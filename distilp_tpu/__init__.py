@@ -1,0 +1,34 @@
+"""distilp_tpu: TPU-native heterogeneous LLM placement framework.
+
+Capabilities (matching and extending firstbatchxyz/distilp):
+
+- ``distilp_tpu.common``   — profile schemas (the JSON contract).
+- ``distilp_tpu.solver``   — HALDA layer/GPU-offload assignment: CPU (scipy/HiGHS)
+  oracle backend plus a JAX backend where the per-k LP relaxations run as a
+  vmapped interior-point kernel and branch-and-bound is batched on device.
+- ``distilp_tpu.profiler`` — device microbenchmarks (JAX) and analytic model
+  profiling straight from HF ``config.json`` metadata (no Metal/MLX needed).
+- ``distilp_tpu.parallel`` — device-mesh utilities and the ICI/DCN
+  communication cost model.
+"""
+
+__version__ = "0.1.0"
+
+from .common import (
+    DeviceProfile,
+    ModelProfile,
+    ModelProfilePhased,
+    ModelProfileSplit,
+    ModelPhase,
+    QuantizationLevel,
+)
+
+__all__ = [
+    "DeviceProfile",
+    "ModelProfile",
+    "ModelProfilePhased",
+    "ModelProfileSplit",
+    "ModelPhase",
+    "QuantizationLevel",
+    "__version__",
+]

@@ -1,0 +1,28 @@
+"""Test configuration.
+
+Tests run on CPU with a virtual 8-device mesh so multi-chip sharding code
+compiles and executes without TPU hardware. Set before any jax import.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+PROFILES = REPO_ROOT / "tests" / "profiles"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def profiles_dir() -> Path:
+    return PROFILES

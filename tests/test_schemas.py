@@ -1,0 +1,113 @@
+"""Schema round-trip and loader tests over the golden conformance fixtures."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from distilp_tpu.common import (
+    DeviceProfile,
+    ModelProfile,
+    ModelProfileSplit,
+    kv_bits_to_factor,
+    load_from_profile_folder,
+    load_model_profile,
+)
+
+FIXTURE_FOLDERS = [
+    "hermes_70b",
+    "llama_3_70b/4bit",
+    "llama_3_70b/online",
+    "qwen3_32b/bf16",
+]
+
+
+@pytest.mark.parametrize("folder", FIXTURE_FOLDERS)
+def test_fixture_folder_loads(profiles_dir: Path, folder: str):
+    devices, model = load_from_profile_folder(profiles_dir / folder)
+    assert devices, "expected at least one device"
+    assert devices[0].is_head
+    assert model.L > 0
+    assert model.b_layer > 0
+    assert "b_1" in model.f_q
+    for dev in devices:
+        assert dev.T_cpu > 0
+        assert dev.scpu, "CPU throughput table must be populated"
+        # All seven quant levels present in measured fixtures
+        for q in ("Q4_K", "Q5_K", "Q6_K", "Q8_0", "F16", "BF16", "F32"):
+            assert q in dev.scpu
+
+
+def test_split_to_scalar_uses_layer_1_decode(profiles_dir: Path):
+    path = profiles_dir / "hermes_70b" / "model_profile.json"
+    raw = json.loads(path.read_text())
+    split = ModelProfileSplit.model_validate(raw)
+    model = split.to_model_profile()
+    assert model.b_layer == split.b[1]
+    assert model.b_in == split.b_i[1]
+    assert model.b_out == split.b_o[1]
+    for batch_key, values in split.f_q["decode"].items():
+        assert model.f_q[batch_key] == values[1]
+    assert model.f_out == split.f_out["decode"]
+    # Loader auto-detects the Split format
+    assert load_model_profile(path).b_layer == model.b_layer
+
+
+def test_device_profile_json_round_trip(profiles_dir: Path):
+    # Prefer the pristine reference fixture so the field-preservation check
+    # runs against the original wire contract, not our own normalized output.
+    ref = Path("/root/reference/test/profiles/llama_3_70b/online/m1.json")
+    path = ref if ref.exists() else profiles_dir / "llama_3_70b" / "online" / "m1.json"
+    raw = json.loads(path.read_text())
+    dev = DeviceProfile.model_validate(raw)
+    dumped = dev.model_dump(mode="json")
+    assert DeviceProfile.model_validate(dumped) == dev
+    # No fields lost relative to the on-disk contract
+    assert set(raw) <= set(dumped)
+    assert dumped["t_comm"] == raw["t_comm"]
+    assert dumped["scpu"] == raw["scpu"]
+
+
+def test_model_profile_round_trip(profiles_dir: Path):
+    path = profiles_dir / "qwen3_32b" / "bf16" / "model_profile.json"
+    raw = json.loads(path.read_text())
+    split = ModelProfileSplit.model_validate(raw)
+    dumped = split.model_dump(mode="json")
+    assert ModelProfileSplit.model_validate(dumped) == split
+
+
+def test_gpu_table_preference():
+    dev = DeviceProfile(
+        has_metal=True,
+        has_cuda=True,
+        sgpu_metal={"F16": {"b_1": 2.0}},
+        sgpu_cuda={"F16": {"b_1": 1.0}},
+        T_metal=5.0,
+        T_cuda=3.0,
+        d_avail_metal=1,
+        d_avail_cuda=1,
+    )
+    assert dev.gpu_table() == {"F16": {"b_1": 2.0}}
+    assert dev.gpu_T() == 5.0
+    assert dev.has_gpu_backend()
+    cpu_only = DeviceProfile()
+    assert cpu_only.gpu_table() is None
+    assert not cpu_only.has_gpu_backend()
+
+
+def test_kv_bits_factor():
+    assert kv_bits_to_factor("4bit") == 0.5
+    assert kv_bits_to_factor("8bit") == 1.0
+    assert kv_bits_to_factor("fp16") == 2.0
+    assert kv_bits_to_factor("BF16") == 2.0
+    with pytest.raises(ValueError):
+        kv_bits_to_factor("2bit")
+
+
+def test_scalar_model_profile_loads(tmp_path: Path):
+    scalar = ModelProfile(L=8, b_layer=100, f_q={"b_1": 1.0}, f_out={"b_1": 2.0})
+    p = tmp_path / "model_profile.json"
+    p.write_text(scalar.model_dump_json())
+    loaded = load_model_profile(p)
+    assert loaded.L == 8
+    assert loaded.b_layer == 100

@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Import the reference's golden profile fixtures as conformance data.
+
+The fixture JSONs under the reference's ``test/profiles/`` are *data* — measured
+device profiles and analytic model profiles — and serve as the
+cross-implementation conformance suite: both solvers must produce the same
+objective on the same profiles. This script validates each fixture through our
+pydantic schemas and re-serializes it into ``tests/profiles/`` (normalized key
+order/formatting). Values are intentionally identical; that is the point of a
+conformance fixture.
+
+Usage: python tools/import_fixtures.py [reference_root] [dest_root]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from distilp_tpu.common import DeviceProfile
+from distilp_tpu.common.loaders import parse_model_profile
+
+
+def normalize(path: Path) -> dict:
+    data = json.loads(path.read_text())
+    if path.name == "model_profile.json":
+        return parse_model_profile(data).model_dump(mode="json")
+    return DeviceProfile.model_validate(data).model_dump(mode="json")
+
+
+def main() -> int:
+    ref = Path(sys.argv[1]) if len(sys.argv) > 1 else Path("/root/reference")
+    dest = (
+        Path(sys.argv[2])
+        if len(sys.argv) > 2
+        else Path(__file__).resolve().parents[1] / "tests" / "profiles"
+    )
+    src = ref / "test" / "profiles"
+
+    # The legacy orphan fixture (flat f_q lists, f_by_quant keys) is not loadable
+    # by the current schema in either implementation; skip it.
+    skip = {"model_profile_qwen3_4b_8bit.json"}
+
+    count = 0
+    for path in sorted(src.rglob("*.json")):
+        if path.name in skip:
+            continue
+        rel = path.relative_to(src)
+        out = dest / rel
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(normalize(path), indent=1, sort_keys=True) + "\n")
+        count += 1
+        print(f"imported {rel}")
+    print(f"{count} fixtures -> {dest}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
