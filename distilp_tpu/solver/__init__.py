@@ -1,0 +1,28 @@
+"""HALDA placement solver: CPU oracle + JAX/TPU batched backend."""
+
+from .api import halda_solve
+from .coeffs import (
+    HaldaCoeffs,
+    alpha_beta_xi,
+    assign_sets,
+    b_cio,
+    b_prime,
+    build_coeffs,
+    kappa_constant,
+    valid_factors_of_L,
+)
+from .result import HALDAResult, ILPResult
+
+__all__ = [
+    "halda_solve",
+    "HALDAResult",
+    "ILPResult",
+    "HaldaCoeffs",
+    "build_coeffs",
+    "b_prime",
+    "alpha_beta_xi",
+    "b_cio",
+    "assign_sets",
+    "kappa_constant",
+    "valid_factors_of_L",
+]

@@ -1,0 +1,117 @@
+"""Public solver API: ``halda_solve`` with pluggable backends.
+
+``backend='cpu'`` — per-k scipy/HiGHS branch-and-cut (the oracle).
+``backend='jax'`` — vmapped interior-point LP relaxations + batched
+branch-and-bound on the accelerator (see ``backend_jax``).
+
+Call-compatible with the reference entry point
+(/root/reference/src/distilp/solver/halda_p_solver.py:369-436), with the
+dead knobs wired for real: ``time_limit`` and ``k_candidates`` are honored
+(the reference CLI parsed but dropped them).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from ..common import DeviceProfile, ModelProfile, kv_bits_to_factor
+from .assemble import assemble
+from .backend_cpu import Infeasible, solve_fixed_k_cpu
+from .coeffs import assign_sets, build_coeffs, valid_factors_of_L
+from .result import HALDAResult, ILPResult
+
+Backend = str  # 'cpu' | 'jax'
+
+
+def halda_solve(
+    devs: Sequence[DeviceProfile],
+    model: ModelProfile,
+    k_candidates: Optional[Iterable[int]] = None,
+    mip_gap: Optional[float] = 1e-4,
+    plot: bool = False,
+    debug: bool = False,
+    kv_bits: str = "8bit",
+    backend: Backend = "cpu",
+    time_limit: Optional[float] = 3600.0,
+) -> HALDAResult:
+    """Pick the best (k, w, n) placement over all candidate segment counts.
+
+    Returns the assignment minimizing the modeled per-round latency; raises
+    ``RuntimeError`` if no candidate k admits a feasible assignment.
+    """
+    if k_candidates:
+        Ks = sorted(set(int(k) for k in k_candidates))
+        bad = [k for k in Ks if k <= 0 or model.L % k != 0 or k == model.L]
+        if bad:
+            raise ValueError(
+                f"k candidates must be proper factors of L={model.L}; invalid: {bad}"
+            )
+    else:
+        Ks = valid_factors_of_L(model.L)
+
+    kv_factor = kv_bits_to_factor(kv_bits)
+    sets = assign_sets(devs)
+    coeffs = build_coeffs(devs, model, kv_factor, sets)
+    arrays = assemble(coeffs)
+
+    per_k_objs: List[Tuple[int, Optional[float]]] = []
+    best: Optional[ILPResult] = None
+
+    if backend == "jax":
+        try:
+            from .backend_jax import solve_sweep_jax
+        except ImportError as e:
+            raise NotImplementedError(
+                "The JAX backend is not available in this build "
+                f"(import failed: {e}); use backend='cpu'."
+            ) from e
+
+        results = solve_sweep_jax(
+            arrays,
+            [(k, model.L // k) for k in Ks],
+            mip_gap=mip_gap if mip_gap is not None else 1e-4,
+            debug=debug,
+        )
+        for k, res in zip(Ks, results):
+            per_k_objs.append((k, res.obj_value if res is not None else None))
+            if debug:
+                obj = f"{res.obj_value:.6f}" if res is not None else "infeasible"
+                print(f"  k={k:<4d}  obj={obj}")
+            if res is not None and (best is None or res.obj_value < best.obj_value):
+                best = res
+    elif backend == "cpu":
+        for k in Ks:
+            try:
+                res = solve_fixed_k_cpu(
+                    arrays, k, model.L // k, time_limit=time_limit, mip_gap=mip_gap
+                )
+            except Infeasible:
+                per_k_objs.append((k, None))
+                if debug:
+                    print(f"  k={k:<4d}  obj=infeasible")
+                continue
+            per_k_objs.append((k, res.obj_value))
+            if debug:
+                print(f"  k={k:<4d}  obj={res.obj_value:.6f}")
+            if best is None or res.obj_value < best.obj_value:
+                best = res
+    else:
+        raise ValueError(f"Unknown backend {backend!r}; expected 'cpu' or 'jax'")
+
+    if best is None:
+        raise RuntimeError("No feasible MILP found for any k.")
+
+    result = HALDAResult(
+        w=list(best.w),
+        n=list(best.n),
+        k=best.k,
+        obj_value=best.obj_value,
+        sets={name: list(v) for name, v in sets.items()},
+    )
+
+    if plot:
+        from .plotter import plot_k_curve
+
+        plot_k_curve(per_k_objs, k_star=result.k)
+
+    return result

@@ -1,0 +1,230 @@
+"""Fixed-shape MILP assembly for the per-k HALDA subproblem.
+
+Decision vector (N = 7M+1), all integer except z and C:
+
+    x = [ w_0..w_{M-1} | n | s1 | s2 | s3 | t | z | C ]
+
+    w_i  layers assigned to device i                 in [1, W]
+    n_i  of those, layers resident on the accelerator in [0, W] (0 w/o GPU)
+    s1/s2/s3_i  RAM-overflow slack layers, gated to the device's set
+    t_i  VRAM-overflow slack layers, gated on GPU presence
+    z_i  pipeline stall seconds (continuous)
+    C    steady-state cycle time seconds (continuous)
+
+Constraint rows are emitted at a fixed count (6M inequality + 1 equality) so
+every (M, k) instance of one fleet shares a single array shape — that is what
+lets the JAX backend vmap the k-sweep and batch branch-and-bound nodes. Rows
+that don't apply to a device (no CUDA, no Metal) keep their structural columns
+but get a huge RHS, and the variable bounds already pin their variables to 0.
+
+Row layout of A_ub:
+    [0,  M)   n_i - w_i <= 0
+    [M, 2M)   RAM/unified residency cap per device (set-dependent shape)
+    [2M,3M)   CUDA VRAM cap
+    [3M,4M)   Metal shared-memory cap
+    [4M,5M)   cycle bound:   B_i + z_i - C <= -(xi_i + t_comm_i)
+    [5M,6M)   prefetch bound: B_i + F_i - z_i - C <= -(xi_i + t_comm_i)
+
+where B_i is the device busy time (a_i w_i + b_i n_i + disk penalties on the
+slacks, plus the constant xi_i + t_comm_i) and F_i = (b'/s_disk_i) w_i the
+disk prefetch time for the next window.
+
+Parity: constraint set and objective match the reference MILP
+(/root/reference/src/distilp/solver/halda_p_solver.py:59-366); the golden
+fixture objectives pin the numerics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .coeffs import HaldaCoeffs
+
+# RHS standing in for "row inactive" — far beyond any byte count in a profile.
+INACTIVE_RHS = 1e30
+
+
+@dataclass(frozen=True)
+class VarLayout:
+    """Index helpers into the decision vector."""
+
+    M: int
+
+    @property
+    def n_vars(self) -> int:
+        return 7 * self.M + 1
+
+    def w(self, i: int) -> int:
+        return i
+
+    def n(self, i: int) -> int:
+        return self.M + i
+
+    def s1(self, i: int) -> int:
+        return 2 * self.M + i
+
+    def s2(self, i: int) -> int:
+        return 3 * self.M + i
+
+    def s3(self, i: int) -> int:
+        return 4 * self.M + i
+
+    def t(self, i: int) -> int:
+        return 5 * self.M + i
+
+    def z(self, i: int) -> int:
+        return 6 * self.M + i
+
+    @property
+    def C(self) -> int:
+        return 7 * self.M
+
+
+@dataclass
+class MilpArrays:
+    """The k-independent dense arrays of one HALDA instance.
+
+    Only ``b_eq`` (= W) and the variable upper bounds scale with k; everything
+    else is shared across the whole k-sweep.
+    """
+
+    layout: VarLayout
+    A_ub: np.ndarray  # (6M, N)
+    b_ub: np.ndarray  # (6M,)
+    A_eq: np.ndarray  # (1, N)
+    c_base: np.ndarray  # (N,) objective without the k-dependent C coefficient
+    integrality: np.ndarray  # (N,) 1 = integer, 0 = continuous
+    # Per-variable bound templates: lb fixed; ub is ub_scale * W + ub_const,
+    # with np.inf marking unbounded (z, C).
+    lb: np.ndarray
+    ub_scale: np.ndarray
+    ub_const: np.ndarray
+    obj_const: float  # additive constant: sum t_comm + sum xi + kappa
+
+    def bounds_for_k(self, W: int) -> tuple[np.ndarray, np.ndarray]:
+        ub = self.ub_scale * float(W) + self.ub_const
+        return self.lb.copy(), ub
+
+    def c_for_k(self, k: int) -> np.ndarray:
+        c = self.c_base.copy()
+        c[self.layout.C] = float(k - 1)
+        return c
+
+
+def assemble(coeffs: HaldaCoeffs) -> MilpArrays:
+    """Emit the fixed-shape arrays for one (devices, model, kv_factor) instance."""
+    M = coeffs.M
+    lay = VarLayout(M)
+    N = lay.n_vars
+
+    A_ub = np.zeros((6 * M, N))
+    b_ub = np.zeros(6 * M)
+    bp = coeffs.bprime
+
+    # Per-device slack penalty coefficients reused by busy rows and objective.
+    # The slack's disk penalty depends on which slack it is, not on the device
+    # set, because bounds already pin out-of-set slacks to zero.
+    pen = {
+        "s1": coeffs.pen_m1,
+        "s2": coeffs.pen_m2,
+        "s3": coeffs.pen_m3,
+        "t": coeffs.pen_vram,
+    }
+
+    for i in range(M):
+        # --- accelerator-count row: n_i <= w_i ---
+        r = i
+        A_ub[r, lay.n(i)] = 1.0
+        A_ub[r, lay.w(i)] = -1.0
+        b_ub[r] = 0.0
+
+        # --- RAM residency row ---
+        r = M + i
+        A_ub[r, lay.w(i)] = bp
+        if coeffs.ram_minus_n[i]:
+            A_ub[r, lay.n(i)] = -bp
+        sid = int(coeffs.set_id[i])
+        slack_col = {1: lay.s1, 2: lay.s2, 3: lay.s3}[sid](i)
+        A_ub[r, slack_col] = -bp
+        b_ub[r] = coeffs.ram_rhs[i] if np.isfinite(coeffs.ram_rhs[i]) else INACTIVE_RHS
+
+        # --- CUDA VRAM row ---
+        r = 2 * M + i
+        A_ub[r, lay.n(i)] = bp
+        A_ub[r, lay.t(i)] = -bp
+        b_ub[r] = coeffs.cuda_rhs[i] if coeffs.cuda_row[i] else INACTIVE_RHS
+
+        # --- Metal shared-memory row ---
+        r = 3 * M + i
+        A_ub[r, lay.n(i)] = bp
+        A_ub[r, lay.t(i)] = -bp
+        b_ub[r] = coeffs.metal_rhs[i] if coeffs.metal_row[i] else INACTIVE_RHS
+
+        # --- busy time B_i (shared by the two cycle rows) ---
+        busy = np.zeros(N)
+        busy[lay.w(i)] = coeffs.a[i]
+        busy[lay.n(i)] = coeffs.b_gpu[i]
+        busy[lay.s1(i)] = pen["s1"][i]
+        busy[lay.s2(i)] = pen["s2"][i]
+        busy[lay.s3(i)] = pen["s3"][i]
+        busy[lay.t(i)] = pen["t"][i]
+        busy_const = coeffs.busy_const[i]
+
+        # --- cycle bound: B_i + const + z_i <= C ---
+        r = 4 * M + i
+        A_ub[r] = busy
+        A_ub[r, lay.z(i)] += 1.0
+        A_ub[r, lay.C] -= 1.0
+        b_ub[r] = -busy_const
+
+        # --- prefetch bound: z_i >= F_i - (C - B_i - const) ---
+        r = 5 * M + i
+        A_ub[r] = busy
+        A_ub[r, lay.w(i)] += bp / coeffs.s_disk[i]
+        A_ub[r, lay.z(i)] -= 1.0
+        A_ub[r, lay.C] -= 1.0
+        b_ub[r] = -busy_const
+
+    # --- equality: sum w_i = W ---
+    A_eq = np.zeros((1, N))
+    A_eq[0, : M] = 1.0
+
+    # --- objective (C coefficient filled per k) ---
+    c = np.zeros(N)
+    c[:M] = coeffs.a
+    c[M : 2 * M] = coeffs.b_gpu
+    for name, sl in (("s1", lay.s1), ("s2", lay.s2), ("s3", lay.s3), ("t", lay.t)):
+        for i in range(M):
+            c[sl(i)] = pen[name][i]
+
+    integrality = np.ones(N, dtype=np.int64)
+    integrality[6 * M :] = 0  # z and C continuous
+
+    # --- bounds templates ---
+    lb = np.zeros(N)
+    ub_scale = np.zeros(N)
+    ub_const = np.zeros(N)
+
+    lb[:M] = 1.0  # every device gets at least one layer
+    ub_scale[:M] = 1.0  # w <= W
+    ub_scale[M : 2 * M] = coeffs.has_gpu.astype(float)  # n <= W or 0
+    for sid, sl in ((1, lay.s1), (2, lay.s2), (3, lay.s3)):
+        for i in range(M):
+            ub_scale[sl(i)] = 1.0 if int(coeffs.set_id[i]) == sid else 0.0
+    ub_scale[5 * M : 6 * M] = coeffs.has_gpu.astype(float)  # t
+    ub_const[6 * M :] = np.inf  # z, C unbounded above
+
+    return MilpArrays(
+        layout=lay,
+        A_ub=A_ub,
+        b_ub=b_ub,
+        A_eq=A_eq,
+        c_base=c,
+        integrality=integrality,
+        lb=lb,
+        ub_scale=ub_scale,
+        ub_const=ub_const,
+        obj_const=coeffs.obj_const,
+    )

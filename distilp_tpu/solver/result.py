@@ -1,0 +1,62 @@
+"""Solver result types."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from pydantic import BaseModel
+
+from ..common import DeviceProfile
+
+
+class ILPResult(BaseModel):
+    """Solution of one fixed-k subproblem."""
+
+    k: int
+    w: List[int]
+    n: List[int]
+    obj_value: float
+
+
+class HALDAResult(BaseModel):
+    """Best placement over the k-sweep."""
+
+    w: List[int]
+    n: List[int]
+    k: int
+    obj_value: float
+    sets: Dict[str, List[int]]
+
+    def solution_text(self, devices: Sequence[DeviceProfile]) -> str:
+        lines = [
+            "",
+            "=" * 60,
+            "HALDA Solution",
+            "=" * 60,
+            "",
+            f"Optimal k: {self.k}",
+            f"Objective value: {self.obj_value:.6f}",
+            "",
+            "Layer distribution (w):",
+        ]
+        total = sum(self.w) or 1
+        for dev, wi in zip(devices, self.w):
+            lines.append(f"  {dev.name:40s}: {wi:3d} layers ({wi / total * 100:5.1f}%)")
+        lines.append("")
+        lines.append("GPU assignments (n):")
+        for dev, ni in zip(devices, self.n):
+            if ni > 0:
+                lines.append(f"  {dev.name:40s}: {ni:3d} layers on GPU")
+            else:
+                lines.append(f"  {dev.name:40s}: CPU only")
+        lines.append("")
+        lines.append("Device sets:")
+        for set_name in ("M1", "M2", "M3"):
+            members = self.sets.get(set_name, [])
+            if members:
+                names = ", ".join(devices[i].name for i in members)
+                lines.append(f"  {set_name}: {names}")
+        return "\n".join(lines)
+
+    def print_solution(self, devices: Sequence[DeviceProfile]) -> None:
+        print(self.solution_text(devices))

@@ -1,0 +1,146 @@
+"""Unit tests for the coefficient model, incl. 1e-12 parity vs the reference."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from distilp_tpu.common import DeviceProfile, ModelProfile, load_from_profile_folder
+from distilp_tpu.solver import (
+    alpha_beta_xi,
+    assign_sets,
+    b_cio,
+    b_prime,
+    build_coeffs,
+    kappa_constant,
+    valid_factors_of_L,
+)
+
+REFERENCE_SRC = Path("/root/reference/src")
+
+FIXTURES = [
+    "hermes_70b",
+    "llama_3_70b/4bit",
+    "llama_3_70b/online",
+    "qwen3_32b/bf16",
+]
+
+
+def test_valid_factors_of_L():
+    assert valid_factors_of_L(80) == [1, 2, 4, 5, 8, 10, 16, 20, 40]
+    assert valid_factors_of_L(64) == [1, 2, 4, 8, 16, 32]
+    assert valid_factors_of_L(7) == [1]
+    assert valid_factors_of_L(1) == []
+
+
+def test_b_prime_hand_computed():
+    model = ModelProfile(
+        L=4, hk=2, ek=8, hv=2, ev=8, n_kv=10, b_layer=1000, Q="Q4_K"
+    )
+    # weights: 1.15 * 1000 = 1150
+    # kv elems: 2*8*10 = 160 per side; 4-bit => 0.5 B/elem => 80 + 80 = 160
+    # group scale: 1 + 2/64 = 1.03125 => 165.0
+    assert b_prime(model, kv_bits_k=0.5) == int(1150 + 165.0)
+    # 8-bit doubles the kv part
+    assert b_prime(model, kv_bits_k=1.0) == int(1150 + 330.0)
+
+
+def test_alpha_beta_xi_hand_computed():
+    model = ModelProfile(
+        L=4, hk=1, ek=1, hv=1, ev=1, n_kv=0, b_layer=0,
+        f_q={"b_1": 100.0}, Q="F16",
+    )
+    dev = DeviceProfile(
+        os_type="linux",
+        scpu={"F16": {"b_1": 50.0}},
+        T_cpu=1e9,
+        t_kvcpy_cpu=0.5,
+        t_kvcpy_gpu=0.7,
+        has_cuda=True,
+        sgpu_cuda={"F16": {"b_1": 200.0}},
+        T_cuda=2e9,
+        d_avail_cuda=1,
+        t_ram2vram=0.1,
+        t_vram2ram=0.2,
+        is_unified_mem=False,
+    )
+    alpha, beta, xi = alpha_beta_xi(dev, model, kv_factor=1.0)
+    # bprime = 0 here, so alpha = 100/50 + 0.5 = 2.5
+    assert alpha == pytest.approx(2.5)
+    # beta = (100/200 - 100/50) + (0.7 - 0.5) + 0 = -1.5 + 0.2
+    assert beta == pytest.approx(-1.3)
+    assert xi == pytest.approx(0.3)
+    # unified memory zeroes xi
+    dev_uma = dev.model_copy(update={"is_unified_mem": True})
+    assert alpha_beta_xi(dev_uma, model, 1.0)[2] == 0.0
+
+
+def test_b_cio_head_vs_tail():
+    model = ModelProfile(L=1, b_in=1000, b_out=500, V=100)
+    head = DeviceProfile(is_head=True, c_cpu=7)
+    tail = DeviceProfile(is_head=False, c_cpu=7)
+    assert b_cio(head, model) == pytest.approx(1000 / 100 + 500 + 7)
+    assert b_cio(tail, model) == pytest.approx(7)
+
+
+def test_assign_sets():
+    devs = [
+        DeviceProfile(os_type="mac_no_metal"),
+        DeviceProfile(os_type="mac_metal"),
+        DeviceProfile(os_type="linux"),
+        DeviceProfile(os_type="android"),
+        DeviceProfile(os_type="tpu"),
+    ]
+    sets = assign_sets(devs)
+    assert sets == {"M1": [0], "M2": [1], "M3": [2, 3, 4]}
+
+
+def test_build_coeffs_on_fixture(profiles_dir):
+    devs, model = load_from_profile_folder(profiles_dir / "llama_3_70b" / "online")
+    coeffs = build_coeffs(devs, model, kv_factor=0.5)
+    assert coeffs.M == 2
+    assert coeffs.set_id.tolist() == [2, 2]  # both mac_metal
+    assert np.all(coeffs.a > 0)
+    assert np.all(coeffs.metal_row)
+    assert not np.any(coeffs.cuda_row)
+    assert coeffs.t_comm.sum() == pytest.approx(0.06355 + 0.06292)
+    # mac_metal devices: GPU delta should be negative (GPU faster than CPU)
+    assert np.all(coeffs.b_gpu < 0)
+
+
+@pytest.mark.skipif(not REFERENCE_SRC.exists(), reason="reference tree not present")
+@pytest.mark.parametrize("fixture", FIXTURES)
+@pytest.mark.parametrize("kv_factor", [0.5, 1.0, 2.0])
+def test_coeff_parity_with_reference(profiles_dir, fixture, kv_factor):
+    """Our vectorized coefficients match the reference scalar code to 1e-12."""
+    if str(REFERENCE_SRC) not in sys.path:
+        sys.path.insert(0, str(REFERENCE_SRC))
+    ref_dc = pytest.importorskip("distilp.solver.components.dense_common")
+
+    devs, model = load_from_profile_folder(profiles_dir / fixture)
+    # Rebuild reference-typed profiles from the same JSON payloads.
+    ref_devs = [
+        ref_dc.DeviceProfile.model_validate(d.model_dump(mode="json")) for d in devs
+    ]
+    ref_model = ref_dc.ModelProfile.model_validate(model.model_dump(mode="json"))
+
+    ref_sets = ref_dc.assign_sets(ref_devs)
+    ref_a, ref_b, ref_c = ref_dc.objective_vectors(ref_devs, ref_model, ref_sets, kv_factor)
+    ref_kappa = ref_dc.kappa_constant(ref_devs, ref_model, ref_sets)
+    ref_bprime = ref_dc.b_prime(ref_model, kv_bits_k=kv_factor)
+
+    sets = assign_sets(devs)
+    assert sets == ref_sets
+    coeffs = build_coeffs(devs, model, kv_factor, sets)
+
+    assert coeffs.bprime == pytest.approx(ref_bprime, abs=1e-9)
+    np.testing.assert_allclose(coeffs.a, ref_a, rtol=1e-12)
+    np.testing.assert_allclose(coeffs.b_gpu, ref_b, rtol=1e-12)
+    np.testing.assert_allclose(coeffs.xi, ref_c, rtol=1e-12)
+    assert coeffs.kappa == pytest.approx(ref_kappa, rel=1e-12)
+    for i, d in enumerate(devs):
+        assert b_cio(d, model) == pytest.approx(
+            ref_dc.b_cio_b(ref_devs[i], ref_model), rel=1e-12
+        )
+    assert kappa_constant(devs, model, sets) == pytest.approx(ref_kappa, rel=1e-12)

@@ -1,0 +1,118 @@
+"""Golden-objective tests for the CPU (scipy/HiGHS) backend.
+
+The expected values were measured by running the reference solver on its own
+fixtures (see BASELINE.md); matching them to 1e-6 proves the assembled MILP is
+the same mathematical program.
+"""
+
+import pytest
+
+from distilp_tpu.common import DeviceProfile, ModelProfile, load_from_profile_folder
+from distilp_tpu.solver import halda_solve
+
+GOLDEN = [
+    # folder, k*, objective, w, n
+    ("hermes_70b", 40, 29.643569, [2], [2]),
+    ("llama_3_70b/4bit", 8, 12.834690, [10], [10]),
+    ("llama_3_70b/online", 2, 1.934942, [13, 27], [13, 27]),
+    ("qwen3_32b/bf16", 16, 12.072837, [4], [4]),
+]
+
+
+@pytest.mark.parametrize("folder,k_star,obj,w,n", GOLDEN)
+def test_golden_objectives(profiles_dir, folder, k_star, obj, w, n):
+    devs, model = load_from_profile_folder(profiles_dir / folder)
+    result = halda_solve(devs, model, mip_gap=1e-4, kv_bits="4bit", backend="cpu")
+    assert result.k == k_star
+    assert result.obj_value == pytest.approx(obj, abs=1e-5)
+    assert result.w == w
+    assert result.n == n
+    assert sum(result.w) * result.k == model.L
+
+
+def test_k_candidates_honored(profiles_dir):
+    devs, model = load_from_profile_folder(profiles_dir / "hermes_70b")
+    result = halda_solve(
+        devs, model, k_candidates=[8, 16], kv_bits="4bit", backend="cpu"
+    )
+    assert result.k in (8, 16)
+    with pytest.raises(ValueError):
+        halda_solve(devs, model, k_candidates=[3], kv_bits="4bit")  # 3 ∤ 80
+    with pytest.raises(ValueError):
+        halda_solve(devs, model, k_candidates=[80], kv_bits="4bit")  # k == L
+
+
+def test_kv_bits_affects_objective(profiles_dir):
+    devs, model = load_from_profile_folder(profiles_dir / "hermes_70b")
+    r4 = halda_solve(devs, model, kv_bits="4bit", backend="cpu")
+    r16 = halda_solve(devs, model, kv_bits="fp16", backend="cpu")
+    # Heavier KV cache cannot make the plan cheaper.
+    assert r16.obj_value >= r4.obj_value - 1e-9
+
+
+def test_ram_overflow_spills_to_disk_slack():
+    # One tiny device that cannot hold even one layer of a huge model.
+    dev = DeviceProfile(
+        name="tiny",
+        os_type="linux",
+        is_head=True,
+        scpu={"F16": {"b_1": 1e9}},
+        T_cpu=1e9,
+        s_disk=1e6,
+        d_avail_ram=1,  # 1 byte of RAM
+        c_cpu=0,
+    )
+    model = ModelProfile(
+        L=4,
+        hk=8,
+        ek=128,
+        hv=8,
+        ev=128,
+        n_kv=1 << 20,
+        e_embed=1024,
+        V=1000,
+        b_layer=1 << 40,  # 1 TiB per layer
+        b_in=0,
+        b_out=0,
+        f_q={"b_1": 1.0},
+        f_out={"b_1": 1.0},
+        Q="F16",
+    )
+    # Slack variables make RAM overflow feasible (spill to disk) — the solver
+    # should still return, charging the disk penalty.
+    result = halda_solve([dev], model, kv_bits="8bit", backend="cpu")
+    assert result.k >= 1
+
+
+def test_infeasible_instance_raises():
+    """More devices than layers per segment: sum w_i = W < M with w_i >= 1."""
+    devs = [
+        DeviceProfile(
+            name=f"d{i}",
+            os_type="linux",
+            is_head=(i == 0),
+            scpu={"F16": {"b_1": 1e9}},
+            T_cpu=1e9,
+            s_disk=1e6,
+            d_avail_ram=1 << 30,
+        )
+        for i in range(4)
+    ]
+    model = ModelProfile(
+        L=8, hk=1, ek=1, hv=1, ev=1, n_kv=1, e_embed=8, V=10,
+        b_layer=1000, f_q={"b_1": 1.0}, f_out={"b_1": 1.0}, Q="F16",
+    )
+    # k=4 -> W=2 but M=4 devices each need w_i >= 1: infeasible for that k;
+    # restricting candidates to k=4 must raise.
+    with pytest.raises(RuntimeError, match="No feasible MILP"):
+        halda_solve(devs, model, k_candidates=[4], kv_bits="8bit", backend="cpu")
+
+
+def test_multi_device_sum_w(profiles_dir):
+    devs, model = load_from_profile_folder(profiles_dir / "llama_3_70b" / "online")
+    result = halda_solve(devs, model, kv_bits="4bit", backend="cpu")
+    assert len(result.w) == 2
+    assert sum(result.w) * result.k == model.L
+    # n_i <= w_i everywhere
+    for wi, ni in zip(result.w, result.n):
+        assert 0 <= ni <= wi
