@@ -66,10 +66,11 @@ def halda_solve(
                 f"(import failed: {e}); use backend='cpu'."
             ) from e
 
-        results = solve_sweep_jax(
+        results, best = solve_sweep_jax(
             arrays,
             [(k, model.L // k) for k in Ks],
             mip_gap=mip_gap if mip_gap is not None else 1e-4,
+            coeffs=coeffs,
             debug=debug,
         )
         for k, res in zip(Ks, results):
@@ -77,8 +78,6 @@ def halda_solve(
             if debug:
                 obj = f"{res.obj_value:.6f}" if res is not None else "infeasible"
                 print(f"  k={k:<4d}  obj={obj}")
-            if res is not None and (best is None or res.obj_value < best.obj_value):
-                best = res
     elif backend == "cpu":
         for k in Ks:
             try:

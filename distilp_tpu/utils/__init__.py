@@ -1,0 +1,5 @@
+"""Utilities: synthetic fleets, logging/timing helpers."""
+
+from .synthetic import make_synthetic_fleet
+
+__all__ = ["make_synthetic_fleet"]

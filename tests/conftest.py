@@ -8,12 +8,31 @@ import os
 import sys
 from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests always run on a virtual 8-device CPU mesh. On this image a TPU-tunnel
+# PJRT plugin ("axon") is injected into every interpreter via a PYTHONPATH
+# sitecustomize, and when the tunnel is down its backend init wedges the whole
+# process — so unregister it before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+from jax._src import xla_bridge  # noqa: E402
+
+if not xla_bridge.backends_are_initialized():
+    try:
+        xla_bridge._backend_factories.pop("axon", None)
+    except AttributeError:
+        import warnings
+
+        warnings.warn(
+            "jax.xla_bridge._backend_factories is gone; the axon PJRT plugin "
+            "cannot be unregistered and tests may hang if the TPU tunnel is down"
+        )
 
 REPO_ROOT = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO_ROOT))

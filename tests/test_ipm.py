@@ -1,0 +1,89 @@
+"""IPM kernel tests: random boxed LPs vs scipy linprog, bound validity."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from distilp_tpu.ops import LPBatch, ipm_solve_batch  # noqa: E402
+
+
+def _random_feasible_batch(rng, m, n, B, fix_frac=0.2):
+    from scipy.optimize import linprog
+
+    A = rng.normal(size=(m, n))
+    bs, cs, ls, us, refs = [], [], [], [], []
+    for _ in range(B):
+        l = rng.uniform(-2, 0, n)
+        u = l + rng.uniform(0.5, 3, n)
+        fix = rng.random(n) < fix_frac
+        u = np.where(fix, l, u)
+        x_feas = l + rng.uniform(0, 1, n) * (u - l)
+        b = A @ x_feas
+        c = rng.normal(size=n)
+        r = linprog(c, A_eq=A, b_eq=b, bounds=np.stack([l, u], 1), method="highs")
+        assert r.status == 0
+        refs.append(r.fun)
+        bs.append(b)
+        cs.append(c)
+        ls.append(l)
+        us.append(u)
+    batch = LPBatch(
+        jnp.array(A), jnp.array(bs), jnp.array(cs), jnp.array(ls), jnp.array(us)
+    )
+    return batch, np.array(refs)
+
+
+def test_ipm_matches_scipy_on_random_lps():
+    rng = np.random.default_rng(42)
+    batch, refs = _random_feasible_batch(rng, m=10, n=25, B=16)
+    res = ipm_solve_batch(batch, iters=50)
+    assert np.all(np.array(res.converged))
+    np.testing.assert_allclose(np.array(res.obj), refs, rtol=1e-8, atol=1e-8)
+    # The Lagrangian bound must be a valid lower bound on the true optimum.
+    assert np.all(np.array(res.bound) <= refs + 1e-8)
+    # ...and tight at convergence.
+    np.testing.assert_allclose(np.array(res.bound), refs, rtol=1e-6, atol=1e-6)
+
+
+def test_ipm_no_nan_with_extra_iterations():
+    """Iterating far past convergence must not corrupt the frozen solution."""
+    rng = np.random.default_rng(7)
+    batch, refs = _random_feasible_batch(rng, m=6, n=14, B=4, fix_frac=0.0)
+    res = ipm_solve_batch(batch, iters=200)
+    assert np.all(np.isfinite(np.array(res.obj)))
+    assert np.all(np.isfinite(np.array(res.bound)))
+    np.testing.assert_allclose(np.array(res.obj), refs, rtol=1e-8, atol=1e-8)
+
+
+def test_ipm_all_columns_fixed():
+    """A fully-fixed box (every branch variable pinned) must not blow up."""
+    rng = np.random.default_rng(3)
+    n, m = 8, 3
+    A = rng.normal(size=(m, n))
+    l = rng.uniform(0, 1, size=(1, n))
+    u = l.copy()  # everything fixed
+    b = (A @ l[0])[None, :]
+    c = rng.normal(size=(1, n))
+    res = ipm_solve_batch(
+        LPBatch(jnp.array(A), jnp.array(b), jnp.array(c), jnp.array(l), jnp.array(u)),
+        iters=20,
+    )
+    assert np.isfinite(float(res.obj[0]))
+    assert float(res.obj[0]) == pytest.approx(float(c[0] @ l[0]))
+
+
+def test_ipm_infeasible_bound_grows():
+    """On an infeasible LP the Lagrangian bound should exceed any feasible-
+    looking value, so branch-and-bound prunes the node."""
+    A = jnp.array([[1.0, 1.0]])
+    b = jnp.array([[10.0]])  # x1 + x2 = 10 but boxes cap at 2
+    c = jnp.array([[1.0, 1.0]])
+    l = jnp.zeros((1, 2))
+    u = jnp.full((1, 2), 1.0)
+    res = ipm_solve_batch(LPBatch(A, b, c, l, u), iters=60)
+    # Any feasible point would cost <= 2; the bound must blow past that.
+    assert float(res.bound[0]) > 2.0

@@ -1,0 +1,52 @@
+"""JAX backend parity: golden fixtures + synthetic fleets vs the CPU oracle."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from distilp_tpu.common import load_from_profile_folder, load_model_profile  # noqa: E402
+from distilp_tpu.solver import halda_solve  # noqa: E402
+from distilp_tpu.utils import make_synthetic_fleet  # noqa: E402
+
+GOLDEN = [
+    ("hermes_70b", 40, 29.643569),
+    ("llama_3_70b/4bit", 8, 12.834690),
+    ("llama_3_70b/online", 2, 1.934942),
+    ("qwen3_32b/bf16", 16, 12.072837),
+]
+
+
+@pytest.mark.parametrize("folder,k_star,obj", GOLDEN)
+def test_jax_backend_matches_golden(profiles_dir, folder, k_star, obj):
+    devs, model = load_from_profile_folder(profiles_dir / folder)
+    result = halda_solve(devs, model, mip_gap=1e-4, kv_bits="4bit", backend="jax")
+    assert result.k == k_star
+    assert result.obj_value == pytest.approx(obj, rel=2e-4)
+    assert sum(result.w) * result.k == model.L
+    for wi, ni in zip(result.w, result.n):
+        assert 0 <= ni <= wi
+
+
+@pytest.mark.parametrize("M", [4, 8])
+def test_jax_matches_cpu_on_synthetic_fleet(profiles_dir, M):
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(M, seed=M)
+    gap = 1e-3
+    ref = halda_solve(devs, model, mip_gap=gap, kv_bits="4bit", backend="cpu")
+    got = halda_solve(devs, model, mip_gap=gap, kv_bits="4bit", backend="jax")
+    # Both backends certify the same relative gap, so the objectives can
+    # differ by at most twice that.
+    assert got.obj_value == pytest.approx(ref.obj_value, rel=2 * gap)
+    assert sum(got.w) * got.k == model.L
+    assert all(0 <= n <= w for w, n in zip(got.w, got.n))
+
+
+def test_jax_backend_infeasible(profiles_dir):
+    devs = make_synthetic_fleet(6, seed=1)
+    _, model = load_from_profile_folder(profiles_dir / "hermes_70b")
+    # k=20 -> W=4 < 6 devices: structurally infeasible; only candidate.
+    with pytest.raises(RuntimeError, match="No feasible"):
+        halda_solve(devs, model, k_candidates=[20], kv_bits="4bit", backend="jax")
