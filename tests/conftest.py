@@ -24,6 +24,9 @@ import jax  # noqa: E402
 from jax._src import xla_bridge  # noqa: E402
 
 if not xla_bridge.backends_are_initialized():
+    # NOT redundant with the env var above: the sitecustomize imported jax
+    # before this file ran, so jax.config already latched JAX_PLATFORMS=axon.
+    jax.config.update("jax_platforms", "cpu")
     try:
         xla_bridge._backend_factories.pop("axon", None)
     except AttributeError:
