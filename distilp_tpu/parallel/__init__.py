@@ -1,0 +1,19 @@
+"""Mesh distribution and the ICI/DCN communication cost model."""
+
+from .mesh import (
+    NODE_AXIS,
+    make_mesh,
+    pad_cap_to_mesh,
+    shard_state,
+    solve_sweep_sharded,
+    state_shardings,
+)
+
+__all__ = [
+    "NODE_AXIS",
+    "make_mesh",
+    "shard_state",
+    "state_shardings",
+    "pad_cap_to_mesh",
+    "solve_sweep_sharded",
+]

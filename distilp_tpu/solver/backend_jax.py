@@ -21,6 +21,7 @@ the host loop only inspects two scalars per round (gap, live-node count).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -290,147 +291,202 @@ class SearchState(NamedTuple):
     per_k_best: jax.Array  # (n_k,) best incumbent per k (reporting only)
 
 
-def _make_round_fn(sf: StandardForm, rd: RoundingData, ipm_iters: int):
-    A = jnp.asarray(sf.A, DTYPE)
-    b_k = jnp.asarray(sf.b_k, DTYPE)
-    c_k = jnp.asarray(sf.c_k, DTYPE)
-    int_mask = jnp.asarray(sf.int_mask)
-    ks = jnp.asarray(sf.ks, DTYPE)
-    Ws = jnp.asarray(sf.Ws, DTYPE)
-    M = sf.M
+class SweepData(NamedTuple):
+    """Device-resident arrays of one sweep, shared by every B&B round.
+
+    A plain pytree argument (not a closure) so the jitted round function is a
+    single module-level callable whose compile cache is reused across
+    ``halda_solve`` calls of the same shape.
+    """
+
+    A: jax.Array  # (m, nf)
+    b_k: jax.Array  # (n_k, m)
+    c_k: jax.Array  # (n_k, nf)
+    int_mask: jax.Array  # (nf,) bool
+    ks: jax.Array  # (n_k,)
+    Ws: jax.Array  # (n_k,)
+    obj_const: jax.Array  # ()
+    rd: RoundingData
+
+
+def _sweep_data(sf: StandardForm, rd: RoundingData) -> SweepData:
+    return SweepData(
+        A=jnp.asarray(sf.A, DTYPE),
+        b_k=jnp.asarray(sf.b_k, DTYPE),
+        c_k=jnp.asarray(sf.c_k, DTYPE),
+        int_mask=jnp.asarray(sf.int_mask),
+        ks=jnp.asarray(sf.ks, DTYPE),
+        Ws=jnp.asarray(sf.Ws, DTYPE),
+        obj_const=jnp.asarray(sf.obj_const, DTYPE),
+        rd=rd,
+    )
+
+
+def _init_state(sf: StandardForm, cap: Optional[int] = None) -> SearchState:
+    """Root frontier: one node per k. An explicit ``cap`` is honored exactly
+    (mesh callers pre-pad it to their device count); it must fit the roots."""
+    n_k = len(sf.ks)
     nf = sf.A.shape[1]
-    obj_const = sf.obj_const
-
-    def one_round(state: SearchState, mip_gap: float) -> SearchState:
-        b = b_k[state.node_kidx]
-        c = c_k[state.node_kidx]
-        res = ipm_solve_batch(
-            LPBatch(A=A, b=b, c=c, l=state.node_lo, u=state.node_hi),
-            iters=ipm_iters,
-        )
-        bound = res.bound + obj_const
-        bound = jnp.where(state.active, jnp.maximum(bound, state.node_bound), jnp.inf)
-
-        # Exact integer incumbents from every active node's LP point.
-        obj_lin, w_int, n_int = jax.vmap(
-            lambda v, kidx: _round_to_incumbent(v, M, Ws[kidx], ks[kidx], rd)
-        )(res.v, state.node_kidx)
-        obj_full = jnp.where(state.active, obj_lin + obj_const, jnp.inf)
-
-        best_i = jnp.argmin(obj_full)
-        best_obj = obj_full[best_i]
-        better = best_obj < state.incumbent
-        incumbent = jnp.where(better, best_obj, state.incumbent)
-        inc_w = jnp.where(better, w_int[best_i], state.inc_w)
-        inc_n = jnp.where(better, n_int[best_i], state.inc_n)
-        inc_kidx = jnp.where(better, state.node_kidx[best_i], state.inc_kidx)
-
-        # Per-k reporting incumbents
-        per_k_best = state.per_k_best
-        per_k_best = jnp.minimum(
-            per_k_best,
-            jnp.full_like(per_k_best, jnp.inf).at[state.node_kidx].min(obj_full),
-        )
-
-        # Prune: a node survives only if its bound can still beat the
-        # incumbent by more than the requested relative gap. (With no
-        # incumbent yet the threshold must stay +inf, not inf-inf=NaN.)
-        threshold = jnp.where(
-            jnp.isfinite(incumbent),
-            incumbent - mip_gap * jnp.abs(incumbent),
-            jnp.inf,
-        )
-        survive = state.active & (bound < threshold)
-
-        # Close nodes that are provably done: either the box is a single
-        # point, or this round's rounded incumbent already achieves the
-        # node's lower bound (so nothing better hides in the subtree). An
-        # integral-*looking* LP point alone is NOT proof — the IPM may not
-        # have converged — so such nodes keep splitting on the widest box.
-        width = jnp.where(
-            int_mask[None, :], state.node_hi - state.node_lo, 0.0
-        )
-        fully_fixed = jnp.max(width, axis=1) < 0.5
-        achieved = obj_full <= bound + 1e-6 * jnp.maximum(1.0, jnp.abs(bound))
-        survive &= ~(fully_fixed | achieved)
-
-        # Branch variable: most fractional if any, else the widest box.
-        frac = jnp.abs(res.v - jnp.round(res.v))
-        branchable = int_mask[None, :] & (width > 0.5)
-        frac_m = jnp.where(branchable, frac, -1.0)
-        j_frac = jnp.argmax(frac_m, axis=1)
-        max_frac = jnp.take_along_axis(frac_m, j_frac[:, None], axis=1)[:, 0]
-        j_wide = jnp.argmax(width, axis=1)
-        has_frac = max_frac > FRAC_TOL
-        j_star = jnp.where(has_frac, j_frac, j_wide)
-
-        lo_j = jnp.take_along_axis(state.node_lo, j_star[:, None], axis=1)[:, 0]
-        hi_j = jnp.take_along_axis(state.node_hi, j_star[:, None], axis=1)[:, 0]
-        vj = jnp.take_along_axis(res.v, j_star[:, None], axis=1)[:, 0]
-        split = jnp.where(has_frac, vj, 0.5 * (lo_j + hi_j))
-        dn = jnp.clip(jnp.floor(split), lo_j, jnp.maximum(hi_j - 1.0, lo_j))
-        up = dn + 1.0
-
-        cap = state.node_lo.shape[0]
-        rows = jnp.arange(cap)
-        # child A: hi_j -> floor(v_j); child B: lo_j -> ceil(v_j)
-        hi_a = state.node_hi.at[rows, j_star].set(dn)
-        lo_b = state.node_lo.at[rows, j_star].set(up)
-
-        child_lo = jnp.concatenate([state.node_lo, lo_b], axis=0)
-        child_hi = jnp.concatenate([hi_a, state.node_hi], axis=0)
-        child_kidx = jnp.concatenate([state.node_kidx, state.node_kidx])
-        child_bound = jnp.concatenate([bound, bound])
-        child_active = jnp.concatenate([survive, survive])
-
-        # Compact best-bound-first back into CAP slots; track what falls off.
-        sort_key = jnp.where(child_active, child_bound, jnp.inf)
-        order = jnp.argsort(sort_key)
-        keep = order[:cap]
-        spill = order[cap:]
-        spill_bound = jnp.min(jnp.where(child_active[spill], child_bound[spill], jnp.inf))
-        dropped_bound = jnp.minimum(state.dropped_bound, spill_bound)
-
-        return SearchState(
-            node_lo=child_lo[keep],
-            node_hi=child_hi[keep],
-            node_kidx=child_kidx[keep],
-            node_bound=child_bound[keep],
-            active=child_active[keep],
-            incumbent=incumbent,
-            inc_w=inc_w,
-            inc_n=inc_n,
-            inc_kidx=inc_kidx,
-            dropped_bound=dropped_bound,
-            per_k_best=per_k_best,
-        )
-
-    dummy_nf = nf  # closed over for init
-
-    def init_state() -> SearchState:
-        n_k = len(sf.ks)
+    if cap is None:
         cap = max(NODE_CAP, 2 * n_k)
-        node_lo = jnp.zeros((cap, dummy_nf), DTYPE)
-        node_hi = jnp.zeros((cap, dummy_nf), DTYPE)
-        node_lo = node_lo.at[:n_k].set(jnp.asarray(sf.lo_k, DTYPE))
-        node_hi = node_hi.at[:n_k].set(jnp.asarray(sf.hi_k, DTYPE))
-        node_kidx = jnp.zeros(cap, jnp.int32).at[: n_k].set(jnp.arange(n_k, dtype=jnp.int32))
-        active = jnp.zeros(cap, bool).at[:n_k].set(True)
-        return SearchState(
-            node_lo=node_lo,
-            node_hi=node_hi,
-            node_kidx=node_kidx,
-            node_bound=jnp.full(cap, -jnp.inf, DTYPE),
-            active=active,
-            incumbent=jnp.asarray(jnp.inf, DTYPE),
-            inc_w=jnp.zeros(M, DTYPE),
-            inc_n=jnp.zeros(M, DTYPE),
-            inc_kidx=jnp.asarray(0, jnp.int32),
-            dropped_bound=jnp.asarray(jnp.inf, DTYPE),
-            per_k_best=jnp.full(len(sf.ks), jnp.inf, DTYPE),
+    elif cap < n_k:
+        raise ValueError(f"frontier cap {cap} cannot hold {n_k} root nodes")
+    node_lo = jnp.zeros((cap, nf), DTYPE).at[:n_k].set(jnp.asarray(sf.lo_k, DTYPE))
+    node_hi = jnp.zeros((cap, nf), DTYPE).at[:n_k].set(jnp.asarray(sf.hi_k, DTYPE))
+    node_kidx = jnp.zeros(cap, jnp.int32).at[:n_k].set(
+        jnp.arange(n_k, dtype=jnp.int32)
+    )
+    active = jnp.zeros(cap, bool).at[:n_k].set(True)
+    return SearchState(
+        node_lo=node_lo,
+        node_hi=node_hi,
+        node_kidx=node_kidx,
+        node_bound=jnp.full(cap, -jnp.inf, DTYPE),
+        active=active,
+        incumbent=jnp.asarray(jnp.inf, DTYPE),
+        inc_w=jnp.zeros(sf.M, DTYPE),
+        inc_n=jnp.zeros(sf.M, DTYPE),
+        inc_kidx=jnp.asarray(0, jnp.int32),
+        dropped_bound=jnp.asarray(jnp.inf, DTYPE),
+        per_k_best=jnp.full(n_k, jnp.inf, DTYPE),
+    )
+
+
+@partial(jax.jit, static_argnames=("ipm_iters", "tier"))
+def _bnb_round(
+    data: SweepData,
+    state: SearchState,
+    mip_gap: jax.Array,
+    ipm_iters: int = 50,
+    tier: Optional[int] = None,
+) -> SearchState:
+    """One batched branch-and-bound round over the frontier.
+
+    ``tier`` solves only the first ``tier`` slots — valid because compaction
+    sorts live nodes to the front — so small trees don't pay for the full
+    frontier capacity. The host picks the smallest tier >= live count.
+    """
+    A, int_mask, ks, Ws, rd = data.A, data.int_mask, data.ks, data.Ws, data.rd
+    obj_const = data.obj_const
+    M = state.inc_w.shape[0]
+
+    full = state
+    if tier is not None and tier < state.node_lo.shape[0]:
+        state = state._replace(
+            node_lo=state.node_lo[:tier],
+            node_hi=state.node_hi[:tier],
+            node_kidx=state.node_kidx[:tier],
+            node_bound=state.node_bound[:tier],
+            active=state.active[:tier],
         )
 
-    return jax.jit(one_round, static_argnames=()), init_state
+    b = data.b_k[state.node_kidx]
+    c = data.c_k[state.node_kidx]
+    res = ipm_solve_batch(
+        LPBatch(A=A, b=b, c=c, l=state.node_lo, u=state.node_hi),
+        iters=ipm_iters,
+    )
+    bound = res.bound + obj_const
+    bound = jnp.where(state.active, jnp.maximum(bound, state.node_bound), jnp.inf)
+
+    # Exact integer incumbents from every active node's LP point.
+    obj_lin, w_int, n_int = jax.vmap(
+        lambda v, kidx: _round_to_incumbent(v, M, Ws[kidx], ks[kidx], rd)
+    )(res.v, state.node_kidx)
+    obj_full = jnp.where(state.active, obj_lin + obj_const, jnp.inf)
+
+    best_i = jnp.argmin(obj_full)
+    best_obj = obj_full[best_i]
+    better = best_obj < state.incumbent
+    incumbent = jnp.where(better, best_obj, state.incumbent)
+    inc_w = jnp.where(better, w_int[best_i], state.inc_w)
+    inc_n = jnp.where(better, n_int[best_i], state.inc_n)
+    inc_kidx = jnp.where(better, state.node_kidx[best_i], state.inc_kidx)
+
+    # Per-k reporting incumbents
+    per_k_best = state.per_k_best
+    per_k_best = jnp.minimum(
+        per_k_best,
+        jnp.full_like(per_k_best, jnp.inf).at[state.node_kidx].min(obj_full),
+    )
+
+    # Prune: a node survives only if its bound can still beat the
+    # incumbent by more than the requested relative gap. (With no
+    # incumbent yet the threshold must stay +inf, not inf-inf=NaN.)
+    threshold = jnp.where(
+        jnp.isfinite(incumbent),
+        incumbent - mip_gap * jnp.abs(incumbent),
+        jnp.inf,
+    )
+    survive = state.active & (bound < threshold)
+
+    # Close nodes that are provably done: either the box is a single
+    # point, or this round's rounded incumbent already achieves the
+    # node's lower bound (so nothing better hides in the subtree). An
+    # integral-*looking* LP point alone is NOT proof — the IPM may not
+    # have converged — so such nodes keep splitting on the widest box.
+    width = jnp.where(
+        int_mask[None, :], state.node_hi - state.node_lo, 0.0
+    )
+    fully_fixed = jnp.max(width, axis=1) < 0.5
+    achieved = obj_full <= bound + 1e-6 * jnp.maximum(1.0, jnp.abs(bound))
+    survive &= ~(fully_fixed | achieved)
+
+    # Branch variable: most fractional if any, else the widest box.
+    frac = jnp.abs(res.v - jnp.round(res.v))
+    branchable = int_mask[None, :] & (width > 0.5)
+    frac_m = jnp.where(branchable, frac, -1.0)
+    j_frac = jnp.argmax(frac_m, axis=1)
+    max_frac = jnp.take_along_axis(frac_m, j_frac[:, None], axis=1)[:, 0]
+    j_wide = jnp.argmax(width, axis=1)
+    has_frac = max_frac > FRAC_TOL
+    j_star = jnp.where(has_frac, j_frac, j_wide)
+
+    lo_j = jnp.take_along_axis(state.node_lo, j_star[:, None], axis=1)[:, 0]
+    hi_j = jnp.take_along_axis(state.node_hi, j_star[:, None], axis=1)[:, 0]
+    vj = jnp.take_along_axis(res.v, j_star[:, None], axis=1)[:, 0]
+    split = jnp.where(has_frac, vj, 0.5 * (lo_j + hi_j))
+    dn = jnp.clip(jnp.floor(split), lo_j, jnp.maximum(hi_j - 1.0, lo_j))
+    up = dn + 1.0
+
+    cap = state.node_lo.shape[0]
+    rows = jnp.arange(cap)
+    # child A: hi_j -> floor(v_j); child B: lo_j -> ceil(v_j)
+    hi_a = state.node_hi.at[rows, j_star].set(dn)
+    lo_b = state.node_lo.at[rows, j_star].set(up)
+
+    # Children of the solved prefix plus the untouched tail of the frontier.
+    child_lo = jnp.concatenate([state.node_lo, lo_b, full.node_lo[cap:]], axis=0)
+    child_hi = jnp.concatenate([hi_a, state.node_hi, full.node_hi[cap:]], axis=0)
+    child_kidx = jnp.concatenate(
+        [state.node_kidx, state.node_kidx, full.node_kidx[cap:]]
+    )
+    child_bound = jnp.concatenate([bound, bound, full.node_bound[cap:]])
+    child_active = jnp.concatenate([survive, survive, full.active[cap:]])
+
+    # Compact best-bound-first back into the full capacity; track what falls off.
+    full_cap = full.node_lo.shape[0]
+    sort_key = jnp.where(child_active, child_bound, jnp.inf)
+    order = jnp.argsort(sort_key)
+    keep = order[:full_cap]
+    spill = order[full_cap:]
+    spill_bound = jnp.min(jnp.where(child_active[spill], child_bound[spill], jnp.inf))
+    dropped_bound = jnp.minimum(state.dropped_bound, spill_bound)
+
+    return SearchState(
+        node_lo=child_lo[keep],
+        node_hi=child_hi[keep],
+        node_kidx=child_kidx[keep],
+        node_bound=child_bound[keep],
+        active=child_active[keep],
+        incumbent=incumbent,
+        inc_w=inc_w,
+        inc_n=inc_n,
+        inc_kidx=inc_kidx,
+        dropped_bound=dropped_bound,
+        per_k_best=per_k_best,
+    )
+
 
 
 def solve_sweep_jax(
@@ -459,23 +515,28 @@ def solve_sweep_jax(
         return results, None
 
     sf = build_standard_form(arrays, coeffs, feasible)
-    rd = rounding_data(coeffs)
-    round_fn, init_state = _make_round_fn(sf, rd, ipm_iters)
+    data = _sweep_data(sf, rounding_data(coeffs))
+    gap = jnp.asarray(mip_gap, DTYPE)
 
-    state = init_state()
+    state = _init_state(sf)
+    cap = int(state.node_lo.shape[0])
+    tiers = sorted({t for t in (16, 64, cap) if t <= cap})
+    live = len(feasible)
     for _ in range(MAX_ROUNDS):
-        state = round_fn(state, mip_gap)
+        tier = next((t for t in tiers if t >= live), cap)
+        state = _bnb_round(data, state, gap, ipm_iters=ipm_iters, tier=tier)
         incumbent = float(state.incumbent)
         live_bounds = np.asarray(
             jnp.where(state.active, state.node_bound, jnp.inf)
         )
         best_bound = min(float(live_bounds.min()), float(state.dropped_bound))
-        n_live = int(np.asarray(state.active).sum())
+        live = int(np.asarray(state.active).sum())
         if debug:
             print(
-                f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f} live={n_live}"
+                f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f} "
+                f"live={live} tier={tier}"
             )
-        if n_live == 0:
+        if live == 0:
             break
         if np.isfinite(incumbent) and (
             incumbent - best_bound <= mip_gap * abs(incumbent)
