@@ -1,0 +1,6 @@
+"""Console entry points: ``profiler`` and ``solver``.
+
+Parity with the reference CLIs (/root/reference/src/cli/), with its dead
+flags wired for real (reference cli/solver.py parses --time-limit,
+--k-candidates, --kv-bits equivalents but never forwards them; see SURVEY §8).
+"""

@@ -1,0 +1,79 @@
+"""Profiler CLI (reference /root/reference/src/cli/profiler.py).
+
+``profiler model -r <source>`` writes a ModelProfileSplit JSON;
+``profiler device -r <source>`` microbenchmarks this host and writes a
+DeviceProfile JSON. ``<source>`` is a HF repo id, a local config.json path,
+or a directory containing one (offline-first; the reference requires the
+Hub).
+
+The reference ships ``--max-batch-exp`` defaulting to 2 while its help text
+and API say 6 (cli/profiler.py:67-72 vs api.py:57); here default and help
+agree on 6.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="profiler",
+        description="Profile this device or a model analytically",
+    )
+    p.add_argument("kind", choices=["device", "model"])
+    p.add_argument(
+        "-r",
+        "--repo",
+        required=True,
+        help="HF repo id, path to config.json, or directory containing one",
+    )
+    p.add_argument("-o", "--output", default=None, help="output JSON path")
+    p.add_argument("-s", "--seq-len", type=int, default=512)
+    p.add_argument(
+        "--max-batch-exp",
+        type=int,
+        default=6,
+        help="device tables cover batches 2^0 .. 2^(N-1) (default 6)",
+    )
+    p.add_argument(
+        "--batches",
+        default=None,
+        help="comma-separated batch sizes for model profiling (default 1,2,4,8)",
+    )
+    p.add_argument("--not-head", action="store_true", help="mark device as non-head")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.kind == "model":
+        from ..profiler import profile_model
+
+        batches = (
+            [int(x) for x in args.batches.split(",") if x.strip()]
+            if args.batches
+            else None
+        )
+        profile = profile_model(
+            args.repo, batch_sizes=batches, sequence_length=args.seq_len
+        )
+        out = Path(args.output or "model_profile.json")
+    else:
+        from ..profiler import profile_device
+
+        profile = profile_device(
+            args.repo, max_batch_exp=args.max_batch_exp, is_head=not args.not_head
+        )
+        out = Path(args.output or f"{profile.name or 'device'}.json")
+
+    out.write_text(profile.model_dump_json(indent=2))
+    print(f"Wrote {args.kind} profile to {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

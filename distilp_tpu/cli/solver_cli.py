@@ -1,0 +1,93 @@
+"""Solver CLI (reference /root/reference/src/cli/solver.py).
+
+Reads a profile folder (``model_profile.json`` + one JSON per device; the
+head device is whichever sorts first, reference cli/solver.py:49-51), runs
+the HALDA sweep, prints the placement, optionally writes a solution JSON.
+
+Differences from the reference, all deliberate:
+- ``--backend {cpu,jax}`` selects the engine (jax = batched IPM + B&B on the
+  accelerator); the reference has only scipy/HiGHS.
+- ``--time-limit``, ``--k-candidates``, ``--kv-bits`` and ``--mip-gap`` are
+  actually forwarded (the reference parses several of these and drops them,
+  cli/solver.py:211).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solver",
+        description="HALDA placement solver over a folder of device/model profiles",
+    )
+    p.add_argument(
+        "--profile",
+        "-p",
+        required=True,
+        help="folder containing model_profile.json and per-device JSONs",
+    )
+    p.add_argument("--backend", choices=["cpu", "jax"], default="cpu")
+    p.add_argument("--mip-gap", type=float, default=1e-4)
+    p.add_argument("--kv-bits", default="4bit", help="4bit | 8bit | fp16 | bf16")
+    p.add_argument("--time-limit", type=float, default=3600.0, help="per-k seconds (cpu backend)")
+    p.add_argument(
+        "--k-candidates",
+        default=None,
+        help="comma-separated k values (default: all proper factors of L)",
+    )
+    p.add_argument("--plot", action="store_true", help="plot the k-objective curve")
+    p.add_argument("--debug", action="store_true")
+    p.add_argument("--save-solution", default=None, help="write the solution JSON here")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from ..common import load_from_profile_folder
+    from ..solver import halda_solve
+
+    folder = Path(args.profile)
+    if not folder.is_dir():
+        print(f"error: {folder} is not a directory", file=sys.stderr)
+        return 2
+    devices, model = load_from_profile_folder(folder)
+
+    k_candidates = None
+    if args.k_candidates:
+        k_candidates = [int(x) for x in args.k_candidates.split(",") if x.strip()]
+
+    result = halda_solve(
+        devices,
+        model,
+        k_candidates=k_candidates,
+        mip_gap=args.mip_gap,
+        plot=args.plot,
+        debug=args.debug,
+        kv_bits=args.kv_bits,
+        backend=args.backend,
+        time_limit=args.time_limit,
+    )
+    result.print_solution(devices)
+
+    if args.save_solution:
+        payload = {
+            "k": result.k,
+            "w": result.w,
+            "n": result.n,
+            "obj_value": result.obj_value,
+            "sets": result.sets,
+            "devices": [d.name for d in devices],
+        }
+        Path(args.save_solution).write_text(json.dumps(payload, indent=2))
+        print(f"Saved solution to {args.save_solution}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

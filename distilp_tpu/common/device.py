@@ -33,6 +33,7 @@ class DeviceProfile(BaseModel):
     is_unified_mem: bool = False  # I_UMA: unified host/accelerator memory
     has_cuda: bool = False
     has_metal: bool = False
+    has_tpu: bool = False  # extension: TPU accelerator attached to this host
 
     # CPU compute: s^{cpu}_{m,q} FLOPS table per quant level and batch,
     # and T^{cpu}_m register-load throughput in bytes/s.
@@ -57,10 +58,13 @@ class DeviceProfile(BaseModel):
     # Accelerator compute tables and capacities (None when absent).
     sgpu_cuda: Optional[ThroughputTable] = None
     sgpu_metal: Optional[ThroughputTable] = None
+    sgpu_tpu: Optional[ThroughputTable] = None
     T_cuda: Optional[float] = None
     T_metal: Optional[float] = None
+    T_tpu: Optional[float] = None
     d_avail_cuda: Optional[int] = None
     d_avail_metal: Optional[int] = None
+    d_avail_tpu: Optional[int] = None
 
     # Compute scratch buffers (bytes), reserved out of the memory caps.
     c_cpu: int = 0
@@ -71,10 +75,13 @@ class DeviceProfile(BaseModel):
     d_swap_avail: int = 0
 
     def gpu_table(self) -> Optional[ThroughputTable]:
-        """The accelerator FLOPS table the solver should use (Metal wins over CUDA).
+        """The accelerator FLOPS table the solver should use.
 
-        Parity: /root/reference/src/distilp/solver/components/dense_common.py:78-86.
+        TPU (this framework's extension) wins, then Metal over CUDA as in the
+        reference (/root/reference/src/distilp/solver/components/dense_common.py:78-86).
         """
+        if self.has_tpu and self.sgpu_tpu:
+            return self.sgpu_tpu
         if self.has_metal and self.sgpu_metal:
             return self.sgpu_metal
         if self.has_cuda and self.sgpu_cuda:
@@ -86,6 +93,8 @@ class DeviceProfile(BaseModel):
 
         Parity: /root/reference/src/distilp/solver/components/dense_common.py:89-97.
         """
+        if self.has_tpu and self.T_tpu:
+            return self.T_tpu
         if self.has_metal and self.T_metal:
             return self.T_metal
         if self.has_cuda and self.T_cuda:
@@ -95,7 +104,8 @@ class DeviceProfile(BaseModel):
     def has_gpu_backend(self) -> bool:
         """Whether any accelerator layers can be placed on this device (n_i > 0)."""
         return bool(
-            (self.has_cuda and self.d_avail_cuda is not None)
+            (self.has_tpu and self.d_avail_tpu is not None)
+            or (self.has_cuda and self.d_avail_cuda is not None)
             or (self.has_metal and self.d_avail_metal is not None)
         )
 

@@ -139,6 +139,29 @@ def _ipm_single(A, b, c, l, u, iters: int, tol: float, reg: float):
         ap = jnp.minimum(max_step(x, dx), max_step(w, dw))
         ad = jnp.minimum(max_step(z, dz), max_step(f, df))
 
+        # Numerical safety: near degeneracy the Newton system can blow up
+        # (inf/NaN directions). A zero step keeps the iterate valid — the
+        # instance simply stalls instead of corrupting its state, and the
+        # caller's bound handling treats a stalled instance soundly. The
+        # direction vectors must be zeroed too: 0 * inf = NaN would poison
+        # the iterate through the update even with a zero step size.
+        finite = (
+            jnp.all(jnp.isfinite(dx))
+            & jnp.all(jnp.isfinite(dw))
+            & jnp.all(jnp.isfinite(dy))
+            & jnp.all(jnp.isfinite(dz))
+            & jnp.all(jnp.isfinite(df))
+            & jnp.isfinite(ap)
+            & jnp.isfinite(ad)
+        )
+        ap = jnp.where(finite, ap, 0.0)
+        ad = jnp.where(finite, ad, 0.0)
+        dx = jnp.where(finite, dx, 0.0)
+        dw = jnp.where(finite, dw, 0.0)
+        dy = jnp.where(finite, dy, 0.0)
+        dz = jnp.where(finite, dz, 0.0)
+        df = jnp.where(finite, df, 0.0)
+
         # Freeze converged instances with a select, not arithmetic masking:
         # post-convergence directions can be inf/NaN and 0*inf = NaN.
         frozen = done > 0.5
@@ -166,6 +189,10 @@ def _ipm_single(A, b, c, l, u, iters: int, tol: float, reg: float):
 
     reduced = cm - A.T @ y
     bound = b_hat @ y + jnp.sum(act * r * jnp.minimum(0.0, reduced))
+    # A non-finite dual vector carries no information: report -inf (the
+    # vacuous-but-sound bound), never NaN, so callers can prune on `bound`
+    # comparisons without a NaN silently acting like "proven bad".
+    bound = jnp.where(jnp.isfinite(bound), bound, -jnp.inf)
     shift = c @ l
     v = l + jnp.where(active, x, 0.0)
 

@@ -1,0 +1,39 @@
+"""TPU-native profiler: device microbenchmarks + analytic model profiling.
+
+Capability parity with the reference profiler package
+(/root/reference/src/distilp/profiler/), redesigned for this stack:
+
+- Model profiling is **config-driven**: per-layer FLOPs/bytes are derived
+  from the HF ``config.json`` alone via a per-architecture layout registry
+  (``archs.py``), instead of instantiating an ``mlx_lm`` module tree and
+  pattern-matching module names (reference profiler/model.py:69-781). Same
+  numbers, no macOS/Metal dependency, no network requirement.
+- Device profiling runs **JAX** microbenchmarks (jitted GEMM sweeps, HBM and
+  host-memory bandwidth probes, host<->device transfer timing) instead of
+  MLX/CuPy (reference profiler/profiler/device.py), and adds an ICI/DCN
+  topology model for inter-device communication cost (the reference has only
+  a hand-measured ``t_comm`` scalar, common/device.py:50).
+"""
+
+from .api import profile_device, profile_model
+from .analytic import (
+    parse_quantization_info,
+    profile_model_phased,
+    profile_model_split,
+    profile_moe_model,
+)
+from .datatypes import DeviceInfo
+from .hfconfig import HFConfig, load_config, load_config_from_repo
+
+__all__ = [
+    "profile_device",
+    "profile_model",
+    "profile_model_split",
+    "profile_model_phased",
+    "profile_moe_model",
+    "parse_quantization_info",
+    "DeviceInfo",
+    "HFConfig",
+    "load_config",
+    "load_config_from_repo",
+]

@@ -1,0 +1,49 @@
+"""High-level profiler API (reference /root/reference/src/distilp/profiler/api.py).
+
+Both entry points accept what the reference accepts (a HF repo id) plus
+offline-first sources: a local ``config.json`` path, a directory containing
+one, a raw config dict, or an :class:`HFConfig`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..common import DeviceProfile, ModelProfileSplit
+from .analytic import profile_model_split
+from .hfconfig import ConfigSource, load_config
+
+
+def profile_model(
+    source: ConfigSource,
+    batch_sizes: Optional[List[int]] = None,
+    sequence_length: int = 512,
+) -> ModelProfileSplit:
+    """Analytically profile a model (reference api.py:12-51).
+
+    Args:
+        source: HF repo id, config.json path/dir, config dict, or HFConfig.
+        batch_sizes: batch sizes to tabulate (default [1, 2, 4, 8]).
+        sequence_length: profiling sequence length (default 512).
+    """
+    batches = batch_sizes or [1, 2, 4, 8]
+    cfg = load_config(source)
+    return profile_model_split(
+        cfg,
+        B=batches[0],
+        L=sequence_length,
+        bs_list=batches,
+    )
+
+
+def profile_device(
+    source: ConfigSource,
+    max_batch_exp: int = 6,
+    is_head: bool = True,
+) -> DeviceProfile:
+    """Microbenchmark this host/accelerator for the given model's shapes
+    (reference api.py:54-82)."""
+    from .device import profile_device as _profile_device
+
+    cfg = load_config(source)
+    return _profile_device(cfg, max_batch_exp=max_batch_exp, is_head=is_head)

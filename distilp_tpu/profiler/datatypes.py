@@ -1,0 +1,161 @@
+"""Raw device-info schema produced by the microbenchmarks.
+
+Wire-compatible superset of the reference's ``DeviceInfo`` tree
+(/root/reference/src/distilp/profiler/datatypes.py:5-123), with two changes:
+
+- ``GPUInfo.name`` admits ``"tpu"`` — the accelerator this framework targets.
+- ``CPUFeatures`` actually has the ``AVX2``/``NEON`` fields the reference's
+  x86 probe tries to set (its schema lacks them, so the probe raises on
+  pydantic v2 — reference profiler/device.py:53,58 vs datatypes.py:16-21;
+  fixed here).
+- ``InterconnectInfo`` is new: measured/derived ICI-DCN characteristics that
+  replace the reference's hand-edited per-device ``t_comm`` scalar
+  (common/device.py:50).
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+from pydantic import BaseModel, Field
+
+
+class CPUTopology(BaseModel):
+    packages: int = 1
+    cores: int = 0
+    threads: int = 0
+
+
+class CPUClock(BaseModel):
+    base: float = 0.0  # MHz
+    max: float = 0.0  # MHz
+
+
+class CPUFeatures(BaseModel):
+    AVX: bool = False
+    AVX2: bool = False
+    FMA: bool = False
+    BF16: bool = False
+    SSE: bool = False
+    NEON: bool = False
+
+
+class CPUCache(BaseModel):
+    l1d: int = 0
+    l1i: int = 0
+    l2: int = 0
+    l3: int = 0
+
+
+class Stat(BaseModel):
+    samples: int = 0
+    min: float = 0.0
+    p50: float = 0.0
+    p95: float = 0.0
+    max: float = 0.0
+    mean: float = 0.0
+    stddev: float = 0.0
+
+
+class Batches(BaseModel):
+    b_1: float = 0.0
+    b_2: float = 0.0
+    b_4: float = 0.0
+    b_8: float = 0.0
+    b_16: float = 0.0
+    b_32: float = 0.0
+    b_64: float = 0.0
+    b_128: float = 0.0
+    b_256: float = 0.0
+    b_512: float = 0.0
+
+
+class Benchmarks(BaseModel):
+    f64: Batches = Field(default_factory=Batches)
+    f32: Batches = Field(default_factory=Batches)
+    tf32: Batches = Field(default_factory=Batches)
+    fp16: Batches = Field(default_factory=Batches)
+    bf16: Batches = Field(default_factory=Batches)
+    u32: Batches = Field(default_factory=Batches)
+    u16: Batches = Field(default_factory=Batches)
+    u8: Batches = Field(default_factory=Batches)
+    i32: Batches = Field(default_factory=Batches)
+    i16: Batches = Field(default_factory=Batches)
+    i8: Batches = Field(default_factory=Batches)
+
+
+class SystemMemory(BaseModel):
+    can_swap: int = 0
+    total: float = 0.0
+    available: float = 0.0
+    total_swap: float = 0.0
+    available_swap: float = 0.0
+    cpu_read_cold_bw: float = 0.0
+    cpu_read_warm_bw: float = 0.0
+    cpu_write_cold_bw: float = 0.0
+    cpu_write_warm_bw: float = 0.0
+    memcpy_delay: float = 0.0  # ms
+
+
+class DiskInfo(BaseModel):
+    read: float = 0.0  # bytes/s
+    write: float = 0.0  # bytes/s
+    random: float = 0.0  # bytes/s
+
+
+class CPUInfo(BaseModel):
+    vendor: str = ""
+    model: str = ""
+    arch: str = ""
+    topology: CPUTopology = Field(default_factory=CPUTopology)
+    clock: CPUClock = Field(default_factory=CPUClock)
+    cache: CPUCache = Field(default_factory=CPUCache)
+    features: CPUFeatures = Field(default_factory=CPUFeatures)
+    benchmarks: Benchmarks = Field(default_factory=Benchmarks)
+    memcpy_hot: float = 0.0
+    memcpy_cold: float = 0.0
+
+
+class GPUMemory(BaseModel):
+    name: str = ""
+    free: float = 0
+    total: float = 0
+    read_bw: float = 0.0  # host->device bytes/s
+    write_bw: float = 0.0  # device->host bytes/s
+    read_write_bw: float = 0.0
+    two_read_one_write_bw: float = 0.0
+    vram_to_compute: float = 0.0  # device-memory streaming bytes/s
+    unified_memory: bool = False
+
+
+class GPUInfo(BaseModel):
+    name: Literal["cuda", "metal", "tpu", ""] = ""
+    memory: GPUMemory = Field(default_factory=GPUMemory)
+    benchmarks: Benchmarks = Field(default_factory=Benchmarks)
+    device_kind: str = ""  # e.g. "TPU v5e"
+    num_devices: int = 0  # local devices visible to this host
+
+
+class InterconnectInfo(BaseModel):
+    """Measured/derived inter-device link characteristics (new vs reference).
+
+    On a multi-device mesh these come from timed collectives over ICI; on a
+    single-device host they stay 0 and ``t_comm`` falls back to the profile
+    scalar, exactly as the reference behaves (profiler/device.py:719).
+    """
+
+    num_devices: int = 0
+    num_slices: int = 1
+    ici_allreduce_latency_s: float = 0.0  # small-message all-reduce time
+    ici_bandwidth: float = 0.0  # bytes/s per link, large-message all-gather
+    dcn_bandwidth: float = 0.0  # bytes/s across slices (0 = unknown)
+    topology: str = ""  # e.g. "2x4" when coords are available
+
+
+class DeviceInfo(BaseModel):
+    os: str = ""  # platform.system().lower() or "" (unknown)
+    cpu: CPUInfo = Field(default_factory=CPUInfo)
+    gpu: GPUInfo = Field(default_factory=GPUInfo)
+    disk: DiskInfo = Field(default_factory=DiskInfo)
+    memory: SystemMemory = Field(default_factory=SystemMemory)
+    interconnect: InterconnectInfo = Field(default_factory=InterconnectInfo)

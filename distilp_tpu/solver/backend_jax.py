@@ -387,6 +387,10 @@ def _bnb_round(
         iters=ipm_iters,
     )
     bound = res.bound + obj_const
+    # A diverged IPM instance reports -inf (see ops/ipm.py); fall back to the
+    # inherited parent bound so the node keeps exploring instead of being
+    # NaN-pruned (observed: platform-dependent divergence on the root LP).
+    bound = jnp.where(jnp.isfinite(bound), bound, -jnp.inf)
     bound = jnp.where(state.active, jnp.maximum(bound, state.node_bound), jnp.inf)
 
     # Exact integer incumbents from every active node's LP point.

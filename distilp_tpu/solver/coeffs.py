@@ -285,7 +285,12 @@ def build_coeffs(
             ram_rhs[i] = float(d.d_avail_ram + _swap_bytes(d)) - bcio_i
             ram_minus_n[i] = True
 
-        if d.has_cuda and d.d_avail_cuda is not None:
+        # Discrete accelerator memory cap (CUDA in the reference; TPU HBM
+        # fills the same role here — separate memory, so the same row shape).
+        if d.has_tpu and d.d_avail_tpu is not None:
+            cuda_row[i] = True
+            cuda_rhs[i] = float(d.d_avail_tpu) - float(d.c_gpu)
+        elif d.has_cuda and d.d_avail_cuda is not None:
             cuda_row[i] = True
             cuda_rhs[i] = float(d.d_avail_cuda) - float(d.c_gpu)
         if d.has_metal and d.d_avail_metal is not None:

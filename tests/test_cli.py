@@ -1,0 +1,125 @@
+"""CLI tests: profiler and solver console entry points."""
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+CONFIGS = Path(__file__).resolve().parent / "configs"
+PROFILES = Path(__file__).resolve().parent / "profiles"
+
+
+def test_profiler_cli_model(tmp_path, capsys):
+    from distilp_tpu.cli.profiler_cli import main
+
+    out = tmp_path / "mp.json"
+    rc = main(
+        [
+            "model",
+            "-r",
+            str(CONFIGS / "llama31_8b_4bit.json"),
+            "-o",
+            str(out),
+            "-s",
+            "128",
+            "--batches",
+            "1,2",
+        ]
+    )
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["L"] == 32
+    assert "b_2" in data["f_q"]["decode"]
+
+
+def test_profiler_cli_device(tmp_path):
+    from distilp_tpu.cli.profiler_cli import main
+
+    knobs = {
+        "DPERF_GEMM_WARMUP": "0",
+        "DPERF_GEMM_ITERS": "1",
+        "DPERF_MEM_MB": "4",
+        "DPERF_DISK_FILE_MB": "2",
+        "DPERF_DISK_CHUNK_MB": "1",
+    }
+    old = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    try:
+        out = tmp_path / "dev.json"
+        rc = main(
+            [
+                "device",
+                "-r",
+                str(CONFIGS / "llama31_8b_4bit.json"),
+                "-o",
+                str(out),
+                "--max-batch-exp",
+                "1",
+            ]
+        )
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["scpu"]["F32"]["b_1"] > 0
+    assert data["is_head"]
+
+
+def test_solver_cli_golden_fixture(tmp_path, capsys):
+    from distilp_tpu.cli.solver_cli import main
+
+    sol = tmp_path / "solution.json"
+    rc = main(
+        [
+            "--profile",
+            str(PROFILES / "hermes_70b"),
+            "--backend",
+            "cpu",
+            "--kv-bits",
+            "4bit",
+            "--mip-gap",
+            "1e-4",
+            "--save-solution",
+            str(sol),
+        ]
+    )
+    assert rc == 0
+    payload = json.loads(sol.read_text())
+    assert payload["k"] == 40
+    assert payload["obj_value"] == pytest.approx(29.643569, abs=1e-3)
+    assert sum(payload["w"]) * payload["k"] == 80
+
+
+def test_solver_cli_k_candidates_forwarded(tmp_path):
+    # The reference parses --k-candidates but drops it (cli/solver.py:211);
+    # here it must constrain the sweep.
+    from distilp_tpu.cli.solver_cli import main
+    from distilp_tpu.common import load_from_profile_folder
+
+    sol = tmp_path / "solution.json"
+    rc = main(
+        [
+            "--profile",
+            str(PROFILES / "hermes_70b"),
+            "--k-candidates",
+            "8,10",
+            "--kv-bits",
+            "4bit",
+            "--save-solution",
+            str(sol),
+        ]
+    )
+    assert rc == 0
+    payload = json.loads(sol.read_text())
+    assert payload["k"] in (8, 10)
+
+
+def test_solver_cli_rejects_bad_folder(tmp_path):
+    from distilp_tpu.cli.solver_cli import main
+
+    assert main(["--profile", str(tmp_path / "nope")]) == 2

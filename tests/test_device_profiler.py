@@ -1,0 +1,138 @@
+"""Device profiler tests: JAX microbenchmarks -> DeviceProfile.
+
+Runs on the CPU backend with tiny benchmark sizes (DPERF_* env knobs, the
+same knob mechanism the reference exposes for its disk bench,
+reference profiler/device.py:271-389). The integration test chains
+profile-device -> profile-model -> save -> load -> solve, mirroring the
+reference's workflow test (test/test_integration.py:66-116).
+"""
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from distilp_tpu.common import ALL_QUANT_LEVELS, DeviceProfile
+
+CONFIGS = Path(__file__).resolve().parent / "configs"
+
+FAST_KNOBS = {
+    "DPERF_GEMM_WARMUP": "1",
+    "DPERF_GEMM_ITERS": "2",
+    "DPERF_MEM_MB": "8",
+    "DPERF_HBM_MB": "8",
+    "DPERF_XFER_MB": "4",
+    "DPERF_DISK_FILE_MB": "4",
+    "DPERF_DISK_CHUNK_MB": "1",
+}
+
+
+@pytest.fixture(scope="module")
+def device_profile():
+    old = {k: os.environ.get(k) for k in FAST_KNOBS}
+    os.environ.update(FAST_KNOBS)
+    try:
+        from distilp_tpu.profiler import profile_device
+
+        yield profile_device(CONFIGS / "llama31_8b_4bit.json", max_batch_exp=1)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_device_profile_validity(device_profile):
+    # Mirrors reference test_integration.py:119-137.
+    prof = device_profile
+    assert prof.os_type in ("linux", "android", "mac_metal", "mac_no_metal")
+    assert set(prof.scpu.keys()) == set(ALL_QUANT_LEVELS)
+    assert prof.scpu["F32"]["b_1"] > 0
+    # Quant synthesis factors (reference profiler/device.py:641-653).
+    f32 = prof.scpu["F32"]["b_1"]
+    assert prof.scpu["Q4_K"]["b_1"] == pytest.approx(f32 * 0.25)
+    assert prof.scpu["Q8_0"]["b_1"] == pytest.approx(f32 * 0.5)
+    assert prof.T_cpu > 0
+    assert prof.t_kvcpy_cpu > 0
+    assert prof.d_avail_ram > 0
+    assert prof.s_disk > 0
+    # On the virtual 8-device mesh t_comm is *measured* (ICI all-reduce
+    # latency) — an upgrade over the reference's hard-coded 0
+    # (reference profiler/device.py:719).
+    assert prof.t_comm >= 0.0
+
+
+def test_device_profile_json_roundtrip(device_profile, tmp_path):
+    path = tmp_path / "device.json"
+    path.write_text(device_profile.model_dump_json())
+    loaded = DeviceProfile.model_validate_json(path.read_text())
+    assert loaded == device_profile
+
+
+def test_device_info_schema_roundtrip():
+    from distilp_tpu.profiler import DeviceInfo
+
+    di = DeviceInfo()
+    di.cpu.benchmarks.f32.b_1 = 1e9
+    di.gpu.name = "tpu"
+    blob = di.model_dump_json()
+    back = DeviceInfo.model_validate_json(blob)
+    assert back.gpu.name == "tpu"
+    assert back.cpu.benchmarks.f32.b_1 == 1e9
+
+
+def test_interconnect_measurement_virtual_mesh():
+    # The 8-device virtual CPU mesh (conftest) stands in for an ICI mesh.
+    from distilp_tpu.profiler.topology import measure_interconnect
+
+    info = measure_interconnect(latency_iters=3, bandwidth_mb=1)
+    assert info.num_devices == 8
+    assert info.ici_allreduce_latency_s > 0
+    assert info.ici_bandwidth > 0
+
+
+def test_estimate_t_comm_positive_on_mesh():
+    from distilp_tpu.profiler.topology import estimate_t_comm
+
+    t = estimate_t_comm(payload_bytes=1024)
+    assert t > 0
+
+
+def test_profile_and_solve_workflow(device_profile, tmp_path):
+    # Mirrors reference test_integration.py:66-116: profile -> save ->
+    # load-from-folder -> solve, with the same device duplicated into a
+    # 2-device cluster.
+    from distilp_tpu.profiler import profile_model
+    from distilp_tpu.common import load_from_profile_folder
+    from distilp_tpu.solver import halda_solve
+
+    model_split = profile_model(
+        CONFIGS / "llama31_8b_4bit.json", batch_sizes=[1], sequence_length=128
+    )
+
+    folder = tmp_path / "cluster"
+    folder.mkdir()
+    (folder / "model_profile.json").write_text(model_split.model_dump_json())
+    head = device_profile.model_copy(deep=True)
+    head.is_head = True
+    second = device_profile.model_copy(deep=True)
+    second.is_head = False
+    second.name = "m2"
+    (folder / "m1.json").write_text(head.model_dump_json())
+    (folder / "m2.json").write_text(second.model_dump_json())
+
+    devices, model = load_from_profile_folder(folder)
+    assert len(devices) == 2
+    assert devices[0].is_head
+
+    result = halda_solve(devices, model, kv_bits="4bit", backend="cpu")
+    assert sum(result.w) * result.k == model.L
+    # Note: obj_value can be negative on a high-RAM host — kappa subtracts
+    # the RAM headroom over s_disk (reference dense_common.py:211-230), and
+    # the golden fixtures only stay positive because their devices have tiny
+    # RAM. Finiteness + feasibility is the invariant.
+    import math
+
+    assert math.isfinite(result.obj_value)

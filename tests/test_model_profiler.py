@@ -1,0 +1,199 @@
+"""Analytic model-profiler conformance tests.
+
+Golden values are the reference's own pinned regression numbers
+(/root/reference/test/test_models.py:54-121), reproduced here from local
+config fixtures (tests/configs/) instead of HF Hub downloads — no network.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from distilp_tpu.common import ModelProfileSplit
+from distilp_tpu.profiler import (
+    load_config,
+    parse_quantization_info,
+    profile_model,
+    profile_model_split,
+)
+
+CONFIGS = Path(__file__).resolve().parent / "configs"
+
+BATCHES = [1, 2, 4]
+SEQ_LEN = 128
+
+ALL_CONFIGS = sorted(p.name for p in CONFIGS.glob("*.json"))
+
+
+def _split(name: str) -> ModelProfileSplit:
+    return profile_model_split(
+        load_config(CONFIGS / name), B=BATCHES[0], L=SEQ_LEN, bs_list=BATCHES
+    )
+
+
+@pytest.mark.parametrize("name", ALL_CONFIGS)
+def test_profile_all_models_sanity(name):
+    # Mirrors reference test/test_models.py:29-51.
+    data = _split(name)
+    assert data.L > 0
+    assert data.V > 0
+    assert data.e_embed > 0
+    assert data.ek > 0
+    assert data.ev > 0
+    assert data.b[1] > 0
+    assert data.b_i[1] > 0
+    assert data.f_q["decode"]["b_1"][1] > 0
+    assert data.quantization in ["Q4_K", "Q5_K", "Q6_K", "Q8_0", "F16", "BF16", "F32"]
+    assert len(data.b) == data.L + 1
+    assert data.b[0] == 0  # synthetic index-0 row
+
+
+def test_profile_qwen3_32b_6bit_golden():
+    # Reference test/test_models.py:54-65.
+    data = _split("qwen3_32b_6bit.json")
+    assert data.L == 64
+    assert data.V == 151936
+    assert data.e_embed == 5120
+    assert data.ek == 128
+    assert data.ev == 128
+    assert data.b[3] == 346214400.0
+    assert data.b_i[3] == 1310720.0
+    assert data.f_q["decode"]["b_1"][3] == 907018240.0
+    assert data.quantization == "Q6_K"
+
+
+def test_profile_llama_70b_4bit_golden():
+    # Reference test/test_models.py:68-79.
+    data = _split("llama3_70b_4bit.json")
+    assert data.L == 80
+    assert data.V == 128256
+    assert data.e_embed == 8192
+    assert data.ek == 128
+    assert data.ev == 128
+    assert data.b[3] == 454557696.0
+    assert data.b_i[3] == 2097152.0
+    assert data.f_q["decode"]["b_1"][3] == 1715470336.0
+    assert data.quantization == "Q4_K"
+
+
+def test_profile_qwen3_32b_bf16_golden():
+    # Reference test/test_models.py:96-107.
+    data = _split("qwen3_32b_bf16.json")
+    assert data.b[3] == 904396800
+    assert data.b_i[3] == 1310720
+    assert data.f_q["decode"]["b_1"][3] == 907018240.0
+    assert data.quantization == "BF16"
+
+
+def test_profile_qwen3_14b_8bit_golden():
+    # Reference test/test_models.py:110-121.
+    data = _split("qwen3_14b_8bit.json")
+    assert data.L == 40
+    assert data.b[3] == 335462400.0
+    assert data.b_i[3] == 1310720.0
+    assert data.f_q["decode"]["b_1"][3] == 663224320.0
+    assert data.quantization == "Q8_0"
+
+
+def test_phase_flops_relationship():
+    # prefill >= decode per layer; merged = prefill + decode tokens.
+    cfg = load_config(CONFIGS / "llama31_8b_4bit.json")
+    split = profile_model_split(cfg, B=1, L=SEQ_LEN, bs_list=[1])
+    pre = split.f_q["prefill"]["b_1"][1]
+    dec = split.f_q["decode"]["b_1"][1]
+    assert pre > dec > 0
+
+
+def test_batch_scaling_decode():
+    # Decode FLOPs scale ~linearly with batch (token count is B).
+    data = _split("llama31_8b_4bit.json")
+    f1 = data.f_q["decode"]["b_1"][1]
+    f4 = data.f_q["decode"]["b_4"][1]
+    # attention core scales with B too; projections dominate => ~4x
+    assert 3.5 < f4 / f1 < 4.5
+
+
+def test_moe_component_metrics_qwen3_30b():
+    data = _split("qwen3_30b_a3b_8bit.json")
+    assert data.is_moe
+    assert data.n_routed_experts == 128
+    assert data.experts_per_token == 8
+    assert data.moe_intermediate_size == 768
+    assert data.total_moe_layers == 48
+    assert data.moe_layer_indices == list(range(1, 49))
+    assert len(data.attn_bytes) == 48
+    for idx in data.moe_layer_indices:
+        assert data.bytes_per_expert[idx] > 0
+        assert data.flops_per_expert[idx] > 0
+        assert data.router_bytes[idx] > 0
+        assert data.router_flops[idx] > 0
+        assert data.flops_per_active_expert_per_token[idx] > 0
+    # Routed expert bytes: E * 3 projections dominate layer weight bytes.
+    assert data.bytes_per_expert[1] * 128 < data.b[1]
+
+
+def test_moe_deepseek_v3_structure():
+    data = _split("deepseek_v3.json")
+    assert data.is_moe
+    assert data.n_routed_experts == 256
+    assert data.n_shared_experts == 1
+    assert data.first_k_dense_replace == 3
+    # Dense-replaced layers carry no shared-expert cost; later layers do.
+    assert data.bytes_shared_experts[1] == 0
+    assert data.bytes_shared_experts[4] > 0
+    assert data.flops_shared_experts[4] > 0
+    # MLA attention bytes are far below a GQA-equivalent H*H*4 layout.
+    assert 0 < data.attn_bytes[0] < 7168 * 7168 * 4
+
+
+def test_moe_router_bytes_not_in_layer_bytes():
+    # Reference parity: router weights are tracked separately and not added
+    # to b (reference profiler/model.py:176-192).
+    data = _split("qwen3_30b_a3b_8bit.json")
+    cfg = load_config(CONFIGS / "qwen3_30b_a3b_8bit.json")
+    expert_total = data.bytes_per_expert[1] * data.n_routed_experts
+    assert data.b[1] == data.attn_bytes[0] + expert_total
+
+
+def test_gpt_oss_mxfp4_quant_parsing():
+    cfg = load_config(CONFIGS / "gpt_oss_20b_mxfp4.json")
+    q = parse_quantization_info(cfg)
+    assert q.bits == 4
+    assert q.group_size == 128
+    assert q.label == "Q4_K"
+    assert "model.layers.*.self_attn" in q.exclude_patterns
+    data = _split("gpt_oss_20b_mxfp4.json")
+    # Attention is excluded from quantization -> stored at fp16.
+    H = 2880
+    head_size = H // 64
+    kv_out = 8 * head_size
+    expected_attn = (H * H * 2) + (H * kv_out * 2) + (H * kv_out * 2) + (H * H * 2)
+    assert data.attn_bytes[0] == expected_attn
+
+
+def test_split_roundtrip_and_scalar_extraction(tmp_path):
+    data = _split("qwen3_32b_6bit.json")
+    path = tmp_path / "model_profile.json"
+    path.write_text(data.model_dump_json())
+    loaded = ModelProfileSplit.model_validate_json(path.read_text())
+    assert loaded == data
+    scalar = loaded.to_model_profile("decode")
+    assert scalar.b_layer == data.b[1]
+    assert scalar.f_q["b_1"] == data.f_q["decode"]["b_1"][1]
+    assert scalar.L == data.L
+
+
+def test_profile_model_api_accepts_dict_and_path():
+    import json
+
+    raw = json.loads((CONFIGS / "llama31_8b_4bit.json").read_text())
+    from_dict = profile_model(raw, batch_sizes=[1], sequence_length=64)
+    from_path = profile_model(CONFIGS / "llama31_8b_4bit.json", batch_sizes=[1], sequence_length=64)
+    assert from_dict == from_path
+
+
+def test_unknown_model_type_rejected():
+    with pytest.raises(ValueError, match="model_type"):
+        load_config({"hidden_size": 8})
+    with pytest.raises(ValueError, match="Unsupported"):
+        load_config({"model_type": "not_a_real_arch"})
