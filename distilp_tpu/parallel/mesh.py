@@ -72,21 +72,23 @@ def solve_sweep_sharded(
     coeffs,
     mesh: Mesh,
     mip_gap: float = 1e-3,
-    ipm_iters: int = 50,
-    max_rounds: int = 64,
+    ipm_iters: int = 26,
+    max_rounds: int = 48,
 ):
-    """Run the batched B&B sweep with the frontier sharded across ``mesh``.
+    """Run the fused B&B sweep with the frontier sharded across ``mesh``.
 
-    Same algorithm as ``solver.backend_jax.solve_sweep_jax``; the only
-    difference is input placement — the jitted round function is reused
-    verbatim and GSPMD does the partitioning.
+    Same single-dispatch program as ``solver.backend_jax.solve_sweep_jax``;
+    the only difference is input placement — the frontier arrays enter
+    node-sharded and GSPMD partitions the batched IPM along the node axis,
+    turning the incumbent/compaction reductions into ICI collectives.
     """
     import jax.numpy as jnp
 
     from ..solver.backend_jax import (
-        DTYPE,
-        _bnb_round,
+        BDTYPE,
+        NODE_CAP,
         _init_state,
+        _solve_fused,
         _sweep_data,
         build_standard_form,
         rounding_data,
@@ -99,9 +101,7 @@ def solve_sweep_sharded(
 
     sf = build_standard_form(arrays, coeffs, feasible)
     data = _sweep_data(sf, rounding_data(coeffs))
-    gap = jnp.asarray(mip_gap, DTYPE)
-
-    from ..solver.backend_jax import NODE_CAP
+    gap = jnp.asarray(mip_gap, BDTYPE)
 
     state = _init_state(sf, cap=pad_cap_to_mesh(max(NODE_CAP, 2 * len(sf.ks)), mesh))
     state = shard_state(state, mesh)
@@ -109,16 +109,7 @@ def solve_sweep_sharded(
     data = jax.tree.map(lambda x: jax.device_put(x, replicated), data)
 
     with mesh:
-        for _ in range(max_rounds):
-            state = _bnb_round(data, state, gap, ipm_iters=ipm_iters)
-            incumbent = float(state.incumbent)
-            live = int(np.asarray(state.active).sum())
-            bounds = np.asarray(jnp.where(state.active, state.node_bound, jnp.inf))
-            best_bound = min(float(bounds.min()), float(state.dropped_bound))
-            if live == 0:
-                break
-            if np.isfinite(incumbent) and (
-                incumbent - best_bound <= mip_gap * abs(incumbent)
-            ):
-                break
+        state = _solve_fused(
+            data, state, gap, ipm_iters=ipm_iters, max_rounds=max_rounds
+        )
     return state, sf

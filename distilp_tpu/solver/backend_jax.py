@@ -1,9 +1,9 @@
-"""JAX/TPU backend: the whole HALDA k-sweep as one batched computation.
+"""JAX/TPU backend: the whole HALDA k-sweep as one fused device program.
 
 Where the reference hands each fixed-k MILP to HiGHS branch-and-cut on the
 host (/root/reference/src/distilp/solver/halda_p_solver.py:340-346, one
-sequential call per k), this backend turns the *entire sweep* into accelerator
-work:
+sequential call per k), this backend turns the *entire sweep* into a single
+accelerator dispatch:
 
 - every k-candidate's LP relaxation and every branch-and-bound node is one
   element of a single batched Mehrotra IPM call (``distilp_tpu.ops.ipm``);
@@ -12,10 +12,20 @@ work:
 - pruning uses the kernel's rigorous Lagrangian bounds, so the mip-gap
   certificate does not depend on IPM convergence;
 - one global incumbent prunes across all k trees simultaneously (the final
-  answer is the min over k, so cross-k pruning is sound).
+  answer is the min over k, so cross-k pruning is sound);
+- the branch-and-bound *loop itself* runs on the device as a
+  ``lax.while_loop`` with an on-device gap test — the host dispatches once
+  and fetches the final state once. No per-round host round-trips: on a
+  remote-tunnel TPU a host sync costs ~1000x the compute of a round.
+
+Precision: search arrays and IPM iterations are float32 (TPU-native; float64
+is software-emulated and ~40x slower), while everything the mip-gap
+certificate touches — Lagrangian bounds, incumbent objectives, pruning
+thresholds — is evaluated in float64. The bound is valid for ANY dual vector,
+so float32 iterates cost tightness, never soundness.
 
 The search state lives in fixed-capacity arrays (no data-dependent shapes);
-the host loop only inspects two scalars per round (gap, live-node count).
+frontier overflow is tracked honestly via ``dropped_bound``.
 """
 
 from __future__ import annotations
@@ -28,8 +38,8 @@ import numpy as np
 
 import jax
 
-# The gap certificate needs ~1e-9 LP accuracy; f32 tops out around 1e-4.
-# On TPU f64 is emulated but these problems are tiny.
+# Certificates (bounds, incumbents, thresholds) are float64; the search and
+# IPM iterations are float32. x64 must be enabled for the f64 half.
 jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
@@ -39,18 +49,24 @@ from .assemble import INACTIVE_RHS, MilpArrays  # noqa: E402
 from .coeffs import HaldaCoeffs  # noqa: E402
 from .result import ILPResult  # noqa: E402
 
-DTYPE = jnp.float64
+DTYPE = jnp.float32  # search arrays + IPM iteration dtype
+BDTYPE = jnp.float64  # certificate dtype
 
 # Fixed frontier capacity. HALDA trees are shallow (the LP optimum is
 # near-integral), so this is generous; overflow is tracked honestly via
 # ``dropped_bound`` rather than silently ignored.
-NODE_CAP = 128
-MAX_ROUNDS = 64
-FRAC_TOL = 1e-6
+NODE_CAP = 64
+MAX_ROUNDS = 48
+IPM_ITERS = 26
+FRAC_TOL = 1e-4
 
 
 class RoundingData(NamedTuple):
-    """Exact per-device MILP data for the integer rounding heuristic."""
+    """Exact per-device MILP data for the integer rounding heuristic.
+
+    Held in float64: the incumbent objective must be exact so the mip-gap
+    certificate means what it says.
+    """
 
     a: jax.Array  # (M,)
     b_gpu: jax.Array
@@ -64,6 +80,35 @@ class RoundingData(NamedTuple):
     metal_rhs: jax.Array  # +inf when row inactive
     has_gpu: jax.Array  # float 0/1
     bprime: jax.Array  # scalar
+
+
+def _rounding_arrays_np(coeffs: HaldaCoeffs) -> dict:
+    """Host-side (numpy) rounding-heuristic arrays; no device traffic."""
+    pen_by_set = np.where(
+        coeffs.set_id == 1,
+        coeffs.pen_m1,
+        np.where(coeffs.set_id == 2, coeffs.pen_m2, coeffs.pen_m3),
+    )
+    return dict(
+        a=np.asarray(coeffs.a, np.float64),
+        b_gpu=np.asarray(coeffs.b_gpu, np.float64),
+        pen_set=np.asarray(pen_by_set, np.float64),
+        pen_vram=np.asarray(coeffs.pen_vram, np.float64),
+        busy_const=np.asarray(coeffs.busy_const, np.float64),
+        s_disk=np.asarray(coeffs.s_disk, np.float64),
+        ram_rhs=np.where(np.isfinite(coeffs.ram_rhs), coeffs.ram_rhs, INACTIVE_RHS),
+        ram_minus_n=coeffs.ram_minus_n.astype(np.float64),
+        cuda_rhs=np.where(coeffs.cuda_row, coeffs.cuda_rhs, np.inf),
+        metal_rhs=np.where(coeffs.metal_row, coeffs.metal_rhs, np.inf),
+        has_gpu=coeffs.has_gpu.astype(np.float64),
+        bprime=np.float64(coeffs.bprime),
+    )
+
+
+def rounding_data(coeffs: HaldaCoeffs) -> RoundingData:
+    return RoundingData(
+        **{k: jnp.asarray(v, BDTYPE) for k, v in _rounding_arrays_np(coeffs).items()}
+    )
 
 
 @dataclass
@@ -86,8 +131,8 @@ class StandardForm:
     obj_const: float
 
 
-def _root_boxes(arrays: MilpArrays, coeffs_like: RoundingData, W: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Finite boxes for every variable at one k.
+def _root_boxes(arrays: MilpArrays, rd: dict, W: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Finite boxes for every variable at one k (pure numpy).
 
     z and C are nominally free above, but any *optimal* solution satisfies
     z_i <= F_i^max and C <= max_i(B_i^max + F_i^max), so these bounds are
@@ -97,22 +142,13 @@ def _root_boxes(arrays: MilpArrays, coeffs_like: RoundingData, W: int) -> Tuple[
     M = arrays.layout.M
     lo, hi = arrays.bounds_for_k(W)
 
-    a = np.asarray(coeffs_like.a)
-    b_gpu = np.asarray(coeffs_like.b_gpu)
-    pen_set = np.asarray(coeffs_like.pen_set)
-    pen_vram = np.asarray(coeffs_like.pen_vram)
-    busy_const = np.asarray(coeffs_like.busy_const)
-    s_disk = np.asarray(coeffs_like.s_disk)
-    has_gpu = np.asarray(coeffs_like.has_gpu)
-    bp = float(coeffs_like.bprime)
-
-    F_max = W * bp / s_disk
+    F_max = W * rd["bprime"] / rd["s_disk"]
     B_max = (
-        a * W
-        + np.maximum(b_gpu, 0.0) * W
-        + pen_set * W
-        + pen_vram * W * has_gpu
-        + busy_const
+        rd["a"] * W
+        + np.maximum(rd["b_gpu"], 0.0) * W
+        + rd["pen_set"] * W
+        + rd["pen_vram"] * W * rd["has_gpu"]
+        + rd["busy_const"]
     )
     z_ub = F_max
     C_ub = float(np.max(B_max + F_max)) if M else 1.0
@@ -126,14 +162,15 @@ def _root_boxes(arrays: MilpArrays, coeffs_like: RoundingData, W: int) -> Tuple[
 def build_standard_form(
     arrays: MilpArrays, coeffs: HaldaCoeffs, kWs: Sequence[Tuple[int, int]]
 ) -> StandardForm:
-    """Row-scale the MILP and emit the per-k (b, c, box) family."""
+    """Row-scale the MILP and emit the per-k (b, c, box) family. Pure numpy —
+    no device traffic until ``_sweep_data`` uploads the result once."""
     M = arrays.layout.M
     N = arrays.layout.n_vars
     m_ub = arrays.A_ub.shape[0]
     nf = N + m_ub
     m = m_ub + 1
 
-    rdata = rounding_data(coeffs)
+    rd = _rounding_arrays_np(coeffs)
 
     # Row scaling: each inequality row (incl. its huge inactive RHS) is
     # normalized by its own magnitude; the slack column keeps coefficient 1
@@ -158,7 +195,7 @@ def build_standard_form(
         b_k[j, m_ub] = float(W)
         c_k[j, :N] = arrays.c_for_k(k)
 
-        lo_s, hi_s = _root_boxes(arrays, rdata, W)
+        lo_s, hi_s = _root_boxes(arrays, rd, W)
         lo_k[j, :N] = lo_s
         hi_k[j, :N] = hi_s
         # Slack boxes: s_row = b_row - min_v(A_row v) over the structural box.
@@ -183,44 +220,18 @@ def build_standard_form(
     )
 
 
-def rounding_data(coeffs: HaldaCoeffs) -> RoundingData:
-    pen_by_set = np.where(
-        coeffs.set_id == 1,
-        coeffs.pen_m1,
-        np.where(coeffs.set_id == 2, coeffs.pen_m2, coeffs.pen_m3),
-    )
-    return RoundingData(
-        a=jnp.asarray(coeffs.a, DTYPE),
-        b_gpu=jnp.asarray(coeffs.b_gpu, DTYPE),
-        pen_set=jnp.asarray(pen_by_set, DTYPE),
-        pen_vram=jnp.asarray(coeffs.pen_vram, DTYPE),
-        busy_const=jnp.asarray(coeffs.busy_const, DTYPE),
-        s_disk=jnp.asarray(coeffs.s_disk, DTYPE),
-        ram_rhs=jnp.asarray(
-            np.where(np.isfinite(coeffs.ram_rhs), coeffs.ram_rhs, INACTIVE_RHS), DTYPE
-        ),
-        ram_minus_n=jnp.asarray(coeffs.ram_minus_n.astype(float), DTYPE),
-        cuda_rhs=jnp.asarray(
-            np.where(coeffs.cuda_row, coeffs.cuda_rhs, np.inf), DTYPE
-        ),
-        metal_rhs=jnp.asarray(
-            np.where(coeffs.metal_row, coeffs.metal_rhs, np.inf), DTYPE
-        ),
-        has_gpu=jnp.asarray(coeffs.has_gpu.astype(float), DTYPE),
-        bprime=jnp.asarray(coeffs.bprime, DTYPE),
-    )
-
-
 def _round_to_incumbent(v, M, W, k, rd: RoundingData):
     """Exact MILP objective of the best integer point near the LP solution v.
 
     Given integer (w, n), the minimal feasible slacks are closed-form, and the
     optimal continuous block is z_i = max(0, B_i + F_i - C), C = max_i(B_i +
-    F_i/2); so the heuristic's objective is exact, not an LP approximation.
+    F_i/2); so the heuristic's objective is exact (float64), not an LP
+    approximation.
 
     Returns (obj_linear, w, n) with obj = +inf when rounding failed.
     """
-    Wf = jnp.asarray(W, DTYPE)
+    Wf = W.astype(BDTYPE)
+    v = v.astype(BDTYPE)
     w_frac = v[:M]
     n_frac = v[M : 2 * M]
 
@@ -271,41 +282,41 @@ def _round_to_incumbent(v, M, W, k, rd: RoundingData):
     fetch = bp / rd.s_disk * w
     C = jnp.max(busy + 0.5 * fetch)
 
-    k_f = jnp.asarray(k, DTYPE)
+    k_f = k.astype(BDTYPE)
     obj = (k_f - 1.0) * C + jnp.sum(rd.a * w + rd.b_gpu * n + pen_cost)
     obj = jnp.where(valid, obj, jnp.inf)
     return obj, w, n
 
 
 class SearchState(NamedTuple):
-    node_lo: jax.Array  # (CAP, nf)
-    node_hi: jax.Array  # (CAP, nf)
+    node_lo: jax.Array  # (CAP, nf) float32
+    node_hi: jax.Array  # (CAP, nf) float32
     node_kidx: jax.Array  # (CAP,) int32
-    node_bound: jax.Array  # (CAP,) parent bound (full-objective space)
+    node_bound: jax.Array  # (CAP,) float64 parent bound (full-objective space)
     active: jax.Array  # (CAP,) bool
-    incumbent: jax.Array  # () full-objective incumbent
-    inc_w: jax.Array  # (M,)
-    inc_n: jax.Array  # (M,)
+    incumbent: jax.Array  # () float64 full-objective incumbent
+    inc_w: jax.Array  # (M,) float64
+    inc_n: jax.Array  # (M,) float64
     inc_kidx: jax.Array  # () int32
-    dropped_bound: jax.Array  # () min bound among nodes dropped on overflow
-    per_k_best: jax.Array  # (n_k,) best incumbent per k (reporting only)
+    dropped_bound: jax.Array  # () float64 min bound among overflow-dropped nodes
+    per_k_best: jax.Array  # (n_k,) float64 best incumbent per k (reporting only)
 
 
 class SweepData(NamedTuple):
     """Device-resident arrays of one sweep, shared by every B&B round.
 
-    A plain pytree argument (not a closure) so the jitted round function is a
-    single module-level callable whose compile cache is reused across
+    A plain pytree argument (not a closure) so the jitted solve is a single
+    module-level callable whose compile cache is reused across
     ``halda_solve`` calls of the same shape.
     """
 
-    A: jax.Array  # (m, nf)
-    b_k: jax.Array  # (n_k, m)
-    c_k: jax.Array  # (n_k, nf)
+    A: jax.Array  # (m, nf) float32
+    b_k: jax.Array  # (n_k, m) float32
+    c_k: jax.Array  # (n_k, nf) float32
     int_mask: jax.Array  # (nf,) bool
-    ks: jax.Array  # (n_k,)
-    Ws: jax.Array  # (n_k,)
-    obj_const: jax.Array  # ()
+    ks: jax.Array  # (n_k,) float64
+    Ws: jax.Array  # (n_k,) float64
+    obj_const: jax.Array  # () float64
     rd: RoundingData
 
 
@@ -315,9 +326,9 @@ def _sweep_data(sf: StandardForm, rd: RoundingData) -> SweepData:
         b_k=jnp.asarray(sf.b_k, DTYPE),
         c_k=jnp.asarray(sf.c_k, DTYPE),
         int_mask=jnp.asarray(sf.int_mask),
-        ks=jnp.asarray(sf.ks, DTYPE),
-        Ws=jnp.asarray(sf.Ws, DTYPE),
-        obj_const=jnp.asarray(sf.obj_const, DTYPE),
+        ks=jnp.asarray(sf.ks, BDTYPE),
+        Ws=jnp.asarray(sf.Ws, BDTYPE),
+        obj_const=jnp.asarray(sf.obj_const, BDTYPE),
         rd=rd,
     )
 
@@ -341,44 +352,28 @@ def _init_state(sf: StandardForm, cap: Optional[int] = None) -> SearchState:
         node_lo=node_lo,
         node_hi=node_hi,
         node_kidx=node_kidx,
-        node_bound=jnp.full(cap, -jnp.inf, DTYPE),
+        node_bound=jnp.full(cap, -jnp.inf, BDTYPE),
         active=active,
-        incumbent=jnp.asarray(jnp.inf, DTYPE),
-        inc_w=jnp.zeros(sf.M, DTYPE),
-        inc_n=jnp.zeros(sf.M, DTYPE),
+        incumbent=jnp.asarray(jnp.inf, BDTYPE),
+        inc_w=jnp.zeros(sf.M, BDTYPE),
+        inc_n=jnp.zeros(sf.M, BDTYPE),
         inc_kidx=jnp.asarray(0, jnp.int32),
-        dropped_bound=jnp.asarray(jnp.inf, DTYPE),
-        per_k_best=jnp.full(n_k, jnp.inf, DTYPE),
+        dropped_bound=jnp.asarray(jnp.inf, BDTYPE),
+        per_k_best=jnp.full(n_k, jnp.inf, BDTYPE),
     )
 
 
-@partial(jax.jit, static_argnames=("ipm_iters", "tier"))
 def _bnb_round(
     data: SweepData,
     state: SearchState,
-    mip_gap: jax.Array,
-    ipm_iters: int = 50,
-    tier: Optional[int] = None,
+    mip_gap,
+    ipm_iters: int = IPM_ITERS,
 ) -> SearchState:
-    """One batched branch-and-bound round over the frontier.
-
-    ``tier`` solves only the first ``tier`` slots — valid because compaction
-    sorts live nodes to the front — so small trees don't pay for the full
-    frontier capacity. The host picks the smallest tier >= live count.
-    """
+    """One batched branch-and-bound round over the frontier (pure function;
+    traced inside the fused solve loop or jitted standalone by callers)."""
     A, int_mask, ks, Ws, rd = data.A, data.int_mask, data.ks, data.Ws, data.rd
     obj_const = data.obj_const
     M = state.inc_w.shape[0]
-
-    full = state
-    if tier is not None and tier < state.node_lo.shape[0]:
-        state = state._replace(
-            node_lo=state.node_lo[:tier],
-            node_hi=state.node_hi[:tier],
-            node_kidx=state.node_kidx[:tier],
-            node_bound=state.node_bound[:tier],
-            active=state.active[:tier],
-        )
 
     b = data.b_k[state.node_kidx]
     c = data.c_k[state.node_kidx]
@@ -429,9 +424,7 @@ def _bnb_round(
     # node's lower bound (so nothing better hides in the subtree). An
     # integral-*looking* LP point alone is NOT proof — the IPM may not
     # have converged — so such nodes keep splitting on the widest box.
-    width = jnp.where(
-        int_mask[None, :], state.node_hi - state.node_lo, 0.0
-    )
+    width = jnp.where(int_mask[None, :], state.node_hi - state.node_lo, 0.0)
     fully_fixed = jnp.max(width, axis=1) < 0.5
     achieved = obj_full <= bound + 1e-6 * jnp.maximum(1.0, jnp.abs(bound))
     survive &= ~(fully_fixed | achieved)
@@ -459,21 +452,17 @@ def _bnb_round(
     hi_a = state.node_hi.at[rows, j_star].set(dn)
     lo_b = state.node_lo.at[rows, j_star].set(up)
 
-    # Children of the solved prefix plus the untouched tail of the frontier.
-    child_lo = jnp.concatenate([state.node_lo, lo_b, full.node_lo[cap:]], axis=0)
-    child_hi = jnp.concatenate([hi_a, state.node_hi, full.node_hi[cap:]], axis=0)
-    child_kidx = jnp.concatenate(
-        [state.node_kidx, state.node_kidx, full.node_kidx[cap:]]
-    )
-    child_bound = jnp.concatenate([bound, bound, full.node_bound[cap:]])
-    child_active = jnp.concatenate([survive, survive, full.active[cap:]])
+    child_lo = jnp.concatenate([state.node_lo, lo_b], axis=0)
+    child_hi = jnp.concatenate([hi_a, state.node_hi], axis=0)
+    child_kidx = jnp.concatenate([state.node_kidx, state.node_kidx])
+    child_bound = jnp.concatenate([bound, bound])
+    child_active = jnp.concatenate([survive, survive])
 
     # Compact best-bound-first back into the full capacity; track what falls off.
-    full_cap = full.node_lo.shape[0]
     sort_key = jnp.where(child_active, child_bound, jnp.inf)
     order = jnp.argsort(sort_key)
-    keep = order[:full_cap]
-    spill = order[full_cap:]
+    keep = order[:cap]
+    spill = order[cap:]
     spill_bound = jnp.min(jnp.where(child_active[spill], child_bound[spill], jnp.inf))
     dropped_bound = jnp.minimum(state.dropped_bound, spill_bound)
 
@@ -492,13 +481,54 @@ def _bnb_round(
     )
 
 
+def _best_bound(state: SearchState) -> jax.Array:
+    live = jnp.min(jnp.where(state.active, state.node_bound, jnp.inf))
+    return jnp.minimum(live, state.dropped_bound)
+
+
+def _certified(state: SearchState, mip_gap) -> jax.Array:
+    inc = state.incumbent
+    return jnp.isfinite(inc) & (inc - _best_bound(state) <= mip_gap * jnp.abs(inc))
+
+
+@partial(jax.jit, static_argnames=("ipm_iters", "max_rounds"))
+def _solve_fused(
+    data: SweepData,
+    state: SearchState,
+    mip_gap: jax.Array,
+    ipm_iters: int = IPM_ITERS,
+    max_rounds: int = MAX_ROUNDS,
+) -> SearchState:
+    """The full branch-and-bound sweep as one device program.
+
+    ``lax.while_loop`` over B&B rounds with the mip-gap test on-device;
+    returns the final state. The host does one dispatch and one fetch per
+    HALDA solve.
+    """
+
+    def cond(carry):
+        state, i = carry
+        return (
+            (i < max_rounds)
+            & jnp.any(state.active)
+            & ~_certified(state, mip_gap)
+        )
+
+    def body(carry):
+        state, i = carry
+        return _bnb_round(data, state, mip_gap, ipm_iters=ipm_iters), i + 1
+
+    state, _ = jax.lax.while_loop(cond, body, (state, jnp.asarray(0, jnp.int32)))
+    return state
+
 
 def solve_sweep_jax(
     arrays: MilpArrays,
     kWs: Sequence[Tuple[int, int]],
     mip_gap: float = 1e-4,
     coeffs: Optional[HaldaCoeffs] = None,
-    ipm_iters: int = 50,
+    ipm_iters: int = IPM_ITERS,
+    max_rounds: int = MAX_ROUNDS,
     debug: bool = False,
 ) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
     """Solve the whole k-sweep on the accelerator.
@@ -520,34 +550,19 @@ def solve_sweep_jax(
 
     sf = build_standard_form(arrays, coeffs, feasible)
     data = _sweep_data(sf, rounding_data(coeffs))
-    gap = jnp.asarray(mip_gap, DTYPE)
-
     state = _init_state(sf)
-    cap = int(state.node_lo.shape[0])
-    tiers = sorted({t for t in (16, 64, cap) if t <= cap})
-    live = len(feasible)
-    for _ in range(MAX_ROUNDS):
-        tier = next((t for t in tiers if t >= live), cap)
-        state = _bnb_round(data, state, gap, ipm_iters=ipm_iters, tier=tier)
-        incumbent = float(state.incumbent)
-        live_bounds = np.asarray(
-            jnp.where(state.active, state.node_bound, jnp.inf)
-        )
-        best_bound = min(float(live_bounds.min()), float(state.dropped_bound))
-        live = int(np.asarray(state.active).sum())
-        if debug:
-            print(
-                f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f} "
-                f"live={live} tier={tier}"
-            )
-        if live == 0:
-            break
-        if np.isfinite(incumbent) and (
-            incumbent - best_bound <= mip_gap * abs(incumbent)
-        ):
-            break
+    gap = jnp.asarray(mip_gap, BDTYPE)
 
-    if not np.isfinite(float(state.incumbent)):
+    state = _solve_fused(data, state, gap, ipm_iters=ipm_iters, max_rounds=max_rounds)
+
+    incumbent = float(state.incumbent)
+    if debug:
+        print(
+            f"    [jax] incumbent={incumbent:.6f} "
+            f"bound={float(_best_bound(state)):.6f} "
+            f"live={int(np.asarray(state.active).sum())}"
+        )
+    if not np.isfinite(incumbent):
         return results, None
 
     per_k_best = np.asarray(state.per_k_best)
