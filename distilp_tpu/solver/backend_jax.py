@@ -59,6 +59,9 @@ NODE_CAP = 64
 MAX_ROUNDS = 48
 IPM_ITERS = 26
 FRAC_TOL = 1e-4
+# Rows of the (best-bound-sorted) frontier that get an IPM solve per round;
+# the rest pass through with their parent bound (see ``_bnb_round``).
+BEAM = 16
 
 
 class RoundingData(NamedTuple):
@@ -333,34 +336,40 @@ def _sweep_data(sf: StandardForm, rd: RoundingData) -> SweepData:
     )
 
 
-def _init_state(sf: StandardForm, cap: Optional[int] = None) -> SearchState:
-    """Root frontier: one node per k. An explicit ``cap`` is honored exactly
-    (mesh callers pre-pad it to their device count); it must fit the roots."""
-    n_k = len(sf.ks)
-    nf = sf.A.shape[1]
-    if cap is None:
-        cap = max(NODE_CAP, 2 * n_k)
-    elif cap < n_k:
-        raise ValueError(f"frontier cap {cap} cannot hold {n_k} root nodes")
-    node_lo = jnp.zeros((cap, nf), DTYPE).at[:n_k].set(jnp.asarray(sf.lo_k, DTYPE))
-    node_hi = jnp.zeros((cap, nf), DTYPE).at[:n_k].set(jnp.asarray(sf.hi_k, DTYPE))
-    node_kidx = jnp.zeros(cap, jnp.int32).at[:n_k].set(
-        jnp.arange(n_k, dtype=jnp.int32)
-    )
-    active = jnp.zeros(cap, bool).at[:n_k].set(True)
+def _default_cap(n_k: int) -> int:
+    return max(NODE_CAP, 2 * n_k)
+
+
+def _root_state(lo_k, hi_k, M: int, cap: int) -> SearchState:
+    """Root frontier (one node per k) built from box arrays; jnp throughout,
+    so it works both eagerly and traced inside ``_solve_packed``."""
+    n_k, nf = lo_k.shape
     return SearchState(
-        node_lo=node_lo,
-        node_hi=node_hi,
-        node_kidx=node_kidx,
+        node_lo=jnp.zeros((cap, nf), DTYPE).at[:n_k].set(lo_k.astype(DTYPE)),
+        node_hi=jnp.zeros((cap, nf), DTYPE).at[:n_k].set(hi_k.astype(DTYPE)),
+        node_kidx=jnp.zeros(cap, jnp.int32).at[:n_k].set(
+            jnp.arange(n_k, dtype=jnp.int32)
+        ),
         node_bound=jnp.full(cap, -jnp.inf, BDTYPE),
-        active=active,
+        active=jnp.zeros(cap, bool).at[:n_k].set(True),
         incumbent=jnp.asarray(jnp.inf, BDTYPE),
-        inc_w=jnp.zeros(sf.M, BDTYPE),
-        inc_n=jnp.zeros(sf.M, BDTYPE),
+        inc_w=jnp.zeros(M, BDTYPE),
+        inc_n=jnp.zeros(M, BDTYPE),
         inc_kidx=jnp.asarray(0, jnp.int32),
         dropped_bound=jnp.asarray(jnp.inf, BDTYPE),
         per_k_best=jnp.full(n_k, jnp.inf, BDTYPE),
     )
+
+
+def _init_state(sf: StandardForm, cap: Optional[int] = None) -> SearchState:
+    """Root frontier: one node per k. An explicit ``cap`` is honored exactly
+    (mesh callers pre-pad it to their device count); it must fit the roots."""
+    n_k = len(sf.ks)
+    if cap is None:
+        cap = _default_cap(n_k)
+    elif cap < n_k:
+        raise ValueError(f"frontier cap {cap} cannot hold {n_k} root nodes")
+    return _root_state(jnp.asarray(sf.lo_k), jnp.asarray(sf.hi_k), sf.M, cap)
 
 
 def _bnb_round(
@@ -368,31 +377,45 @@ def _bnb_round(
     state: SearchState,
     mip_gap,
     ipm_iters: int = IPM_ITERS,
+    beam: Optional[int] = None,
 ) -> SearchState:
     """One batched branch-and-bound round over the frontier (pure function;
-    traced inside the fused solve loop or jitted standalone by callers)."""
+    traced inside the fused solve loop or jitted standalone by callers).
+
+    ``beam`` (static) caps how many frontier rows get an IPM solve this round.
+    Compaction keeps the frontier sorted best-bound-first, so the prefix holds
+    the most promising nodes; rows past the beam pass through untouched
+    (parent bound kept, no branching) and bubble forward as the prefix drains.
+    Measured frontiers stay tiny (<=4 active on the 16-device north star), so
+    a small beam removes ~90% of the round's FLOPs without weakening the
+    certificate — an unprocessed node keeps its valid parent bound.
+    """
     A, int_mask, ks, Ws, rd = data.A, data.int_mask, data.ks, data.Ws, data.rd
     obj_const = data.obj_const
     M = state.inc_w.shape[0]
+    cap = state.node_lo.shape[0]
+    B = cap if beam is None else min(beam, cap)
 
-    b = data.b_k[state.node_kidx]
-    c = data.c_k[state.node_kidx]
-    res = ipm_solve_batch(
-        LPBatch(A=A, b=b, c=c, l=state.node_lo, u=state.node_hi),
-        iters=ipm_iters,
-    )
+    lo_p = state.node_lo[:B]
+    hi_p = state.node_hi[:B]
+    kidx_p = state.node_kidx[:B]
+    active_p = state.active[:B]
+
+    b = data.b_k[kidx_p]
+    c = data.c_k[kidx_p]
+    res = ipm_solve_batch(LPBatch(A=A, b=b, c=c, l=lo_p, u=hi_p), iters=ipm_iters)
     bound = res.bound + obj_const
     # A diverged IPM instance reports -inf (see ops/ipm.py); fall back to the
     # inherited parent bound so the node keeps exploring instead of being
     # NaN-pruned (observed: platform-dependent divergence on the root LP).
     bound = jnp.where(jnp.isfinite(bound), bound, -jnp.inf)
-    bound = jnp.where(state.active, jnp.maximum(bound, state.node_bound), jnp.inf)
+    bound = jnp.where(active_p, jnp.maximum(bound, state.node_bound[:B]), jnp.inf)
 
-    # Exact integer incumbents from every active node's LP point.
+    # Exact integer incumbents from every active processed node's LP point.
     obj_lin, w_int, n_int = jax.vmap(
         lambda v, kidx: _round_to_incumbent(v, M, Ws[kidx], ks[kidx], rd)
-    )(res.v, state.node_kidx)
-    obj_full = jnp.where(state.active, obj_lin + obj_const, jnp.inf)
+    )(res.v, kidx_p)
+    obj_full = jnp.where(active_p, obj_lin + obj_const, jnp.inf)
 
     best_i = jnp.argmin(obj_full)
     best_obj = obj_full[best_i]
@@ -400,13 +423,13 @@ def _bnb_round(
     incumbent = jnp.where(better, best_obj, state.incumbent)
     inc_w = jnp.where(better, w_int[best_i], state.inc_w)
     inc_n = jnp.where(better, n_int[best_i], state.inc_n)
-    inc_kidx = jnp.where(better, state.node_kidx[best_i], state.inc_kidx)
+    inc_kidx = jnp.where(better, kidx_p[best_i], state.inc_kidx)
 
     # Per-k reporting incumbents
     per_k_best = state.per_k_best
     per_k_best = jnp.minimum(
         per_k_best,
-        jnp.full_like(per_k_best, jnp.inf).at[state.node_kidx].min(obj_full),
+        jnp.full_like(per_k_best, jnp.inf).at[kidx_p].min(obj_full),
     )
 
     # Prune: a node survives only if its bound can still beat the
@@ -417,14 +440,14 @@ def _bnb_round(
         incumbent - mip_gap * jnp.abs(incumbent),
         jnp.inf,
     )
-    survive = state.active & (bound < threshold)
+    survive = active_p & (bound < threshold)
 
     # Close nodes that are provably done: either the box is a single
     # point, or this round's rounded incumbent already achieves the
     # node's lower bound (so nothing better hides in the subtree). An
     # integral-*looking* LP point alone is NOT proof — the IPM may not
     # have converged — so such nodes keep splitting on the widest box.
-    width = jnp.where(int_mask[None, :], state.node_hi - state.node_lo, 0.0)
+    width = jnp.where(int_mask[None, :], hi_p - lo_p, 0.0)
     fully_fixed = jnp.max(width, axis=1) < 0.5
     achieved = obj_full <= bound + 1e-6 * jnp.maximum(1.0, jnp.abs(bound))
     survive &= ~(fully_fixed | achieved)
@@ -439,24 +462,28 @@ def _bnb_round(
     has_frac = max_frac > FRAC_TOL
     j_star = jnp.where(has_frac, j_frac, j_wide)
 
-    lo_j = jnp.take_along_axis(state.node_lo, j_star[:, None], axis=1)[:, 0]
-    hi_j = jnp.take_along_axis(state.node_hi, j_star[:, None], axis=1)[:, 0]
+    lo_j = jnp.take_along_axis(lo_p, j_star[:, None], axis=1)[:, 0]
+    hi_j = jnp.take_along_axis(hi_p, j_star[:, None], axis=1)[:, 0]
     vj = jnp.take_along_axis(res.v, j_star[:, None], axis=1)[:, 0]
     split = jnp.where(has_frac, vj, 0.5 * (lo_j + hi_j))
     dn = jnp.clip(jnp.floor(split), lo_j, jnp.maximum(hi_j - 1.0, lo_j))
     up = dn + 1.0
 
-    cap = state.node_lo.shape[0]
-    rows = jnp.arange(cap)
+    rows = jnp.arange(B)
     # child A: hi_j -> floor(v_j); child B: lo_j -> ceil(v_j)
-    hi_a = state.node_hi.at[rows, j_star].set(dn)
-    lo_b = state.node_lo.at[rows, j_star].set(up)
+    hi_a = hi_p.at[rows, j_star].set(dn)
+    lo_b = lo_p.at[rows, j_star].set(up)
 
-    child_lo = jnp.concatenate([state.node_lo, lo_b], axis=0)
-    child_hi = jnp.concatenate([hi_a, state.node_hi], axis=0)
-    child_kidx = jnp.concatenate([state.node_kidx, state.node_kidx])
-    child_bound = jnp.concatenate([bound, bound])
-    child_active = jnp.concatenate([survive, survive])
+    # Unprocessed rows pass through once, with their parent bound still
+    # subject to this round's (possibly improved) pruning threshold.
+    rest_bound = state.node_bound[B:]
+    rest_active = state.active[B:] & (rest_bound < threshold)
+
+    child_lo = jnp.concatenate([lo_p, lo_b, state.node_lo[B:]], axis=0)
+    child_hi = jnp.concatenate([hi_a, hi_p, state.node_hi[B:]], axis=0)
+    child_kidx = jnp.concatenate([kidx_p, kidx_p, state.node_kidx[B:]])
+    child_bound = jnp.concatenate([bound, bound, rest_bound])
+    child_active = jnp.concatenate([survive, survive, rest_active])
 
     # Compact best-bound-first back into the full capacity; track what falls off.
     sort_key = jnp.where(child_active, child_bound, jnp.inf)
@@ -481,6 +508,124 @@ def _bnb_round(
     )
 
 
+def _pack_blob(sf: StandardForm, rd: dict, mip_gap: float) -> np.ndarray:
+    """Flatten one sweep's entire input into a single float64 vector.
+
+    On a remote-tunnel TPU every host->device transfer costs a full RTT
+    (~7 ms measured), so the 19-odd arrays of a sweep are shipped as ONE
+    upload and sliced apart in-trace by ``_solve_packed``.
+    """
+    M = sf.M
+    parts = [
+        sf.A.ravel(),
+        sf.b_k.ravel(),
+        sf.c_k.ravel(),
+        sf.lo_k.ravel(),
+        sf.hi_k.ravel(),
+        sf.int_mask.astype(np.float64),
+        np.asarray(sf.ks, np.float64),
+        np.asarray(sf.Ws, np.float64),
+        np.asarray([sf.obj_const, mip_gap], np.float64),
+    ]
+    for name in _RD_VEC_FIELDS:
+        arr = np.broadcast_to(np.asarray(rd[name], np.float64), (M,))
+        parts.append(arr)
+    parts.append(np.asarray([rd["bprime"]], np.float64))
+    return np.ascontiguousarray(np.concatenate(parts))
+
+
+_RD_VEC_FIELDS = (
+    "a",
+    "b_gpu",
+    "pen_set",
+    "pen_vram",
+    "busy_const",
+    "s_disk",
+    "ram_rhs",
+    "ram_minus_n",
+    "cuda_rhs",
+    "metal_rhs",
+    "has_gpu",
+)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam"),
+)
+def _solve_packed(
+    blob: jax.Array,
+    M: int,
+    n_k: int,
+    m: int,
+    nf: int,
+    cap: int,
+    ipm_iters: int = IPM_ITERS,
+    max_rounds: int = MAX_ROUNDS,
+    beam: Optional[int] = BEAM,
+) -> jax.Array:
+    """One-dispatch sweep: unpack the blob, build the root state in-trace, run
+    the fused B&B loop, and pack the answer into one float64 vector:
+
+        [incumbent, best_bound, inc_kidx, dropped_bound,
+         inc_w (M), inc_n (M), per_k_best (n_k)]
+    """
+    off = 0
+
+    def take(n):
+        nonlocal off
+        s = blob[off : off + n]
+        off += n
+        return s
+
+    A = take(m * nf).reshape(m, nf)
+    b_k = take(n_k * m).reshape(n_k, m)
+    c_k = take(n_k * nf).reshape(n_k, nf)
+    lo_k = take(n_k * nf).reshape(n_k, nf)
+    hi_k = take(n_k * nf).reshape(n_k, nf)
+    int_mask = take(nf) > 0.5
+    ks = take(n_k)
+    Ws = take(n_k)
+    obj_const, mip_gap = take(2)
+    rd_vecs = {name: take(M) for name in _RD_VEC_FIELDS}
+    bprime = take(1)[0]
+    assert off == blob.shape[0], (
+        f"_pack_blob/_solve_packed layout drift: consumed {off} of {blob.shape[0]}"
+    )
+
+    data = SweepData(
+        A=A.astype(DTYPE),
+        b_k=b_k.astype(DTYPE),
+        c_k=c_k.astype(DTYPE),
+        int_mask=int_mask,
+        ks=ks,
+        Ws=Ws,
+        obj_const=obj_const,
+        rd=RoundingData(bprime=bprime, **rd_vecs),
+    )
+
+    state = _root_state(lo_k, hi_k, M, cap)
+    state = _run_bnb_loop(
+        data, state, mip_gap, ipm_iters=ipm_iters, max_rounds=max_rounds, beam=beam
+    )
+
+    return jnp.concatenate(
+        [
+            jnp.stack(
+                [
+                    state.incumbent,
+                    _best_bound(state),
+                    state.inc_kidx.astype(BDTYPE),
+                    state.dropped_bound,
+                ]
+            ),
+            state.inc_w,
+            state.inc_n,
+            state.per_k_best,
+        ]
+    )
+
+
 def _best_bound(state: SearchState) -> jax.Array:
     live = jnp.min(jnp.where(state.active, state.node_bound, jnp.inf))
     return jnp.minimum(live, state.dropped_bound)
@@ -491,20 +636,17 @@ def _certified(state: SearchState, mip_gap) -> jax.Array:
     return jnp.isfinite(inc) & (inc - _best_bound(state) <= mip_gap * jnp.abs(inc))
 
 
-@partial(jax.jit, static_argnames=("ipm_iters", "max_rounds"))
-def _solve_fused(
+def _run_bnb_loop(
     data: SweepData,
     state: SearchState,
-    mip_gap: jax.Array,
+    mip_gap,
     ipm_iters: int = IPM_ITERS,
     max_rounds: int = MAX_ROUNDS,
+    beam: Optional[int] = None,
 ) -> SearchState:
-    """The full branch-and-bound sweep as one device program.
-
-    ``lax.while_loop`` over B&B rounds with the mip-gap test on-device;
-    returns the final state. The host does one dispatch and one fetch per
-    HALDA solve.
-    """
+    """``lax.while_loop`` over B&B rounds with the mip-gap test on-device.
+    The single shared definition of the search loop (traced by both the
+    packed single-dispatch path and the mesh-sharded path)."""
 
     def cond(carry):
         state, i = carry
@@ -516,10 +658,29 @@ def _solve_fused(
 
     def body(carry):
         state, i = carry
-        return _bnb_round(data, state, mip_gap, ipm_iters=ipm_iters), i + 1
+        return (
+            _bnb_round(data, state, mip_gap, ipm_iters=ipm_iters, beam=beam),
+            i + 1,
+        )
 
     state, _ = jax.lax.while_loop(cond, body, (state, jnp.asarray(0, jnp.int32)))
     return state
+
+
+@partial(jax.jit, static_argnames=("ipm_iters", "max_rounds", "beam"))
+def _solve_fused(
+    data: SweepData,
+    state: SearchState,
+    mip_gap: jax.Array,
+    ipm_iters: int = IPM_ITERS,
+    max_rounds: int = MAX_ROUNDS,
+    beam: Optional[int] = None,
+) -> SearchState:
+    """The full branch-and-bound sweep as one device program; the host does
+    one dispatch and one fetch per HALDA solve."""
+    return _run_bnb_loop(
+        data, state, mip_gap, ipm_iters=ipm_iters, max_rounds=max_rounds, beam=beam
+    )
 
 
 def solve_sweep_jax(
@@ -549,26 +710,52 @@ def solve_sweep_jax(
         return results, None
 
     sf = build_standard_form(arrays, coeffs, feasible)
-    data = _sweep_data(sf, rounding_data(coeffs))
-    state = _init_state(sf)
-    gap = jnp.asarray(mip_gap, BDTYPE)
+    n_k = len(sf.ks)
+    m, nf = sf.A.shape
+    cap = _default_cap(n_k)
 
-    state = _solve_fused(data, state, gap, ipm_iters=ipm_iters, max_rounds=max_rounds)
-
-    incumbent = float(state.incumbent)
-    if debug:
-        print(
-            f"    [jax] incumbent={incumbent:.6f} "
-            f"bound={float(_best_bound(state)):.6f} "
-            f"live={int(np.asarray(state.active).sum())}"
+    # One upload, one dispatch, one fetch — transfer count, not FLOPs, is
+    # what a remote-tunnel TPU bills for (see _pack_blob).
+    blob = jnp.asarray(_pack_blob(sf, _rounding_arrays_np(coeffs), mip_gap))
+    out = np.asarray(
+        jax.device_get(
+            _solve_packed(
+                blob,
+                M=M,
+                n_k=n_k,
+                m=m,
+                nf=nf,
+                cap=cap,
+                ipm_iters=ipm_iters,
+                max_rounds=max_rounds,
+            )
         )
+    )
+
+    incumbent = float(out[0])
+    best_bound = float(out[1])
+    if debug:
+        print(f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f}")
     if not np.isfinite(incumbent):
         return results, None
+    if incumbent - best_bound > mip_gap * abs(incumbent) + 1e-12:
+        # Search exhausted max_rounds (or overflowed the frontier) without
+        # closing the gap; the incumbent is still the best found integer
+        # point, but the certificate failed — say so instead of implying it.
+        import warnings
 
-    per_k_best = np.asarray(state.per_k_best)
-    inc_k_idx = int(state.inc_kidx)
-    inc_w = [int(x) for x in np.asarray(state.inc_w)]
-    inc_n = [int(x) for x in np.asarray(state.inc_n)]
+        warnings.warn(
+            f"HALDA jax backend: mip-gap certificate NOT met "
+            f"(incumbent={incumbent:.6g}, bound={best_bound:.6g}, "
+            f"requested gap={mip_gap:g}); raise max_rounds or mip_gap.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+
+    inc_k_idx = int(out[2])
+    inc_w = [int(round(x)) for x in out[4 : 4 + M]]
+    inc_n = [int(round(x)) for x in out[4 + M : 4 + 2 * M]]
+    per_k_best = out[4 + 2 * M : 4 + 2 * M + n_k]
 
     best: Optional[ILPResult] = None
     pos_of = {kW: i for i, kW in enumerate(kWs)}
