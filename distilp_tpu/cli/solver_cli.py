@@ -43,6 +43,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--plot", action="store_true", help="plot the k-objective curve")
     p.add_argument("--debug", action="store_true")
     p.add_argument("--save-solution", default=None, help="write the solution JSON here")
+    p.add_argument(
+        "--moe",
+        choices=["auto", "on", "off"],
+        default="auto",
+        help="expert+layer co-assignment: auto (when the profile has MoE "
+        "component metrics), on (require them), off (dense formulation)",
+    )
     return p
 
 
@@ -62,17 +69,22 @@ def main(argv=None) -> int:
     if args.k_candidates:
         k_candidates = [int(x) for x in args.k_candidates.split(",") if x.strip()]
 
-    result = halda_solve(
-        devices,
-        model,
-        k_candidates=k_candidates,
-        mip_gap=args.mip_gap,
-        plot=args.plot,
-        debug=args.debug,
-        kv_bits=args.kv_bits,
-        backend=args.backend,
-        time_limit=args.time_limit,
-    )
+    try:
+        result = halda_solve(
+            devices,
+            model,
+            k_candidates=k_candidates,
+            mip_gap=args.mip_gap,
+            plot=args.plot,
+            debug=args.debug,
+            kv_bits=args.kv_bits,
+            backend=args.backend,
+            time_limit=args.time_limit,
+            moe={"auto": None, "on": True, "off": False}[args.moe],
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     result.print_solution(devices)
 
     if args.save_solution:
@@ -84,6 +96,8 @@ def main(argv=None) -> int:
             "sets": result.sets,
             "devices": [d.name for d in devices],
         }
+        if result.y is not None:
+            payload["y"] = result.y
         Path(args.save_solution).write_text(json.dumps(payload, indent=2))
         print(f"Saved solution to {args.save_solution}")
     return 0

@@ -56,13 +56,16 @@ BOUND_DTYPE = jnp.float64
 
 
 class LPBatch(NamedTuple):
-    """One fleet instance's LP family: shared A, batched (b, c, l, u).
+    """One fleet instance's LP family: (shared or batched) A, batched b/c/l/u.
 
-    A is shared across the batch (same constraint structure for every k and
-    every branch-and-bound node); b/c/l/u carry the per-instance variation.
+    A with shape (m, n) is shared across the batch (same constraint structure
+    for every k and every branch-and-bound node — the dense HALDA case);
+    shape (B, m, n) carries a per-instance matrix (the MoE case, where expert
+    busy coefficients scale with 1/k). b/c/l/u always carry the per-instance
+    variation.
     """
 
-    A: jax.Array  # (m, n)
+    A: jax.Array  # (m, n) shared or (B, m, n) batched
     b: jax.Array  # (B, m)
     c: jax.Array  # (B, n)
     l: jax.Array  # (B, n)
@@ -247,7 +250,7 @@ def ipm_solve_batch(
     tol: Optional[float] = None,
     reg: Optional[float] = None,
 ) -> IPMResult:
-    """Solve a batch of boxed LPs sharing one constraint matrix.
+    """Solve a batch of boxed LPs (shared (m, n) or per-instance (B, m, n) A).
 
     Runs in the dtype of ``batch.A`` (float32 is the TPU production path);
     returns per-element primal points, objectives, and rigorous float64
@@ -256,6 +259,11 @@ def ipm_solve_batch(
     dtype = batch.A.dtype
     tol_v = _default_tol(dtype) if tol is None else tol
     reg_v = _default_reg(dtype) if reg is None else reg
+    if batch.A.ndim == 3:
+        solver = jax.vmap(
+            lambda A, b, c, l, u: _ipm_single(A, b, c, l, u, iters, tol_v, reg_v)
+        )
+        return solver(batch.A, batch.b, batch.c, batch.l, batch.u)
     solver = jax.vmap(
         lambda b, c, l, u: _ipm_single(batch.A, b, c, l, u, iters, tol_v, reg_v)
     )

@@ -100,7 +100,7 @@ def solve_sweep_sharded(
         raise RuntimeError("No feasible MILP found for any k.")
 
     sf = build_standard_form(arrays, coeffs, feasible)
-    data = _sweep_data(sf, rounding_data(coeffs))
+    data = _sweep_data(sf, rounding_data(coeffs, arrays.moe))
     gap = jnp.asarray(mip_gap, BDTYPE)
 
     state = _init_state(sf, cap=pad_cap_to_mesh(max(NODE_CAP, 2 * len(sf.ks)), mesh))
@@ -110,6 +110,6 @@ def solve_sweep_sharded(
 
     with mesh:
         state = _solve_fused(
-            data, state, gap, ipm_iters=ipm_iters, max_rounds=max_rounds
+            data, state, gap, ipm_iters=ipm_iters, max_rounds=max_rounds, moe=sf.moe
         )
     return state, sf

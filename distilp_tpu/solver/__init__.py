@@ -11,10 +11,15 @@ from .coeffs import (
     kappa_constant,
     valid_factors_of_L,
 )
+from .moe import MoEArrays, adjust_model, build_moe_arrays, model_has_moe_components
 from .result import HALDAResult, ILPResult
 
 __all__ = [
     "halda_solve",
+    "MoEArrays",
+    "adjust_model",
+    "build_moe_arrays",
+    "model_has_moe_components",
     "HALDAResult",
     "ILPResult",
     "HaldaCoeffs",

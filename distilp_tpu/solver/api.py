@@ -18,6 +18,7 @@ from ..common import DeviceProfile, ModelProfile, kv_bits_to_factor
 from .assemble import assemble
 from .backend_cpu import Infeasible, solve_fixed_k_cpu
 from .coeffs import assign_sets, build_coeffs, valid_factors_of_L
+from .moe import adjust_model, build_moe_arrays, model_has_moe_components
 from .result import HALDAResult, ILPResult
 
 Backend = str  # 'cpu' | 'jax'
@@ -33,12 +34,25 @@ def halda_solve(
     kv_bits: str = "8bit",
     backend: Backend = "cpu",
     time_limit: Optional[float] = 3600.0,
+    moe: Optional[bool] = None,
 ) -> HALDAResult:
-    """Pick the best (k, w, n) placement over all candidate segment counts.
+    """Pick the best (k, w, n[, y]) placement over all candidate segment counts.
+
+    ``moe=None`` (default) enables expert+layer co-assignment automatically
+    when the profile carries MoE component metrics; ``moe=False`` forces the
+    dense formulation; ``moe=True`` raises if the metrics are missing. In MoE
+    mode the result's ``y`` lists the routed experts hosted per device (see
+    ``distilp_tpu.solver.moe`` for the formulation).
 
     Returns the assignment minimizing the modeled per-round latency; raises
     ``RuntimeError`` if no candidate k admits a feasible assignment.
     """
+    use_moe = model_has_moe_components(model) if moe is None else bool(moe)
+    if use_moe and not model_has_moe_components(model):
+        raise ValueError(
+            "moe=True requires a profile with MoE component metrics "
+            "(bytes_per_expert, flops_per_active_expert_per_token, ...)"
+        )
     if k_candidates:
         Ks = sorted(set(int(k) for k in k_candidates))
         bad = [k for k in Ks if k <= 0 or model.L % k != 0 or k == model.L]
@@ -51,8 +65,14 @@ def halda_solve(
 
     kv_factor = kv_bits_to_factor(kv_bits)
     sets = assign_sets(devs)
-    coeffs = build_coeffs(devs, model, kv_factor, sets)
-    arrays = assemble(coeffs)
+    if use_moe:
+        # Dense (w/n) costs come from the expert-free adjusted profile; the
+        # expert block (y) carries the routed-expert bytes and compute.
+        coeffs = build_coeffs(devs, adjust_model(model), kv_factor, sets)
+        arrays = assemble(coeffs, moe=build_moe_arrays(devs, model))
+    else:
+        coeffs = build_coeffs(devs, model, kv_factor, sets)
+        arrays = assemble(coeffs)
 
     per_k_objs: List[Tuple[int, Optional[float]]] = []
     best: Optional[ILPResult] = None
@@ -106,6 +126,7 @@ def halda_solve(
         k=best.k,
         obj_value=best.obj_value,
         sets={name: list(v) for name, v in sets.items()},
+        y=list(best.y) if best.y is not None else None,
     )
 
     if plot:

@@ -1,46 +1,59 @@
 """Fixed-shape MILP assembly for the per-k HALDA subproblem.
 
-Decision vector (N = 7M+1), all integer except z and C:
+Decision vector (N = 7M+1 dense, 8M+1 with MoE co-assignment), all integer
+except z and C:
 
-    x = [ w_0..w_{M-1} | n | s1 | s2 | s3 | t | z | C ]
+    x = [ w_0..w_{M-1} | n | (y) | s1 | s2 | s3 | t | z | C ]
 
     w_i  layers assigned to device i                 in [1, W]
     n_i  of those, layers resident on the accelerator in [0, W] (0 w/o GPU)
+    y_i  routed experts hosted per MoE layer          in [0, E] (MoE mode)
     s1/s2/s3_i  RAM-overflow slack layers, gated to the device's set
     t_i  VRAM-overflow slack layers, gated on GPU presence
     z_i  pipeline stall seconds (continuous)
     C    steady-state cycle time seconds (continuous)
 
-Constraint rows are emitted at a fixed count (6M inequality + 1 equality) so
-every (M, k) instance of one fleet shares a single array shape — that is what
-lets the JAX backend vmap the k-sweep and batch branch-and-bound nodes. Rows
-that don't apply to a device (no CUDA, no Metal) keep their structural columns
-but get a huge RHS, and the variable bounds already pin their variables to 0.
+Constraint rows are emitted at a fixed count (6M inequality + 1 or 2
+equality) so every (M, k) instance of one fleet shares a single array shape —
+that is what lets the JAX backend vmap the k-sweep and batch branch-and-bound
+nodes. Rows that don't apply to a device (no CUDA, no Metal) keep their
+structural columns but get a huge RHS, and the variable bounds already pin
+their variables to 0.
 
 Row layout of A_ub:
     [0,  M)   n_i - w_i <= 0
-    [M, 2M)   RAM/unified residency cap per device (set-dependent shape)
+    [M, 2M)   RAM/unified residency cap per device (set-dependent shape;
+              MoE mode adds eb_i * y_i resident expert bytes)
     [2M,3M)   CUDA VRAM cap
     [3M,4M)   Metal shared-memory cap
     [4M,5M)   cycle bound:   B_i + z_i - C <= -(xi_i + t_comm_i)
     [5M,6M)   prefetch bound: B_i + F_i - z_i - C <= -(xi_i + t_comm_i)
 
 where B_i is the device busy time (a_i w_i + b_i n_i + disk penalties on the
-slacks, plus the constant xi_i + t_comm_i) and F_i = (b'/s_disk_i) w_i the
-disk prefetch time for the next window.
+slacks, plus the constant xi_i + t_comm_i — and, in MoE mode, the expert
+share (g_raw_i / k) y_i) and F_i = (b'/s_disk_i) w_i the disk prefetch time
+for the next window. Expert weights are always resident, so they appear in
+the memory rows but never in F_i.
 
-Parity: constraint set and objective match the reference MILP
+The MoE busy coefficient g_raw_i / k is the one k-DEPENDENT entry of the
+constraint matrix (a segment covers n_moe/k MoE layers); ``A_ub_for_k``
+materializes the per-k matrix. The dense mode keeps A fully k-independent.
+
+Parity: the dense constraint set and objective match the reference MILP
 (/root/reference/src/distilp/solver/halda_p_solver.py:59-366); the golden
-fixture objectives pin the numerics.
+fixture objectives pin the numerics. The MoE block is new design — see
+``distilp_tpu.solver.moe`` for the formulation rationale.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import numpy as np
 
 from .coeffs import HaldaCoeffs
+from .moe import MoEArrays
 
 # RHS standing in for "row inactive" — far beyond any byte count in a profile.
 INACTIVE_RHS = 1e30
@@ -48,13 +61,23 @@ INACTIVE_RHS = 1e30
 
 @dataclass(frozen=True)
 class VarLayout:
-    """Index helpers into the decision vector."""
+    """Index helpers into the decision vector. ``moe`` inserts the y block
+    after n and shifts everything behind it by M."""
 
     M: int
+    moe: bool = False
+
+    @property
+    def ny(self) -> int:
+        return self.M if self.moe else 0
 
     @property
     def n_vars(self) -> int:
-        return 7 * self.M + 1
+        return 7 * self.M + self.ny + 1
+
+    @property
+    def n_eq(self) -> int:
+        return 2 if self.moe else 1
 
     def w(self, i: int) -> int:
         return i
@@ -62,39 +85,49 @@ class VarLayout:
     def n(self, i: int) -> int:
         return self.M + i
 
-    def s1(self, i: int) -> int:
+    def y(self, i: int) -> int:
+        if not self.moe:
+            raise IndexError("y block only exists in MoE mode")
         return 2 * self.M + i
 
+    def s1(self, i: int) -> int:
+        return 2 * self.M + self.ny + i
+
     def s2(self, i: int) -> int:
-        return 3 * self.M + i
+        return 3 * self.M + self.ny + i
 
     def s3(self, i: int) -> int:
-        return 4 * self.M + i
+        return 4 * self.M + self.ny + i
 
     def t(self, i: int) -> int:
-        return 5 * self.M + i
+        return 5 * self.M + self.ny + i
 
     def z(self, i: int) -> int:
-        return 6 * self.M + i
+        return 6 * self.M + self.ny + i
+
+    @property
+    def z0(self) -> int:
+        return 6 * self.M + self.ny
 
     @property
     def C(self) -> int:
-        return 7 * self.M
+        return 7 * self.M + self.ny
 
 
 @dataclass
 class MilpArrays:
     """The k-independent dense arrays of one HALDA instance.
 
-    Only ``b_eq`` (= W) and the variable upper bounds scale with k; everything
-    else is shared across the whole k-sweep.
+    Only ``b_eq``'s W entry, the variable upper bounds, the objective's C
+    coefficient, and (MoE mode) the y busy coefficients scale with k;
+    everything else is shared across the whole k-sweep.
     """
 
     layout: VarLayout
-    A_ub: np.ndarray  # (6M, N)
+    A_ub: np.ndarray  # (6M, N) — y busy coefficients left at 0 (k-dependent)
     b_ub: np.ndarray  # (6M,)
-    A_eq: np.ndarray  # (1, N)
-    c_base: np.ndarray  # (N,) objective without the k-dependent C coefficient
+    A_eq: np.ndarray  # (n_eq, N)
+    c_base: np.ndarray  # (N,) objective without the k-dependent coefficients
     integrality: np.ndarray  # (N,) 1 = integer, 0 = continuous
     # Per-variable bound templates: lb fixed; ub is ub_scale * W + ub_const,
     # with np.inf marking unbounded (z, C).
@@ -102,6 +135,7 @@ class MilpArrays:
     ub_scale: np.ndarray
     ub_const: np.ndarray
     obj_const: float  # additive constant: sum t_comm + sum xi + kappa
+    moe: Optional[MoEArrays] = None
 
     def bounds_for_k(self, W: int) -> tuple[np.ndarray, np.ndarray]:
         ub = self.ub_scale * float(W) + self.ub_const
@@ -110,13 +144,35 @@ class MilpArrays:
     def c_for_k(self, k: int) -> np.ndarray:
         c = self.c_base.copy()
         c[self.layout.C] = float(k - 1)
+        if self.moe is not None:
+            lay = self.layout
+            for i in range(lay.M):
+                c[lay.y(i)] = self.moe.g_raw[i] / float(k)
         return c
 
+    def A_ub_for_k(self, k: int) -> np.ndarray:
+        """The inequality matrix at one k (fills the y busy coefficients)."""
+        if self.moe is None:
+            return self.A_ub
+        A = self.A_ub.copy()
+        lay = self.layout
+        M = lay.M
+        for i in range(M):
+            g_k = self.moe.g_raw[i] / float(k)
+            A[4 * M + i, lay.y(i)] = g_k  # cycle row
+            A[5 * M + i, lay.y(i)] = g_k  # prefetch row (contains B_i too)
+        return A
 
-def assemble(coeffs: HaldaCoeffs) -> MilpArrays:
+    def b_eq_for_k(self, W: int) -> np.ndarray:
+        if self.moe is None:
+            return np.array([float(W)])
+        return np.array([float(W), float(self.moe.E)])
+
+
+def assemble(coeffs: HaldaCoeffs, moe: Optional[MoEArrays] = None) -> MilpArrays:
     """Emit the fixed-shape arrays for one (devices, model, kv_factor) instance."""
     M = coeffs.M
-    lay = VarLayout(M)
+    lay = VarLayout(M, moe=moe is not None)
     N = lay.n_vars
 
     A_ub = np.zeros((6 * M, N))
@@ -145,6 +201,8 @@ def assemble(coeffs: HaldaCoeffs) -> MilpArrays:
         A_ub[r, lay.w(i)] = bp
         if coeffs.ram_minus_n[i]:
             A_ub[r, lay.n(i)] = -bp
+        if moe is not None:
+            A_ub[r, lay.y(i)] = moe.eb[i]  # resident expert bytes
         sid = int(coeffs.set_id[i])
         slack_col = {1: lay.s1, 2: lay.s2, 3: lay.s3}[sid](i)
         A_ub[r, slack_col] = -bp
@@ -162,7 +220,7 @@ def assemble(coeffs: HaldaCoeffs) -> MilpArrays:
         A_ub[r, lay.t(i)] = -bp
         b_ub[r] = coeffs.metal_rhs[i] if coeffs.metal_row[i] else INACTIVE_RHS
 
-        # --- busy time B_i (shared by the two cycle rows) ---
+        # --- busy time B_i (shared by the two cycle rows; y filled per k) ---
         busy = np.zeros(N)
         busy[lay.w(i)] = coeffs.a[i]
         busy[lay.n(i)] = coeffs.b_gpu[i]
@@ -187,11 +245,13 @@ def assemble(coeffs: HaldaCoeffs) -> MilpArrays:
         A_ub[r, lay.C] -= 1.0
         b_ub[r] = -busy_const
 
-    # --- equality: sum w_i = W ---
-    A_eq = np.zeros((1, N))
-    A_eq[0, : M] = 1.0
+    # --- equalities: sum w_i = W; MoE mode adds sum y_i = E ---
+    A_eq = np.zeros((lay.n_eq, N))
+    A_eq[0, :M] = 1.0
+    if moe is not None:
+        A_eq[1, 2 * M : 3 * M] = 1.0
 
-    # --- objective (C coefficient filled per k) ---
+    # --- objective (k-dependent coefficients filled per k) ---
     c = np.zeros(N)
     c[:M] = coeffs.a
     c[M : 2 * M] = coeffs.b_gpu
@@ -200,7 +260,7 @@ def assemble(coeffs: HaldaCoeffs) -> MilpArrays:
             c[sl(i)] = pen[name][i]
 
     integrality = np.ones(N, dtype=np.int64)
-    integrality[6 * M :] = 0  # z and C continuous
+    integrality[lay.z0 :] = 0  # z and C continuous
 
     # --- bounds templates ---
     lb = np.zeros(N)
@@ -210,11 +270,20 @@ def assemble(coeffs: HaldaCoeffs) -> MilpArrays:
     lb[:M] = 1.0  # every device gets at least one layer
     ub_scale[:M] = 1.0  # w <= W
     ub_scale[M : 2 * M] = coeffs.has_gpu.astype(float)  # n <= W or 0
+    if moe is not None:
+        ub_const[2 * M : 3 * M] = float(moe.E)  # y <= E (k-independent)
     for sid, sl in ((1, lay.s1), (2, lay.s2), (3, lay.s3)):
         for i in range(M):
-            ub_scale[sl(i)] = 1.0 if int(coeffs.set_id[i]) == sid else 0.0
-    ub_scale[5 * M : 6 * M] = coeffs.has_gpu.astype(float)  # t
-    ub_const[6 * M :] = np.inf  # z, C unbounded above
+            in_set = int(coeffs.set_id[i]) == sid
+            ub_scale[sl(i)] = 1.0 if in_set else 0.0
+            if moe is not None and in_set:
+                # Expert residency can exceed RAM too; the overflow rides the
+                # same disk-streaming slack (unit = b' bytes), so its cap
+                # grows by the expert bytes expressed in slack units.
+                ub_const[sl(i)] = np.ceil(moe.eb[i] * moe.E / bp)
+    for i in range(M):
+        ub_scale[lay.t(i)] = 1.0 if coeffs.has_gpu[i] else 0.0
+    ub_const[lay.z0 :] = np.inf  # z, C unbounded above
 
     return MilpArrays(
         layout=lay,
@@ -227,4 +296,5 @@ def assemble(coeffs: HaldaCoeffs) -> MilpArrays:
         ub_scale=ub_scale,
         ub_const=ub_const,
         obj_const=coeffs.obj_const,
+        moe=moe,
     )

@@ -34,10 +34,11 @@ def solve_fixed_k_cpu(
     lay = arrays.layout
     lb, ub = arrays.bounds_for_k(W)
     c = arrays.c_for_k(k)
+    b_eq = arrays.b_eq_for_k(W)
 
     constraints = [
-        LinearConstraint(arrays.A_ub, -np.inf, arrays.b_ub),
-        LinearConstraint(arrays.A_eq, float(W), float(W)),
+        LinearConstraint(arrays.A_ub_for_k(k), -np.inf, arrays.b_ub),
+        LinearConstraint(arrays.A_eq, b_eq, b_eq),
     ]
 
     options = {}
@@ -60,5 +61,6 @@ def solve_fixed_k_cpu(
     M = lay.M
     w = [int(round(x[lay.w(i)])) for i in range(M)]
     n = [int(round(x[lay.n(i)])) for i in range(M)]
+    y = [int(round(x[lay.y(i)])) for i in range(M)] if lay.moe else None
     obj = float(c @ x) + arrays.obj_const
-    return ILPResult(k=k, w=w, n=n, obj_value=obj)
+    return ILPResult(k=k, w=w, n=n, y=y, obj_value=obj)

@@ -68,7 +68,7 @@ class RoundingData(NamedTuple):
     """Exact per-device MILP data for the integer rounding heuristic.
 
     Held in float64: the incumbent objective must be exact so the mip-gap
-    certificate means what it says.
+    certificate means what it says. The MoE fields are zeros in dense mode.
     """
 
     a: jax.Array  # (M,)
@@ -82,11 +82,15 @@ class RoundingData(NamedTuple):
     cuda_rhs: jax.Array  # +inf when row inactive
     metal_rhs: jax.Array  # +inf when row inactive
     has_gpu: jax.Array  # float 0/1
+    g_raw: jax.Array  # (M,) MoE expert busy seconds per y-unit, times k
+    eb: jax.Array  # (M,) MoE resident bytes per y-unit
     bprime: jax.Array  # scalar
+    E: jax.Array  # scalar: routed experts per MoE layer (0 = dense)
 
 
-def _rounding_arrays_np(coeffs: HaldaCoeffs) -> dict:
+def _rounding_arrays_np(coeffs: HaldaCoeffs, moe=None) -> dict:
     """Host-side (numpy) rounding-heuristic arrays; no device traffic."""
+    M = coeffs.M
     pen_by_set = np.where(
         coeffs.set_id == 1,
         coeffs.pen_m1,
@@ -104,13 +108,19 @@ def _rounding_arrays_np(coeffs: HaldaCoeffs) -> dict:
         cuda_rhs=np.where(coeffs.cuda_row, coeffs.cuda_rhs, np.inf),
         metal_rhs=np.where(coeffs.metal_row, coeffs.metal_rhs, np.inf),
         has_gpu=coeffs.has_gpu.astype(np.float64),
+        g_raw=np.asarray(moe.g_raw if moe is not None else np.zeros(M), np.float64),
+        eb=np.asarray(moe.eb if moe is not None else np.zeros(M), np.float64),
         bprime=np.float64(coeffs.bprime),
+        E=np.float64(moe.E if moe is not None else 0.0),
     )
 
 
-def rounding_data(coeffs: HaldaCoeffs) -> RoundingData:
+def rounding_data(coeffs: HaldaCoeffs, moe=None) -> RoundingData:
     return RoundingData(
-        **{k: jnp.asarray(v, BDTYPE) for k, v in _rounding_arrays_np(coeffs).items()}
+        **{
+            k: jnp.asarray(v, BDTYPE)
+            for k, v in _rounding_arrays_np(coeffs, moe).items()
+        }
     )
 
 
@@ -118,11 +128,14 @@ def rounding_data(coeffs: HaldaCoeffs) -> RoundingData:
 class StandardForm:
     """Host-assembled arrays of the boxed-standard-form LP family.
 
-    Variables: [x_struct (7M+1) | row slacks (6M)]; rows: 6M scaled
-    inequality rows turned equalities + the sum(w)=W equality.
+    Variables: [x_struct (N) | row slacks (6M)]; rows: 6M scaled inequality
+    rows turned equalities + the sum(w)=W (and, MoE mode, sum(y)=E)
+    equalities. A is per-k because the MoE expert busy coefficients scale
+    with 1/k (a pure copy per k in dense mode — the memory is trivial and
+    the uniform shape keeps one code path).
     """
 
-    A: np.ndarray  # (m, nf) row-scaled
+    A: np.ndarray  # (n_k, m, nf) row-scaled
     b_k: np.ndarray  # (n_k, m)
     c_k: np.ndarray  # (n_k, nf)
     lo_k: np.ndarray  # (n_k, nf) root boxes
@@ -132,9 +145,12 @@ class StandardForm:
     Ws: List[int]
     M: int
     obj_const: float
+    moe: bool = False
 
 
-def _root_boxes(arrays: MilpArrays, rd: dict, W: int) -> Tuple[np.ndarray, np.ndarray]:
+def _root_boxes(
+    arrays: MilpArrays, rd: dict, k: int, W: int
+) -> Tuple[np.ndarray, np.ndarray]:
     """Finite boxes for every variable at one k (pure numpy).
 
     z and C are nominally free above, but any *optimal* solution satisfies
@@ -142,67 +158,73 @@ def _root_boxes(arrays: MilpArrays, rd: dict, W: int) -> Tuple[np.ndarray, np.nd
     valid for branch-and-bound. Boxing everything is what makes the
     Lagrangian bound rigorous for any dual vector.
     """
-    M = arrays.layout.M
+    lay = arrays.layout
+    M = lay.M
     lo, hi = arrays.bounds_for_k(W)
 
     F_max = W * rd["bprime"] / rd["s_disk"]
+    s_cap = W + np.ceil(rd["eb"] * rd["E"] / rd["bprime"])  # slack upper bound
     B_max = (
         rd["a"] * W
         + np.maximum(rd["b_gpu"], 0.0) * W
-        + rd["pen_set"] * W
+        + rd["pen_set"] * s_cap
         + rd["pen_vram"] * W * rd["has_gpu"]
+        + (rd["g_raw"] / float(k)) * rd["E"]
         + rd["busy_const"]
     )
     z_ub = F_max
     C_ub = float(np.max(B_max + F_max)) if M else 1.0
 
     hi = hi.copy()
-    hi[6 * M : 7 * M] = z_ub
-    hi[7 * M] = C_ub
+    hi[lay.z0 : lay.C] = z_ub
+    hi[lay.C] = C_ub
     return lo, hi
 
 
 def build_standard_form(
     arrays: MilpArrays, coeffs: HaldaCoeffs, kWs: Sequence[Tuple[int, int]]
 ) -> StandardForm:
-    """Row-scale the MILP and emit the per-k (b, c, box) family. Pure numpy —
-    no device traffic until ``_sweep_data`` uploads the result once."""
-    M = arrays.layout.M
-    N = arrays.layout.n_vars
+    """Row-scale the MILP and emit the per-k (A, b, c, box) family. Pure
+    numpy — no device traffic until ``_sweep_data`` uploads the result once."""
+    lay = arrays.layout
+    M = lay.M
+    N = lay.n_vars
+    n_eq = lay.n_eq
     m_ub = arrays.A_ub.shape[0]
     nf = N + m_ub
-    m = m_ub + 1
+    m = m_ub + n_eq
 
-    rd = _rounding_arrays_np(coeffs)
-
-    # Row scaling: each inequality row (incl. its huge inactive RHS) is
-    # normalized by its own magnitude; the slack column keeps coefficient 1
-    # (slacks live in scaled units, boxed below).
-    row_mag = np.maximum(np.abs(arrays.A_ub).max(axis=1), np.abs(arrays.b_ub))
-    row_scale = 1.0 / np.maximum(row_mag, 1.0)
-
-    A = np.zeros((m, nf))
-    A[:m_ub, :N] = arrays.A_ub * row_scale[:, None]
-    A[:m_ub, N:] = np.eye(m_ub)
-    A[m_ub, :N] = arrays.A_eq[0]
-    b_ub_scaled = arrays.b_ub * row_scale
+    rd = _rounding_arrays_np(coeffs, arrays.moe)
 
     n_k = len(kWs)
+    A = np.zeros((n_k, m, nf))
     b_k = np.zeros((n_k, m))
     c_k = np.zeros((n_k, nf))
     lo_k = np.zeros((n_k, nf))
     hi_k = np.zeros((n_k, nf))
 
     for j, (k, W) in enumerate(kWs):
+        A_ub = arrays.A_ub_for_k(k)
+        # Row scaling: each inequality row (incl. its huge inactive RHS) is
+        # normalized by its own magnitude; the slack column keeps coefficient
+        # 1 (slacks live in scaled units, boxed below).
+        row_mag = np.maximum(np.abs(A_ub).max(axis=1), np.abs(arrays.b_ub))
+        row_scale = 1.0 / np.maximum(row_mag, 1.0)
+
+        A[j, :m_ub, :N] = A_ub * row_scale[:, None]
+        A[j, :m_ub, N:] = np.eye(m_ub)
+        A[j, m_ub:, :N] = arrays.A_eq
+        b_ub_scaled = arrays.b_ub * row_scale
+
         b_k[j, :m_ub] = b_ub_scaled
-        b_k[j, m_ub] = float(W)
+        b_k[j, m_ub:] = arrays.b_eq_for_k(W)
         c_k[j, :N] = arrays.c_for_k(k)
 
-        lo_s, hi_s = _root_boxes(arrays, rd, W)
+        lo_s, hi_s = _root_boxes(arrays, rd, k, W)
         lo_k[j, :N] = lo_s
         hi_k[j, :N] = hi_s
         # Slack boxes: s_row = b_row - min_v(A_row v) over the structural box.
-        Arow = A[:m_ub, :N]
+        Arow = A[j, :m_ub, :N]
         smin = np.minimum(Arow * lo_s[None, :], Arow * hi_s[None, :]).sum(axis=1)
         hi_k[j, N:] = np.maximum(b_ub_scaled - smin, 0.0)
 
@@ -220,18 +242,48 @@ def build_standard_form(
         Ws=[W for _, W in kWs],
         M=M,
         obj_const=arrays.obj_const,
+        moe=lay.moe,
     )
 
 
-def _round_to_incumbent(v, M, W, k, rd: RoundingData):
+def _int_redistribute(vals, rem, lo, hi, target, M):
+    """Scan that moves ``vals`` (integers in [lo, hi]) one unit at a time
+    toward ``sum(vals) == target``, preferring large fractional remainders on
+    the way up and small ones on the way down. Returns the adjusted vector;
+    the caller re-checks the sum (|residual| <= M for near-feasible LP
+    points; the scan length covers that)."""
+
+    def body(state, _):
+        v, d = state
+        add_score = jnp.where(v < hi, rem, -jnp.inf)
+        sub_score = jnp.where(v > lo, -rem, -jnp.inf)
+        i_add = jnp.argmax(add_score)
+        i_sub = jnp.argmax(sub_score)
+        v = jax.lax.cond(
+            d > 0,
+            lambda v: v.at[i_add].add(1.0),
+            lambda v: jax.lax.cond(
+                d < 0, lambda v: v.at[i_sub].add(-1.0), lambda v: v, v
+            ),
+            v,
+        )
+        return (v, d - jnp.sign(d)), None
+
+    d0 = target - vals.sum()
+    (vals, _), _ = jax.lax.scan(body, (vals, d0), None, length=M + 4)
+    return vals
+
+
+def _round_to_incumbent(v, M, W, k, rd: RoundingData, moe: bool = False):
     """Exact MILP objective of the best integer point near the LP solution v.
 
-    Given integer (w, n), the minimal feasible slacks are closed-form, and the
-    optimal continuous block is z_i = max(0, B_i + F_i - C), C = max_i(B_i +
-    F_i/2); so the heuristic's objective is exact (float64), not an LP
+    Given integer (w, n, y), the minimal feasible slacks are closed-form, and
+    the optimal continuous block is z_i = max(0, B_i + F_i - C), C = max_i(B_i
+    + F_i/2); so the heuristic's objective is exact (float64), not an LP
     approximation.
 
-    Returns (obj_linear, w, n) with obj = +inf when rounding failed.
+    Returns (obj_linear, w, n, y) with obj = +inf when rounding failed; y is
+    zeros in dense mode.
     """
     Wf = W.astype(BDTYPE)
     v = v.astype(BDTYPE)
@@ -240,37 +292,30 @@ def _round_to_incumbent(v, M, W, k, rd: RoundingData):
 
     rem = w_frac - jnp.floor(w_frac)
     w = jnp.clip(jnp.floor(w_frac), 1.0, Wf)
-
-    # Distribute the residual sum(w) - W one unit at a time (|d| <= M for a
-    # near-feasible LP point; the final validity check catches the rest).
-    def body(state, _):
-        w, d = state
-        add_score = jnp.where(w < Wf, rem, -jnp.inf)
-        sub_score = jnp.where(w > 1.0, -rem, -jnp.inf)
-        i_add = jnp.argmax(add_score)
-        i_sub = jnp.argmax(sub_score)
-        w = jax.lax.cond(
-            d > 0,
-            lambda w: w.at[i_add].add(1.0),
-            lambda w: jax.lax.cond(
-                d < 0, lambda w: w.at[i_sub].add(-1.0), lambda w: w, w
-            ),
-            w,
-        )
-        return (w, d - jnp.sign(d)), None
-
-    d0 = Wf - w.sum()
-    (w, _), _ = jax.lax.scan(body, (w, d0), None, length=M + 4)
+    w = _int_redistribute(w, rem, 1.0, Wf, Wf, M)
     valid = w.sum() == Wf
 
     n = jnp.clip(jnp.round(n_frac), 0.0, w) * rd.has_gpu
 
+    # MoE expert counts: floor + largest-remainder redistribution to sum E.
+    if moe:
+        y_frac = v[2 * M : 3 * M]
+        y_rem = y_frac - jnp.floor(y_frac)
+        y = jnp.clip(jnp.floor(y_frac), 0.0, rd.E)
+        y = _int_redistribute(y, y_rem, 0.0, rd.E, rd.E, M)
+        valid &= y.sum() == rd.E
+        g_k = rd.g_raw / k.astype(BDTYPE)
+    else:
+        y = jnp.zeros(M, BDTYPE)
+        g_k = jnp.zeros(M, BDTYPE)
+
     bp = rd.bprime
-    # RAM slack for the device's own set
-    resident = bp * w - bp * n * rd.ram_minus_n
+    # RAM slack for the device's own set (MoE: experts are resident too)
+    resident = bp * w - bp * n * rd.ram_minus_n + rd.eb * y
     viol_ram = jnp.maximum(resident - rd.ram_rhs, 0.0)
     s_ram = jnp.ceil(viol_ram / bp - 1e-9)
-    valid &= jnp.all(s_ram <= Wf)
+    s_cap = Wf + jnp.ceil(rd.eb * rd.E / bp)
+    valid &= jnp.all(s_ram <= s_cap)
 
     # VRAM slack: one t_i covers both CUDA and Metal rows
     viol_vram = jnp.maximum(
@@ -281,14 +326,15 @@ def _round_to_incumbent(v, M, W, k, rd: RoundingData):
     valid &= jnp.all(t <= Wf * rd.has_gpu + 1e-9)
 
     pen_cost = rd.pen_set * s_ram + rd.pen_vram * t
-    busy = rd.a * w + rd.b_gpu * n + pen_cost + rd.busy_const
+    lin = rd.a * w + rd.b_gpu * n + pen_cost + g_k * y
+    busy = lin + rd.busy_const
     fetch = bp / rd.s_disk * w
     C = jnp.max(busy + 0.5 * fetch)
 
     k_f = k.astype(BDTYPE)
-    obj = (k_f - 1.0) * C + jnp.sum(rd.a * w + rd.b_gpu * n + pen_cost)
+    obj = (k_f - 1.0) * C + jnp.sum(lin)
     obj = jnp.where(valid, obj, jnp.inf)
-    return obj, w, n
+    return obj, w, n, y
 
 
 class SearchState(NamedTuple):
@@ -300,6 +346,7 @@ class SearchState(NamedTuple):
     incumbent: jax.Array  # () float64 full-objective incumbent
     inc_w: jax.Array  # (M,) float64
     inc_n: jax.Array  # (M,) float64
+    inc_y: jax.Array  # (M,) float64 expert counts (zeros in dense mode)
     inc_kidx: jax.Array  # () int32
     dropped_bound: jax.Array  # () float64 min bound among overflow-dropped nodes
     per_k_best: jax.Array  # (n_k,) float64 best incumbent per k (reporting only)
@@ -313,7 +360,7 @@ class SweepData(NamedTuple):
     ``halda_solve`` calls of the same shape.
     """
 
-    A: jax.Array  # (m, nf) float32
+    A: jax.Array  # (n_k, m, nf) float32
     b_k: jax.Array  # (n_k, m) float32
     c_k: jax.Array  # (n_k, nf) float32
     int_mask: jax.Array  # (nf,) bool
@@ -355,6 +402,7 @@ def _root_state(lo_k, hi_k, M: int, cap: int) -> SearchState:
         incumbent=jnp.asarray(jnp.inf, BDTYPE),
         inc_w=jnp.zeros(M, BDTYPE),
         inc_n=jnp.zeros(M, BDTYPE),
+        inc_y=jnp.zeros(M, BDTYPE),
         inc_kidx=jnp.asarray(0, jnp.int32),
         dropped_bound=jnp.asarray(jnp.inf, BDTYPE),
         per_k_best=jnp.full(n_k, jnp.inf, BDTYPE),
@@ -378,6 +426,7 @@ def _bnb_round(
     mip_gap,
     ipm_iters: int = IPM_ITERS,
     beam: Optional[int] = None,
+    moe: bool = False,
 ) -> SearchState:
     """One batched branch-and-bound round over the frontier (pure function;
     traced inside the fused solve loop or jitted standalone by callers).
@@ -401,9 +450,10 @@ def _bnb_round(
     kidx_p = state.node_kidx[:B]
     active_p = state.active[:B]
 
+    A_p = A[kidx_p]  # (B, m, nf): per-k constraint matrices gathered per node
     b = data.b_k[kidx_p]
     c = data.c_k[kidx_p]
-    res = ipm_solve_batch(LPBatch(A=A, b=b, c=c, l=lo_p, u=hi_p), iters=ipm_iters)
+    res = ipm_solve_batch(LPBatch(A=A_p, b=b, c=c, l=lo_p, u=hi_p), iters=ipm_iters)
     bound = res.bound + obj_const
     # A diverged IPM instance reports -inf (see ops/ipm.py); fall back to the
     # inherited parent bound so the node keeps exploring instead of being
@@ -412,8 +462,8 @@ def _bnb_round(
     bound = jnp.where(active_p, jnp.maximum(bound, state.node_bound[:B]), jnp.inf)
 
     # Exact integer incumbents from every active processed node's LP point.
-    obj_lin, w_int, n_int = jax.vmap(
-        lambda v, kidx: _round_to_incumbent(v, M, Ws[kidx], ks[kidx], rd)
+    obj_lin, w_int, n_int, y_int = jax.vmap(
+        lambda v, kidx: _round_to_incumbent(v, M, Ws[kidx], ks[kidx], rd, moe=moe)
     )(res.v, kidx_p)
     obj_full = jnp.where(active_p, obj_lin + obj_const, jnp.inf)
 
@@ -423,6 +473,7 @@ def _bnb_round(
     incumbent = jnp.where(better, best_obj, state.incumbent)
     inc_w = jnp.where(better, w_int[best_i], state.inc_w)
     inc_n = jnp.where(better, n_int[best_i], state.inc_n)
+    inc_y = jnp.where(better, y_int[best_i], state.inc_y)
     inc_kidx = jnp.where(better, kidx_p[best_i], state.inc_kidx)
 
     # Per-k reporting incumbents
@@ -502,6 +553,7 @@ def _bnb_round(
         incumbent=incumbent,
         inc_w=inc_w,
         inc_n=inc_n,
+        inc_y=inc_y,
         inc_kidx=inc_kidx,
         dropped_bound=dropped_bound,
         per_k_best=per_k_best,
@@ -512,7 +564,7 @@ def _pack_blob(sf: StandardForm, rd: dict, mip_gap: float) -> np.ndarray:
     """Flatten one sweep's entire input into a single float64 vector.
 
     On a remote-tunnel TPU every host->device transfer costs a full RTT
-    (~7 ms measured), so the 19-odd arrays of a sweep are shipped as ONE
+    (~7 ms measured), so the 20-odd arrays of a sweep are shipped as ONE
     upload and sliced apart in-trace by ``_solve_packed``.
     """
     M = sf.M
@@ -530,7 +582,7 @@ def _pack_blob(sf: StandardForm, rd: dict, mip_gap: float) -> np.ndarray:
     for name in _RD_VEC_FIELDS:
         arr = np.broadcast_to(np.asarray(rd[name], np.float64), (M,))
         parts.append(arr)
-    parts.append(np.asarray([rd["bprime"]], np.float64))
+    parts.append(np.asarray([rd["bprime"], rd["E"]], np.float64))
     return np.ascontiguousarray(np.concatenate(parts))
 
 
@@ -546,12 +598,16 @@ _RD_VEC_FIELDS = (
     "cuda_rhs",
     "metal_rhs",
     "has_gpu",
+    "g_raw",
+    "eb",
 )
 
 
 @partial(
     jax.jit,
-    static_argnames=("M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam"),
+    static_argnames=(
+        "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
+    ),
 )
 def _solve_packed(
     blob: jax.Array,
@@ -563,12 +619,13 @@ def _solve_packed(
     ipm_iters: int = IPM_ITERS,
     max_rounds: int = MAX_ROUNDS,
     beam: Optional[int] = BEAM,
+    moe: bool = False,
 ) -> jax.Array:
     """One-dispatch sweep: unpack the blob, build the root state in-trace, run
     the fused B&B loop, and pack the answer into one float64 vector:
 
         [incumbent, best_bound, inc_kidx, dropped_bound,
-         inc_w (M), inc_n (M), per_k_best (n_k)]
+         inc_w (M), inc_n (M), inc_y (M), per_k_best (n_k)]
     """
     off = 0
 
@@ -578,7 +635,7 @@ def _solve_packed(
         off += n
         return s
 
-    A = take(m * nf).reshape(m, nf)
+    A = take(n_k * m * nf).reshape(n_k, m, nf)
     b_k = take(n_k * m).reshape(n_k, m)
     c_k = take(n_k * nf).reshape(n_k, nf)
     lo_k = take(n_k * nf).reshape(n_k, nf)
@@ -588,7 +645,7 @@ def _solve_packed(
     Ws = take(n_k)
     obj_const, mip_gap = take(2)
     rd_vecs = {name: take(M) for name in _RD_VEC_FIELDS}
-    bprime = take(1)[0]
+    bprime, E = take(2)
     assert off == blob.shape[0], (
         f"_pack_blob/_solve_packed layout drift: consumed {off} of {blob.shape[0]}"
     )
@@ -601,12 +658,18 @@ def _solve_packed(
         ks=ks,
         Ws=Ws,
         obj_const=obj_const,
-        rd=RoundingData(bprime=bprime, **rd_vecs),
+        rd=RoundingData(bprime=bprime, E=E, **rd_vecs),
     )
 
     state = _root_state(lo_k, hi_k, M, cap)
     state = _run_bnb_loop(
-        data, state, mip_gap, ipm_iters=ipm_iters, max_rounds=max_rounds, beam=beam
+        data,
+        state,
+        mip_gap,
+        ipm_iters=ipm_iters,
+        max_rounds=max_rounds,
+        beam=beam,
+        moe=moe,
     )
 
     return jnp.concatenate(
@@ -621,6 +684,7 @@ def _solve_packed(
             ),
             state.inc_w,
             state.inc_n,
+            state.inc_y,
             state.per_k_best,
         ]
     )
@@ -643,6 +707,7 @@ def _run_bnb_loop(
     ipm_iters: int = IPM_ITERS,
     max_rounds: int = MAX_ROUNDS,
     beam: Optional[int] = None,
+    moe: bool = False,
 ) -> SearchState:
     """``lax.while_loop`` over B&B rounds with the mip-gap test on-device.
     The single shared definition of the search loop (traced by both the
@@ -659,7 +724,9 @@ def _run_bnb_loop(
     def body(carry):
         state, i = carry
         return (
-            _bnb_round(data, state, mip_gap, ipm_iters=ipm_iters, beam=beam),
+            _bnb_round(
+                data, state, mip_gap, ipm_iters=ipm_iters, beam=beam, moe=moe
+            ),
             i + 1,
         )
 
@@ -667,7 +734,7 @@ def _run_bnb_loop(
     return state
 
 
-@partial(jax.jit, static_argnames=("ipm_iters", "max_rounds", "beam"))
+@partial(jax.jit, static_argnames=("ipm_iters", "max_rounds", "beam", "moe"))
 def _solve_fused(
     data: SweepData,
     state: SearchState,
@@ -675,11 +742,18 @@ def _solve_fused(
     ipm_iters: int = IPM_ITERS,
     max_rounds: int = MAX_ROUNDS,
     beam: Optional[int] = None,
+    moe: bool = False,
 ) -> SearchState:
     """The full branch-and-bound sweep as one device program; the host does
     one dispatch and one fetch per HALDA solve."""
     return _run_bnb_loop(
-        data, state, mip_gap, ipm_iters=ipm_iters, max_rounds=max_rounds, beam=beam
+        data,
+        state,
+        mip_gap,
+        ipm_iters=ipm_iters,
+        max_rounds=max_rounds,
+        beam=beam,
+        moe=moe,
     )
 
 
@@ -711,23 +785,23 @@ def solve_sweep_jax(
 
     sf = build_standard_form(arrays, coeffs, feasible)
     n_k = len(sf.ks)
-    m, nf = sf.A.shape
     cap = _default_cap(n_k)
 
     # One upload, one dispatch, one fetch — transfer count, not FLOPs, is
     # what a remote-tunnel TPU bills for (see _pack_blob).
-    blob = jnp.asarray(_pack_blob(sf, _rounding_arrays_np(coeffs), mip_gap))
+    blob = jnp.asarray(_pack_blob(sf, _rounding_arrays_np(coeffs, arrays.moe), mip_gap))
     out = np.asarray(
         jax.device_get(
             _solve_packed(
                 blob,
                 M=M,
                 n_k=n_k,
-                m=m,
-                nf=nf,
+                m=sf.A.shape[1],
+                nf=sf.A.shape[2],
                 cap=cap,
                 ipm_iters=ipm_iters,
                 max_rounds=max_rounds,
+                moe=sf.moe,
             )
         )
     )
@@ -755,7 +829,8 @@ def solve_sweep_jax(
     inc_k_idx = int(out[2])
     inc_w = [int(round(x)) for x in out[4 : 4 + M]]
     inc_n = [int(round(x)) for x in out[4 + M : 4 + 2 * M]]
-    per_k_best = out[4 + 2 * M : 4 + 2 * M + n_k]
+    inc_y = [int(round(x)) for x in out[4 + 2 * M : 4 + 3 * M]]
+    per_k_best = out[4 + 3 * M : 4 + 3 * M + n_k]
 
     best: Optional[ILPResult] = None
     pos_of = {kW: i for i, kW in enumerate(kWs)}
@@ -765,11 +840,14 @@ def solve_sweep_jax(
             continue
         if j == inc_k_idx:
             w, n = inc_w, inc_n
-            best = ILPResult(k=k, w=w, n=n, obj_value=obj_j)
+            y = inc_y if sf.moe else None
+            best = ILPResult(k=k, w=w, n=n, y=y, obj_value=obj_j)
+            results[pos_of[(k, W)]] = best
         else:
             # Reporting-only entry: the k didn't win; re-deriving its exact
             # integer vector would cost another solve, so carry the objective
             # with the assignment left empty.
-            w, n = [0] * M, [0] * M
-        results[pos_of[(k, W)]] = ILPResult(k=k, w=w, n=n, obj_value=obj_j)
+            results[pos_of[(k, W)]] = ILPResult(
+                k=k, w=[0] * M, n=[0] * M, obj_value=obj_j
+            )
     return results, best

@@ -1,0 +1,174 @@
+"""MoE expert+layer co-assignment: the solver extension the reference
+advertises but never built.
+
+The reference profiles per-layer expert metrics (bytes_per_expert,
+flops_per_expert, router_*, flops_per_active_expert_per_token —
+/root/reference/src/distilp/profiler/profiler/model.py:1059-1073, schema
+/root/reference/src/distilp/common/model.py:74-85) and its package
+description promises "layer/expert assignment"
+(/root/reference/pyproject.toml:4), yet ``solve_fixed_k_milp`` consumes only
+the dense scalars. This module supplies the missing formulation.
+
+Formulation (new design — there is no reference implementation):
+
+- One integer variable ``y_i`` per device: how many of the ``E`` routed
+  experts device i hosts. The split is the SAME for every MoE layer
+  (standard expert-parallel sharding: device i owns expert slice
+  [offset_i, offset_i + y_i) of each MoE layer), so ``sum_i y_i = E``.
+- Expert weights are always resident — they are needed at every MoE layer,
+  so unlike pipeline windows they cannot be disk-streamed. Device i's
+  primary memory row gains ``eb_i * y_i`` bytes, where
+  ``eb_i = (1+rho_w) * bytes_per_expert * n_moe``.
+- Compute + dispatch: with uniform routing, device i executes the share
+  ``y_i / E`` of every MoE layer's routed-expert FLOPs and receives the same
+  share of the all-to-all token dispatch. Per pipeline segment (1/k of the
+  layers, hence ``n_moe / k`` MoE layers on average) that adds
+
+      g_i(k) * y_i,   g_i(k) = (n_moe / (k * E)) * (f_exp / s_i + 2 t_comm_i)
+
+  seconds to the device's busy time B_i, where ``f_exp = experts_per_token *
+  flops_per_active_expert_per_token`` is the active-expert work of one MoE
+  layer and ``s_i`` the device's measured FLOPS. The ``1/k`` makes the busy
+  rows k-dependent — the only place the MoE MILP family loses the shared-
+  constraint-matrix property (handled by ``MilpArrays.A_ub_for_k``).
+- The dense layer costs must not double-count experts: ``adjust_model``
+  replaces the typical-layer scalars with the expert-free average layer
+  (attention + router + shared experts for MoE layers, the dense scalar for
+  dense layers), so ``w`` carries the pipeline-resident part and ``y``
+  carries the expert part.
+
+Deliberate v1 simplifications (documented, not hidden):
+- Experts charge the device's primary (RAM/unified) pool, not VRAM — a
+  ``y_gpu`` split mirroring ``n`` is future work.
+- Expert compute uses the CPU throughput table (consistent with the alpha
+  base path); the GPU delta for experts rides the same simplification.
+- Dispatch cost reuses the measured per-device ``t_comm`` scalar as the
+  all-to-all hop cost (2x: dispatch + combine).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..common import DeviceProfile, ModelProfile
+from .coeffs import RHO_W, flops_over_flops_per_s
+
+
+@dataclass
+class MoEArrays:
+    """Per-device MoE coefficients consumed by the assembler and backends."""
+
+    E: int  # routed experts per MoE layer
+    n_moe: int  # MoE layer count
+    g_raw: np.ndarray  # (M,) seconds per y-unit per segment, times k
+    eb: np.ndarray  # (M,) resident bytes per y-unit
+
+
+def model_has_moe_components(model: ModelProfile) -> bool:
+    """True when the profile carries enough MoE detail to co-assign experts."""
+    return bool(
+        model.is_moe
+        and model.n_routed_experts > 0
+        and model.total_moe_layers > 0
+        and model.bytes_per_expert
+        and model.flops_per_active_expert_per_token
+    )
+
+
+def _moe_mean(d: Optional[dict], default: float = 0.0) -> float:
+    if not d:
+        return default
+    vals = [float(v) for v in d.values()]
+    return float(np.mean(vals)) if vals else default
+
+
+def adjust_model(model: ModelProfile) -> ModelProfile:
+    """Expert-free copy of the profile for the dense (w/n) part of the MILP.
+
+    Typical-layer scalars become the average over ALL real layers of the
+    expert-free cost: MoE layers contribute attention + router + shared
+    experts; dense layers contribute the original typical scalars. KV/
+    architecture fields are untouched (attention is identical either way).
+    """
+    if not model_has_moe_components(model):
+        return model
+
+    L = max(1, model.L)
+    n_moe = model.total_moe_layers
+    n_dense = max(0, L - n_moe)
+
+    bpe = _moe_mean(model.bytes_per_expert)
+    router_b = _moe_mean(model.router_bytes)
+    shared_b = _moe_mean(model.bytes_shared_experts)
+
+    # Average attention bytes over MoE layers. moe_layer_indices are 1-based
+    # layer numbers; attn_bytes/attn_flops are 0-based length-L lists.
+    moe_idx = model.moe_layer_indices or []
+    if model.attn_bytes and moe_idx and len(model.attn_bytes) >= max(moe_idx):
+        attn_b = float(np.mean([model.attn_bytes[i - 1] for i in moe_idx]))
+    else:
+        # No component split recorded: subtract the expert block instead.
+        attn_b = max(0.0, float(model.b_layer) - model.n_routed_experts * bpe
+                     - router_b - shared_b)
+
+    b_moe_nonexp = attn_b + router_b + shared_b
+    b_layer_adj = (n_dense * float(model.b_layer) + n_moe * b_moe_nonexp) / L
+
+    # Expert-free FLOPs per batch key: attention + router + shared.
+    f_exp_act = (
+        model.experts_per_token
+        * _moe_mean(model.flops_per_active_expert_per_token)
+    )
+    f_shared = _moe_mean(model.flops_shared_experts)
+    f_router = _moe_mean(model.router_flops)
+
+    f_q_adj = {}
+    for bk, f_total in model.f_q.items():
+        if (
+            model.attn_flops
+            and bk in model.attn_flops
+            and moe_idx
+            and len(model.attn_flops[bk]) >= max(moe_idx)
+        ):
+            attn_f = float(
+                np.mean([model.attn_flops[bk][i - 1] for i in moe_idx])
+            )
+        else:
+            attn_f = max(0.0, float(f_total) - f_exp_act - f_router - f_shared)
+        f_moe_nonexp = attn_f + f_router + f_shared
+        f_q_adj[bk] = (n_dense * float(f_total) + n_moe * f_moe_nonexp) / L
+
+    return model.model_copy(
+        update={"b_layer": int(round(b_layer_adj)), "f_q": f_q_adj}
+    )
+
+
+def build_moe_arrays(
+    devs: Sequence[DeviceProfile],
+    model: ModelProfile,
+    *,
+    rho_w: float = RHO_W,
+) -> MoEArrays:
+    """Derive the per-device expert coefficients from an (unadjusted) profile."""
+    if not model_has_moe_components(model):
+        raise ValueError("model profile lacks the MoE component metrics")
+
+    M = len(devs)
+    E = model.n_routed_experts
+    n_moe = model.total_moe_layers
+    bpe = _moe_mean(model.bytes_per_expert)
+    f_exp = (
+        model.experts_per_token
+        * _moe_mean(model.flops_per_active_expert_per_token)
+    )
+
+    g_raw = np.zeros(M)
+    eb = np.zeros(M)
+    for i, d in enumerate(devs):
+        sec = flops_over_flops_per_s({"b_1": f_exp}, d.scpu, model.Q)
+        g_raw[i] = (n_moe / float(E)) * (sec + 2.0 * d.t_comm)
+        eb[i] = (1.0 + rho_w) * bpe * n_moe
+    return MoEArrays(E=E, n_moe=n_moe, g_raw=g_raw, eb=eb)

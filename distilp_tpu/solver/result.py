@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from pydantic import BaseModel
 
@@ -16,6 +16,8 @@ class ILPResult(BaseModel):
     w: List[int]
     n: List[int]
     obj_value: float
+    # MoE co-assignment: routed experts hosted per device (None in dense mode)
+    y: Optional[List[int]] = None
 
 
 class HALDAResult(BaseModel):
@@ -26,6 +28,8 @@ class HALDAResult(BaseModel):
     k: int
     obj_value: float
     sets: Dict[str, List[int]]
+    # MoE co-assignment: routed experts hosted per device (None in dense mode)
+    y: Optional[List[int]] = None
 
     def solution_text(self, devices: Sequence[DeviceProfile]) -> str:
         lines = [
@@ -49,6 +53,11 @@ class HALDAResult(BaseModel):
                 lines.append(f"  {dev.name:40s}: {ni:3d} layers on GPU")
             else:
                 lines.append(f"  {dev.name:40s}: CPU only")
+        if self.y is not None:
+            lines.append("")
+            lines.append("Expert placement (y, routed experts per MoE layer):")
+            for dev, yi in zip(devices, self.y):
+                lines.append(f"  {dev.name:40s}: {yi:3d} experts")
         lines.append("")
         lines.append("Device sets:")
         for set_name in ("M1", "M2", "M3"):

@@ -123,3 +123,47 @@ def test_solver_cli_rejects_bad_folder(tmp_path):
     from distilp_tpu.cli.solver_cli import main
 
     assert main(["--profile", str(tmp_path / "nope")]) == 2
+
+
+def test_solver_cli_moe_fixture(tmp_path):
+    # End-to-end MoE co-assignment through the CLI on the Mixtral golden
+    # folder: the solution JSON must carry the expert placement y.
+    from distilp_tpu.cli.solver_cli import main
+
+    sol = tmp_path / "solution.json"
+    rc = main(
+        [
+            "--profile",
+            str(PROFILES / "mixtral_8x7b"),
+            "--kv-bits",
+            "8bit",
+            "--mip-gap",
+            "1e-3",
+            "--save-solution",
+            str(sol),
+        ]
+    )
+    assert rc == 0
+    payload = json.loads(sol.read_text())
+    assert sum(payload["y"]) == 8
+    assert sum(payload["w"]) * payload["k"] == 32
+
+
+def test_solver_cli_moe_off(tmp_path):
+    from distilp_tpu.cli.solver_cli import main
+
+    sol = tmp_path / "solution.json"
+    rc = main(
+        [
+            "--profile",
+            str(PROFILES / "mixtral_8x7b"),
+            "--kv-bits",
+            "8bit",
+            "--moe",
+            "off",
+            "--save-solution",
+            str(sol),
+        ]
+    )
+    assert rc == 0
+    assert "y" not in json.loads(sol.read_text())
