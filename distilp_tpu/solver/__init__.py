@@ -13,9 +13,11 @@ from .coeffs import (
 )
 from .moe import MoEArrays, adjust_model, build_moe_arrays, model_has_moe_components
 from .result import HALDAResult, ILPResult
+from .streaming import StreamingReplanner
 
 __all__ = [
     "halda_solve",
+    "StreamingReplanner",
     "MoEArrays",
     "adjust_model",
     "build_moe_arrays",

@@ -35,6 +35,7 @@ def halda_solve(
     backend: Backend = "cpu",
     time_limit: Optional[float] = 3600.0,
     moe: Optional[bool] = None,
+    warm: Optional[HALDAResult] = None,
 ) -> HALDAResult:
     """Pick the best (k, w, n[, y]) placement over all candidate segment counts.
 
@@ -43,6 +44,11 @@ def halda_solve(
     dense formulation; ``moe=True`` raises if the metrics are missing. In MoE
     mode the result's ``y`` lists the routed experts hosted per device (see
     ``distilp_tpu.solver.moe`` for the formulation).
+
+    ``warm`` seeds the JAX backend with a previous solve's assignment
+    (re-priced exactly under the current profiles) so streaming re-solves
+    prune from round one; the CPU backend ignores it (scipy's MILP API has
+    no warm-start hook).
 
     Returns the assignment minimizing the modeled per-round latency; raises
     ``RuntimeError`` if no candidate k admits a feasible assignment.
@@ -86,12 +92,18 @@ def halda_solve(
                 f"(import failed: {e}); use backend='cpu'."
             ) from e
 
+        warm_ilp = None
+        if warm is not None:
+            warm_ilp = ILPResult(
+                k=warm.k, w=warm.w, n=warm.n, y=warm.y, obj_value=warm.obj_value
+            )
         results, best = solve_sweep_jax(
             arrays,
             [(k, model.L // k) for k in Ks],
             mip_gap=mip_gap if mip_gap is not None else 1e-4,
             coeffs=coeffs,
             debug=debug,
+            warm=warm_ilp,
         )
         for k, res in zip(Ks, results):
             per_k_objs.append((k, res.obj_value if res is not None else None))

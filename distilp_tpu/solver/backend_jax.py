@@ -560,14 +560,32 @@ def _bnb_round(
     )
 
 
-def _pack_blob(sf: StandardForm, rd: dict, mip_gap: float) -> np.ndarray:
+def _pack_blob(
+    sf: StandardForm,
+    rd: dict,
+    mip_gap: float,
+    warm: Optional[Tuple[int, Sequence[int], Sequence[int], Sequence[int]]] = None,
+) -> np.ndarray:
     """Flatten one sweep's entire input into a single float64 vector.
 
     On a remote-tunnel TPU every host->device transfer costs a full RTT
     (~7 ms measured), so the 20-odd arrays of a sweep are shipped as ONE
     upload and sliced apart in-trace by ``_solve_packed``.
+
+    ``warm`` = (k_index, w, n, y) seeds the incumbent: the previous round's
+    integer assignment, re-priced EXACTLY under this sweep's coefficients
+    on-device (a stale objective would break the mip-gap certificate). The
+    slot is always present (flag 0 when cold) so the blob layout is static.
     """
     M = sf.M
+    if warm is None:
+        warm_part = np.zeros(2 + 3 * M)
+    else:
+        kidx, w, n, y = warm
+        warm_part = np.concatenate(
+            [[1.0, float(kidx)], np.asarray(w, np.float64),
+             np.asarray(n, np.float64), np.asarray(y, np.float64)]
+        )
     parts = [
         sf.A.ravel(),
         sf.b_k.ravel(),
@@ -583,6 +601,7 @@ def _pack_blob(sf: StandardForm, rd: dict, mip_gap: float) -> np.ndarray:
         arr = np.broadcast_to(np.asarray(rd[name], np.float64), (M,))
         parts.append(arr)
     parts.append(np.asarray([rd["bprime"], rd["E"]], np.float64))
+    parts.append(warm_part)
     return np.ascontiguousarray(np.concatenate(parts))
 
 
@@ -646,10 +665,15 @@ def _solve_packed(
     obj_const, mip_gap = take(2)
     rd_vecs = {name: take(M) for name in _RD_VEC_FIELDS}
     bprime, E = take(2)
+    warm_flag, warm_kidx_f = take(2)
+    warm_w = take(M)
+    warm_n = take(M)
+    warm_y = take(M)
     assert off == blob.shape[0], (
         f"_pack_blob/_solve_packed layout drift: consumed {off} of {blob.shape[0]}"
     )
 
+    rd = RoundingData(bprime=bprime, E=E, **rd_vecs)
     data = SweepData(
         A=A.astype(DTYPE),
         b_k=b_k.astype(DTYPE),
@@ -658,10 +682,39 @@ def _solve_packed(
         ks=ks,
         Ws=Ws,
         obj_const=obj_const,
-        rd=RoundingData(bprime=bprime, E=E, **rd_vecs),
+        rd=rd,
     )
 
     state = _root_state(lo_k, hi_k, M, cap)
+
+    # Warm start: re-price the previous assignment under THESE coefficients
+    # (exact closed form, float64) and seed the incumbent with it. Invalid or
+    # stale-infeasible assignments price to +inf and leave the state cold.
+    warm_kidx = jnp.clip(warm_kidx_f.astype(jnp.int32), 0, n_k - 1)
+    v_warm = jnp.zeros(nf, BDTYPE)
+    v_warm = v_warm.at[:M].set(warm_w).at[M : 2 * M].set(warm_n)
+    if moe:
+        v_warm = v_warm.at[2 * M : 3 * M].set(warm_y)
+    # Seed with the vectors the pricer actually evaluated (it may have
+    # repaired the hint, e.g. redistributed y to sum E or zeroed n on a
+    # device that lost its GPU) — seeding the raw hint could return an
+    # assignment inconsistent with the certified objective.
+    warm_obj, w_rep, n_rep, y_rep = _round_to_incumbent(
+        v_warm, M, Ws[warm_kidx], ks[warm_kidx], rd, moe=moe
+    )
+    warm_obj = jnp.where(warm_flag > 0.5, warm_obj + obj_const, jnp.inf)
+    seeded = jnp.isfinite(warm_obj)
+    state = state._replace(
+        incumbent=jnp.where(seeded, warm_obj, state.incumbent),
+        inc_w=jnp.where(seeded, w_rep, state.inc_w),
+        inc_n=jnp.where(seeded, n_rep, state.inc_n),
+        inc_y=jnp.where(seeded, y_rep, state.inc_y),
+        inc_kidx=jnp.where(seeded, warm_kidx, state.inc_kidx),
+        per_k_best=state.per_k_best.at[warm_kidx].set(
+            jnp.where(seeded, warm_obj, jnp.inf)
+        ),
+    )
+
     state = _run_bnb_loop(
         data,
         state,
@@ -765,8 +818,13 @@ def solve_sweep_jax(
     ipm_iters: int = IPM_ITERS,
     max_rounds: int = MAX_ROUNDS,
     debug: bool = False,
+    warm: Optional[ILPResult] = None,
 ) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
     """Solve the whole k-sweep on the accelerator.
+
+    ``warm`` seeds the search with a previous solve's integer assignment
+    (re-priced exactly on-device under the current coefficients), so a
+    streaming re-solve prunes against a strong incumbent from round one.
 
     Returns ``(per_k_results, best)``: one entry per (k, W) pair carrying that
     k's best found incumbent objective (reporting), and the global optimum
@@ -787,9 +845,22 @@ def solve_sweep_jax(
     n_k = len(sf.ks)
     cap = _default_cap(n_k)
 
+    warm_tuple = None
+    if warm is not None and len(warm.w) == M:
+        k_index = {k: j for j, (k, _) in enumerate(feasible)}
+        if warm.k in k_index:
+            warm_tuple = (
+                k_index[warm.k],
+                warm.w,
+                warm.n,
+                warm.y if warm.y is not None else [0] * M,
+            )
+
     # One upload, one dispatch, one fetch — transfer count, not FLOPs, is
     # what a remote-tunnel TPU bills for (see _pack_blob).
-    blob = jnp.asarray(_pack_blob(sf, _rounding_arrays_np(coeffs, arrays.moe), mip_gap))
+    blob = jnp.asarray(
+        _pack_blob(sf, _rounding_arrays_np(coeffs, arrays.moe), mip_gap, warm_tuple)
+    )
     out = np.asarray(
         jax.device_get(
             _solve_packed(

@@ -1,0 +1,80 @@
+"""Streaming re-placement: the north-star end state of BASELINE.json.
+
+The reference is an offline planner — profiles in, one solve, placement out.
+On an accelerator the solve is cheap enough to sit in a loop: profiles
+stream in (device load changes, nodes join/leave, t_comm drifts), each tick
+re-solves warm-started from the previous placement, and the new assignment
+streams out. BASELINE.json's "DeepSeek-V3 MoE real-time re-placement
+(streaming profiles, 32 devices)" is this loop.
+
+Warm start semantics: the previous integer assignment is re-PRICED exactly
+under the new coefficients on-device (never trusted at its stale objective),
+then used as the initial incumbent, so branch-and-bound prunes from round
+one. When the fleet barely changed, the first certificate check usually
+passes within a round or two; when it changed shape (device count, L), the
+replanner falls back to a cold solve automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..common import DeviceProfile, ModelProfile
+from .api import halda_solve
+from .result import HALDAResult
+
+
+class StreamingReplanner:
+    """Holds the previous placement and re-solves warm on every tick.
+
+    >>> planner = StreamingReplanner(kv_bits="8bit", mip_gap=1e-3)
+    >>> placement = planner.step(devs, model)       # cold solve
+    >>> devs[3].t_comm *= 2.0                        # profile update streams in
+    >>> placement = planner.step(devs, model)       # warm re-solve
+    """
+
+    def __init__(
+        self,
+        mip_gap: float = 1e-3,
+        kv_bits: str = "8bit",
+        backend: str = "jax",
+        moe: Optional[bool] = None,
+    ) -> None:
+        self.mip_gap = mip_gap
+        self.kv_bits = kv_bits
+        self.backend = backend
+        self.moe = moe
+        self.last: Optional[HALDAResult] = None
+        self._last_shape: Optional[tuple] = None
+
+    def step(
+        self,
+        devs: Sequence[DeviceProfile],
+        model: ModelProfile,
+        k_candidates: Optional[Sequence[int]] = None,
+    ) -> HALDAResult:
+        """One tick: re-solve under the current profiles, warm when possible."""
+        from .moe import model_has_moe_components
+
+        use_moe = (
+            model_has_moe_components(model) if self.moe is None else bool(self.moe)
+        )
+        shape = (len(devs), model.L, use_moe)
+        warm = self.last if shape == self._last_shape else None
+        result = halda_solve(
+            devs,
+            model,
+            k_candidates=k_candidates,
+            mip_gap=self.mip_gap,
+            kv_bits=self.kv_bits,
+            backend=self.backend,
+            moe=self.moe,
+            warm=warm,
+        )
+        self.last = result
+        self._last_shape = shape
+        return result
+
+    def reset(self) -> None:
+        self.last = None
+        self._last_shape = None

@@ -1,0 +1,120 @@
+"""Warm-started re-solve and the streaming re-placement loop.
+
+The warm incumbent must be re-priced under the NEW coefficients (a stale
+objective would poison the mip-gap certificate), so a warm solve and a cold
+solve must certify to the same answer — warm only changes how fast.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import pytest
+
+from distilp_tpu.common import load_from_profile_folder
+from distilp_tpu.solver import StreamingReplanner, halda_solve
+from distilp_tpu.utils import make_synthetic_fleet
+
+GAP = 1e-3
+
+
+@pytest.fixture(scope="module")
+def fleet_and_model():
+    _, model = load_from_profile_folder("tests/profiles/llama_3_70b/online")
+    devs = make_synthetic_fleet(8, seed=11)
+    return devs, model
+
+
+def _close(a, b, gap=GAP):
+    return abs(a - b) <= 2 * gap * abs(b) + 1e-9
+
+
+def test_warm_matches_cold(fleet_and_model):
+    devs, model = fleet_and_model
+    cold = halda_solve(devs, model, kv_bits="4bit", mip_gap=GAP, backend="jax")
+    warm = halda_solve(
+        devs, model, kv_bits="4bit", mip_gap=GAP, backend="jax", warm=cold
+    )
+    assert _close(warm.obj_value, cold.obj_value)
+    assert sum(warm.w) * warm.k == model.L
+
+
+def test_warm_survives_profile_drift(fleet_and_model):
+    devs, model = fleet_and_model
+    prev = halda_solve(devs, model, kv_bits="4bit", mip_gap=GAP, backend="jax")
+
+    drifted = [copy.deepcopy(d) for d in devs]
+    for d in drifted:
+        d.t_comm *= 1.5
+    cold = halda_solve(drifted, model, kv_bits="4bit", mip_gap=GAP, backend="jax")
+    warm = halda_solve(
+        drifted, model, kv_bits="4bit", mip_gap=GAP, backend="jax", warm=prev
+    )
+    # The stale assignment must be re-priced, not trusted: warm == cold.
+    assert _close(warm.obj_value, cold.obj_value)
+
+
+def test_warm_with_garbage_is_ignored(fleet_and_model):
+    """A warm hint that no longer fits (wrong M) must not corrupt the solve."""
+    devs, model = fleet_and_model
+    cold = halda_solve(devs, model, kv_bits="4bit", mip_gap=GAP, backend="jax")
+    small = halda_solve(
+        devs[:2], model, kv_bits="4bit", mip_gap=GAP, backend="jax"
+    )
+    warm = halda_solve(
+        devs, model, kv_bits="4bit", mip_gap=GAP, backend="jax", warm=small
+    )
+    assert _close(warm.obj_value, cold.obj_value)
+
+
+def test_streaming_replanner_loop(fleet_and_model):
+    devs, model = fleet_and_model
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="4bit", backend="jax")
+
+    first = planner.step(devs, model)
+    assert planner.last is first
+
+    # Tick 2: drifted fleet, same shape -> warm path.
+    drifted = [copy.deepcopy(d) for d in devs]
+    for d in drifted:
+        d.t_comm *= 2.0
+    second = planner.step(drifted, model)
+    cold = halda_solve(drifted, model, kv_bits="4bit", mip_gap=GAP, backend="jax")
+    assert _close(second.obj_value, cold.obj_value)
+
+    # Tick 3: fleet shrinks -> shape change forces a cold solve, still correct.
+    third = planner.step(drifted[:4], model)
+    assert len(third.w) == 4 and sum(third.w) * third.k == model.L
+
+
+def test_streaming_replanner_moe():
+    from distilp_tpu.profiler.api import profile_model
+
+    model = profile_model(
+        "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+    devs = make_synthetic_fleet(4, seed=7)
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
+    first = planner.step(devs, model)
+    assert first.y is not None and sum(first.y) == model.n_routed_experts
+    second = planner.step(devs, model)
+    assert second.y is not None and sum(second.y) == model.n_routed_experts
+    assert _close(second.obj_value, first.obj_value)
+
+
+def test_warm_moe_from_dense_hint_repairs_y():
+    """A warm hint lacking y (e.g. from a dense solve) must be repaired to a
+    feasible expert placement, never returned raw with sum(y) != E."""
+    from distilp_tpu.profiler.api import profile_model
+
+    model = profile_model(
+        "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+    devs = make_synthetic_fleet(4, seed=7)
+    cold = halda_solve(devs, model, kv_bits="8bit", mip_gap=GAP, backend="jax")
+    hint = cold.model_copy(update={"y": None})
+    warm = halda_solve(
+        devs, model, kv_bits="8bit", mip_gap=GAP, backend="jax", warm=hint
+    )
+    assert warm.y is not None and sum(warm.y) == model.n_routed_experts
+    assert _close(warm.obj_value, cold.obj_value)
