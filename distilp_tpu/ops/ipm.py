@@ -80,6 +80,7 @@ class IPMResult(NamedTuple):
     rd_norm: jax.Array  # (B,) dual residual inf-norm (scaled system)
     mu: jax.Array  # (B,) final complementarity measure
     converged: jax.Array  # (B,) bool
+    reduced: jax.Array  # (B, n) float64 reduced costs c - A'y of the bound's dual
 
 
 def _default_tol(dtype) -> float:
@@ -98,9 +99,20 @@ def _ipm_single(A, b, c, l, u, iters: int, tol, reg):
 
     r_raw = u - l
     active = r_raw > 0  # fixed (collapsed-box) columns leave the system
-    r = jnp.where(active, r_raw, 1.0)
-    cm = jnp.where(active, c, 0.0)
     b_hat = b - A @ l  # fold lower bounds (incl. fixed values) into the RHS
+
+    # Column equilibration: scale every active column by its box width so the
+    # shifted problem lives on [0, 1]^n. Branch-and-bound instances mix boxes
+    # spanning 4 orders of magnitude (slack caps ~50, MoE expert counts up to
+    # 256, binary-ish w splits) — unscaled, the f32 normal matrix conditioning
+    # collapses and the iteration stalls with a garbage dual. The bound is
+    # scale-invariant; v and the reduced costs are mapped back below.
+    col_s = jnp.where(active, r_raw, 1.0)
+    A_orig, c_orig = A, c
+    A = A * col_s[None, :]
+    c = c * col_s
+    r = jnp.ones_like(r_raw)  # every active box is [0, 1] after scaling
+    cm = jnp.where(active, c, 0.0)
     act = active.astype(dtype)
     n_active = jnp.maximum(act.sum(), 1.0)
 
@@ -215,31 +227,33 @@ def _ipm_single(A, b, c, l, u, iters: int, tol, reg):
     rd = cm - A.T @ y - z + f
     mu = (jnp.vdot(x * act, z) + jnp.vdot(w * act, f)) / (2.0 * n_active)
 
-    # The rigorous Lagrangian bound, evaluated in float64 regardless of the
-    # iteration dtype. Valid for ANY y, so the float32 iterate only affects
-    # bound *tightness*, never soundness.
-    A64 = A.astype(BOUND_DTYPE)
+    # The rigorous Lagrangian bound, evaluated in float64 in ORIGINAL units
+    # (the equilibration above is internal to the iteration; the dual y is
+    # the same for both scalings). Valid for ANY y, so the float32 iterate
+    # only affects bound *tightness*, never soundness.
+    A64 = A_orig.astype(BOUND_DTYPE)
     y64 = y.astype(BOUND_DTYPE)
-    c64 = jnp.where(active, c, 0.0).astype(BOUND_DTYPE)
-    r64 = (r * act).astype(BOUND_DTYPE)
+    r64 = (r_raw * act).astype(BOUND_DTYPE)
     bh64 = b.astype(BOUND_DTYPE) - A64 @ l.astype(BOUND_DTYPE)
-    reduced = c64 - A64.T @ y64
+    reduced = c_orig.astype(BOUND_DTYPE) - A64.T @ y64
+    # r64 is already 0 for inactive (fixed) columns, so no extra mask needed.
     bound = bh64 @ y64 + jnp.sum(r64 * jnp.minimum(0.0, reduced))
     # A non-finite dual vector carries no information: report -inf (the
     # vacuous-but-sound bound), never NaN, so callers can prune on `bound`
     # comparisons without a NaN silently acting like "proven bad".
     bound = jnp.where(jnp.isfinite(bound), bound, -jnp.inf)
-    shift = c.astype(BOUND_DTYPE) @ l.astype(BOUND_DTYPE)
-    v = l + jnp.where(active, x, 0.0)
+    shift = c_orig.astype(BOUND_DTYPE) @ l.astype(BOUND_DTYPE)
+    v = l + jnp.where(active, col_s * x, 0.0)
 
     return IPMResult(
         v=v,
         bound=bound + shift,
-        obj=c @ v,
+        obj=c_orig @ v,
         rp_norm=jnp.max(jnp.abs(rp)),
         rd_norm=jnp.max(jnp.abs(rd * act)),
         mu=mu,
         converged=done > 0,
+        reduced=reduced,
     )
 
 
@@ -259,12 +273,17 @@ def ipm_solve_batch(
     dtype = batch.A.dtype
     tol_v = _default_tol(dtype) if tol is None else tol
     reg_v = _default_reg(dtype) if reg is None else reg
-    if batch.A.ndim == 3:
+    # TPU matmuls default to bf16 multiplication for f32 inputs; an IPM loses
+    # its dual (and with it the Lagrangian bound quality) at bf16. Force full
+    # f32 accumulation — these matrices are tiny and latency-bound, so the
+    # MXU throughput cost is irrelevant.
+    with jax.default_matmul_precision("highest"):
+        if batch.A.ndim == 3:
+            solver = jax.vmap(
+                lambda A, b, c, l, u: _ipm_single(A, b, c, l, u, iters, tol_v, reg_v)
+            )
+            return solver(batch.A, batch.b, batch.c, batch.l, batch.u)
         solver = jax.vmap(
-            lambda A, b, c, l, u: _ipm_single(A, b, c, l, u, iters, tol_v, reg_v)
+            lambda b, c, l, u: _ipm_single(batch.A, b, c, l, u, iters, tol_v, reg_v)
         )
-        return solver(batch.A, batch.b, batch.c, batch.l, batch.u)
-    solver = jax.vmap(
-        lambda b, c, l, u: _ipm_single(batch.A, b, c, l, u, iters, tol_v, reg_v)
-    )
-    return solver(batch.b, batch.c, batch.l, batch.u)
+        return solver(batch.b, batch.c, batch.l, batch.u)

@@ -52,16 +52,19 @@ from .result import ILPResult  # noqa: E402
 DTYPE = jnp.float32  # search arrays + IPM iteration dtype
 BDTYPE = jnp.float64  # certificate dtype
 
-# Fixed frontier capacity. HALDA trees are shallow (the LP optimum is
-# near-integral), so this is generous; overflow is tracked honestly via
-# ``dropped_bound`` rather than silently ignored.
-NODE_CAP = 64
+# Fixed frontier capacity. Dense HALDA trees are shallow (the LP optimum is
+# near-integral); MoE trees with large E go wider, and an overflow floors the
+# certificate at ``dropped_bound``, so capacity is generous — the beam keeps
+# per-round compute independent of it (capacity only costs sort/memory).
+NODE_CAP = 256
 MAX_ROUNDS = 48
 IPM_ITERS = 26
 FRAC_TOL = 1e-4
 # Rows of the (best-bound-sorted) frontier that get an IPM solve per round;
 # the rest pass through with their parent bound (see ``_bnb_round``).
 BEAM = 16
+# Greedy single-expert-move refinement steps on rounded MoE incumbents.
+MOE_LOCAL_MOVES = 8
 
 
 class RoundingData(NamedTuple):
@@ -286,6 +289,7 @@ def _round_to_incumbent(v, M, W, k, rd: RoundingData, moe: bool = False):
     zeros in dense mode.
     """
     Wf = W.astype(BDTYPE)
+    k_f = k.astype(BDTYPE)
     v = v.astype(BDTYPE)
     w_frac = v[:M]
     n_frac = v[M : 2 * M]
@@ -297,27 +301,10 @@ def _round_to_incumbent(v, M, W, k, rd: RoundingData, moe: bool = False):
 
     n = jnp.clip(jnp.round(n_frac), 0.0, w) * rd.has_gpu
 
-    # MoE expert counts: floor + largest-remainder redistribution to sum E.
-    if moe:
-        y_frac = v[2 * M : 3 * M]
-        y_rem = y_frac - jnp.floor(y_frac)
-        y = jnp.clip(jnp.floor(y_frac), 0.0, rd.E)
-        y = _int_redistribute(y, y_rem, 0.0, rd.E, rd.E, M)
-        valid &= y.sum() == rd.E
-        g_k = rd.g_raw / k.astype(BDTYPE)
-    else:
-        y = jnp.zeros(M, BDTYPE)
-        g_k = jnp.zeros(M, BDTYPE)
-
     bp = rd.bprime
-    # RAM slack for the device's own set (MoE: experts are resident too)
-    resident = bp * w - bp * n * rd.ram_minus_n + rd.eb * y
-    viol_ram = jnp.maximum(resident - rd.ram_rhs, 0.0)
-    s_ram = jnp.ceil(viol_ram / bp - 1e-9)
     s_cap = Wf + jnp.ceil(rd.eb * rd.E / bp)
-    valid &= jnp.all(s_ram <= s_cap)
 
-    # VRAM slack: one t_i covers both CUDA and Metal rows
+    # VRAM slack: one t_i covers both CUDA and Metal rows (independent of y)
     viol_vram = jnp.maximum(
         jnp.maximum(bp * n - rd.cuda_rhs, bp * n - rd.metal_rhs), 0.0
     )
@@ -325,15 +312,55 @@ def _round_to_incumbent(v, M, W, k, rd: RoundingData, moe: bool = False):
     t = jnp.ceil(viol_vram / bp - 1e-9)
     valid &= jnp.all(t <= Wf * rd.has_gpu + 1e-9)
 
-    pen_cost = rd.pen_set * s_ram + rd.pen_vram * t
-    lin = rd.a * w + rd.b_gpu * n + pen_cost + g_k * y
-    busy = lin + rd.busy_const
     fetch = bp / rd.s_disk * w
-    C = jnp.max(busy + 0.5 * fetch)
 
-    k_f = k.astype(BDTYPE)
-    obj = (k_f - 1.0) * C + jnp.sum(lin)
-    obj = jnp.where(valid, obj, jnp.inf)
+    if moe:
+        g_k = rd.g_raw / k_f
+    else:
+        g_k = jnp.zeros(M, BDTYPE)
+
+    def price(y_t):
+        """Exact objective of (w, n, y_t) with closed-form optimal slacks and
+        continuous block; +inf when the RAM slack cap is exceeded."""
+        resident = bp * w - bp * n * rd.ram_minus_n + rd.eb * y_t
+        viol_ram = jnp.maximum(resident - rd.ram_rhs, 0.0)
+        s_ram = jnp.ceil(viol_ram / bp - 1e-9)
+        ok = jnp.all(s_ram <= s_cap)
+        pen_cost = rd.pen_set * s_ram + rd.pen_vram * t
+        lin = rd.a * w + rd.b_gpu * n + pen_cost + g_k * y_t
+        busy = lin + rd.busy_const
+        C = jnp.max(busy + 0.5 * fetch)
+        return jnp.where(ok, (k_f - 1.0) * C + jnp.sum(lin), jnp.inf)
+
+    # MoE expert counts: floor + largest-remainder redistribution to sum E,
+    # then a greedy local search over single-expert moves i -> j. The LP
+    # point is a good region but its rounding is rarely the best lattice
+    # point when E is large (DeepSeek: E=256); a few exact-priced moves
+    # close most of that gap.
+    if moe:
+        y_frac = v[2 * M : 3 * M]
+        y_rem = y_frac - jnp.floor(y_frac)
+        y = jnp.clip(jnp.floor(y_frac), 0.0, rd.E)
+        y = _int_redistribute(y, y_rem, 0.0, rd.E, rd.E, M)
+        valid &= y.sum() == rd.E
+
+        eyeM = jnp.eye(M, dtype=BDTYPE)
+        not_diag = ~jnp.eye(M, dtype=bool)
+
+        def move(y_t, _):
+            cand = y_t[None, None, :] + eyeM[None, :, :] - eyeM[:, None, :]
+            feas = (y_t[:, None] > 0) & (y_t[None, :] < rd.E) & not_diag
+            objs = jnp.where(feas, jax.vmap(jax.vmap(price))(cand), jnp.inf)
+            flat = jnp.argmin(objs)
+            i, j = flat // M, flat % M
+            better = objs[i, j] < price(y_t) - 1e-12
+            return jnp.where(better, cand[i, j], y_t), None
+
+        y, _ = jax.lax.scan(move, y, None, length=MOE_LOCAL_MOVES)
+    else:
+        y = jnp.zeros(M, BDTYPE)
+
+    obj = jnp.where(valid, price(y), jnp.inf)
     return obj, w, n, y
 
 
@@ -493,6 +520,37 @@ def _bnb_round(
     )
     survive = active_p & (bound < threshold)
 
+    # Reduced-cost box tightening. The Lagrangian bound prices a unit move of
+    # variable j away from its bound-active side at |red_j|:
+    #     obj >= bound_raw + red_j (x_j - lo_j)     when red_j > 0
+    #     obj >= bound_raw + |red_j| (hi_j - x_j)   when red_j < 0
+    # so any x_j further than (threshold - bound_raw)/|red_j| from that side
+    # provably cannot beat the incumbent. This collapses the wide MoE y
+    # boxes ([0, E], E up to 256) orders of magnitude faster than bisection
+    # branching alone. Sound for any dual vector, like the bound itself.
+    bound_raw = res.bound + obj_const  # the bound the reduced costs certify
+    budget = threshold - bound_raw
+    budget = jnp.where(jnp.isfinite(budget) & (budget >= 0), budget, jnp.inf)[
+        :, None
+    ]
+    lo64 = lo_p.astype(BDTYPE)
+    hi64 = hi_p.astype(BDTYPE)
+    red = res.reduced
+    tight_hi = jnp.where(
+        int_mask[None, :] & (red > 1e-12),
+        jnp.floor(lo64 + budget / jnp.maximum(red, 1e-12) + 1e-9),
+        hi64,
+    )
+    tight_lo = jnp.where(
+        int_mask[None, :] & (red < -1e-12),
+        jnp.ceil(hi64 - budget / jnp.maximum(-red, 1e-12) - 1e-9),
+        lo64,
+    )
+    hi_p = jnp.minimum(hi_p, tight_hi.astype(DTYPE))
+    lo_p = jnp.maximum(lo_p, tight_lo.astype(DTYPE))
+    # An emptied box proves the node cannot beat the incumbent.
+    survive &= jnp.all(lo_p <= hi_p, axis=1)
+
     # Close nodes that are provably done: either the box is a single
     # point, or this round's rounded incumbent already achieves the
     # node's lower bound (so nothing better hides in the subtree). An
@@ -504,6 +562,9 @@ def _bnb_round(
     survive &= ~(fully_fixed | achieved)
 
     # Branch variable: most fractional if any, else the widest box.
+    # (Reduced-cost-weighted fractionality was tried and measured WORSE on
+    # the DeepSeek E=256 instance — degenerate LPs put near-zero reduced
+    # costs on exactly the variables that matter.)
     frac = jnp.abs(res.v - jnp.round(res.v))
     branchable = int_mask[None, :] & (width > 0.5)
     frac_m = jnp.where(branchable, frac, -1.0)
@@ -849,12 +910,18 @@ def solve_sweep_jax(
     if warm is not None and len(warm.w) == M:
         k_index = {k: j for j, (k, _) in enumerate(feasible)}
         if warm.k in k_index:
-            warm_tuple = (
-                k_index[warm.k],
-                warm.w,
-                warm.n,
-                warm.y if warm.y is not None else [0] * M,
-            )
+            if sf.moe:
+                E = arrays.moe.E
+                if warm.y is not None and sum(warm.y) == E:
+                    warm_y = warm.y
+                else:
+                    # Hint lacks a usable expert split (dense->MoE tick):
+                    # spread evenly HOST-side — the in-trace repair scan only
+                    # covers deficits up to ~M, far less than E can be.
+                    warm_y = [E // M + (1 if i < E % M else 0) for i in range(M)]
+            else:
+                warm_y = [0] * M
+            warm_tuple = (k_index[warm.k], warm.w, warm.n, warm_y)
 
     # One upload, one dispatch, one fetch — transfer count, not FLOPs, is
     # what a remote-tunnel TPU bills for (see _pack_blob).

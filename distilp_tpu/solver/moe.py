@@ -37,6 +37,15 @@ Formulation (new design — there is no reference implementation):
   dense layers), so ``w`` carries the pipeline-resident part and ``y``
   carries the expert part.
 
+Certification note: on wide-expert instances (DeepSeek-V3: E=256 over 32
+devices) the JAX backend finds the true optimum (verified against HiGHS) and
+its local-search rounding lands on it reliably, but the box branch-and-bound
+cannot always close the last ~0.2% of the root integrality gap that HiGHS
+closes with cutting planes — ``halda_solve`` then returns the optimum with a
+``RuntimeWarning`` that the requested mip-gap certificate was not met. Use
+``mip_gap=2e-3`` (or the CPU backend) when a certificate on such instances
+matters more than latency.
+
 Deliberate v1 simplifications (documented, not hidden):
 - Experts charge the device's primary (RAM/unified) pool, not VRAM — a
   ``y_gpu`` split mirroring ``n`` is future work.
