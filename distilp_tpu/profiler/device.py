@@ -58,19 +58,80 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
-def bench(fn: Callable[[], Any], warmup: int = 3, iters: int = 10) -> float:
-    """Median wall-clock seconds of ``fn`` with device-sync per call
-    (reference profiler/device.py:175-199)."""
+def bench(
+    fn: Callable[[], Any],
+    warmup: int = 3,
+    iters: int = 10,
+    baseline: float = 0.0,
+) -> float:
+    """Median wall-clock seconds of ``fn`` (reference profiler/device.py:
+    175-199), minus ``baseline`` (the round-trip floor on remote devices).
+
+    Completion is forced by FETCHING one element of the output, not by
+    ``block_until_ready``: on tunneled accelerator runtimes the latter
+    acknowledges before the computation finishes (measured: a 137-GFLOP
+    matmul "completed" in 0.05 ms), while a value fetch cannot lie.
+    """
     import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def run() -> None:
+        out = fn()
+        leaf = jax.tree.leaves(out)[0]
+        if isinstance(leaf, jax.Array):
+            np.asarray(jnp.ravel(leaf)[0])
+        else:
+            # Plain numpy output (e.g. a device->host fetch already done by
+            # fn): touching it through jnp would re-UPLOAD it to the default
+            # backend inside the timed region. It is already synchronous.
+            np.ravel(leaf)[:1]
 
     for _ in range(warmup):
-        jax.block_until_ready(fn())
+        run()
     times: List[float] = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        run()
         times.append(time.perf_counter() - t0)
-    return stats.median(times)
+    return max(stats.median(times) - baseline, 1e-9)
+
+
+def _fetch_baseline(backend: str) -> float:
+    """Round-trip floor of a dispatch + one-element fetch on ``backend``."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        dev = jax.devices(backend)[0]
+        x = jax.device_put(jnp.ones((8,), jnp.float32), dev)
+        probe = jax.jit(lambda v: v * 1.0)
+        return bench(lambda: probe(x), warmup=3, iters=10)
+    except Exception:
+        return 0.0
+
+
+def _chained_rate(
+    fn: Callable[[Any], Any],
+    chain: int,
+    units_per_iter: float,
+    warmup: int,
+    iters: int,
+    baseline: float,
+) -> float:
+    """Units/second of a chained kernel ``fn(chain_length)`` measured at two
+    chain lengths; the slope cancels the dispatch round-trip and per-call
+    overheads. Falls back to single-point (baseline-subtracted) timing when
+    jitter swamps the slope."""
+    import jax.numpy as jnp
+
+    c_lo = max(1, chain // 4)
+    t_hi = bench(lambda: fn(jnp.asarray(chain, jnp.int32)), warmup, iters)
+    t_lo = bench(lambda: fn(jnp.asarray(c_lo, jnp.int32)), warmup, iters)
+    dt = t_hi - t_lo
+    if dt > 0:
+        return units_per_iter * (chain - c_lo) / dt
+    return units_per_iter * chain / max(t_hi - baseline, 1e-9)
 
 
 def _gemm_flops(
@@ -82,6 +143,7 @@ def _gemm_flops(
     dtype_name: str,
     warmup: int,
     iters: int,
+    baseline: float = 0.0,
 ) -> float:
     """FLOPS of a jitted batched GEMM ``(B,M,K) @ (K,N)`` on ``backend``.
 
@@ -105,11 +167,49 @@ def _gemm_flops(
             b = jax.random.normal(kb, (K, N), dtype=dtype)
         a = jax.device_put(a, dev)
         b = jax.device_put(b, dev)
-
-        matmul = jax.jit(jnp.matmul)  # placement follows the device_put inputs
-        median = bench(lambda: matmul(a, b), warmup, iters)
         flop = 2.0 * B * N * M * K
-        result = flop / median
+
+        if key is None:
+            # Integer matmul: single call (no float feedback trick exists
+            # that XLA cannot constant-fold); RTT subtracted via baseline.
+            # Reduce via max|.| — a plain [0] slice lets XLA rewrite
+            # slice-of-dot into a one-element dot.
+            mm = jax.jit(lambda a, b: jnp.max(jnp.abs(jnp.matmul(a, b))))
+            median = bench(lambda: mm(a, b), warmup, iters, baseline=baseline)
+            result = flop / median
+        else:
+            # Chain matmuls inside ONE jitted call with FULL matrix feedback
+            # (the output, normalized, is the next input). Anything weaker is
+            # defeated: scalar feedback perturbations are either distributed
+            # out of the linear matmul and hoisted, flushed to zero
+            # (subnormal constants on TPU), or rounded into a fixed point —
+            # all observed to collapse the loop. Throughput comes from the
+            # SLOPE between two chain lengths, which cancels the dispatch
+            # round-trip (tens of ms on a tunneled TPU) and per-call
+            # overheads entirely.
+            # Local backends have ~us dispatch overhead: a short chain
+            # suffices and keeps the (slow, upcast) CPU fp16 sweep bounded.
+            if backend == "cpu":
+                chain = _env_int("DPERF_CHAIN_CPU", 2)
+            else:
+                chain = max(4, _env_int("DPERF_CHAIN", 64) // B)
+            eps = jnp.asarray(1e-6, dtype)
+
+            # Chain length is a DYNAMIC argument (fori_loop lowers a traced
+            # bound to while_loop): one compile covers both slope points —
+            # with remote compile times in seconds, recompiling per chain
+            # length dominated the whole profiling run.
+            @jax.jit
+            def chained(x, b, c):
+                def body(_, x):
+                    y = jnp.matmul(x, b)
+                    return y / (jnp.max(jnp.abs(y)) + eps)
+
+                return jax.lax.fori_loop(0, c, body, x).ravel()[0]
+
+            result = _chained_rate(
+                lambda c: chained(a, b, c), chain, flop, warmup, iters, baseline
+            )
         del a, b
         gc.collect()
         return result
@@ -120,15 +220,16 @@ def _gemm_flops(
 def run_host_benchmarks(di: DeviceInfo, n_embd: int, max_batch_exp: int) -> None:
     """CPU GEMM sweep (reference run_cpu_benchmarks, :142-155)."""
     size = int(n_embd / 8 if n_embd >= 4096 else 4096 / 8)
-    warmup = _env_int("DPERF_GEMM_WARMUP", 3)
-    iters = _env_int("DPERF_GEMM_ITERS", 10)
+    warmup = _env_int("DPERF_GEMM_WARMUP", 1)
+    iters = _env_int("DPERF_GEMM_ITERS", 4)
+    base = _fetch_baseline("cpu")
     for tag, dtype in [("f32", "float32"), ("fp16", "float16"), ("bf16", "bfloat16"), ("u32", "uint32")]:
         table: Batches = getattr(di.cpu.benchmarks, tag)
         for exp in range(min(max_batch_exp, len(_BATCH_TAGS))):
             setattr(
                 table,
                 _BATCH_TAGS[exp],
-                _gemm_flops("cpu", 2**exp, size, size, size, dtype, warmup, iters),
+                _gemm_flops("cpu", 2**exp, size, size, size, dtype, warmup, iters, base),
             )
 
 
@@ -140,15 +241,16 @@ def run_accel_benchmarks(di: DeviceInfo, n_embd: int, max_batch_exp: int) -> Non
     if backend == "cpu":
         return
     size = n_embd if n_embd >= 4096 else 4096
-    warmup = _env_int("DPERF_GEMM_WARMUP", 3)
-    iters = _env_int("DPERF_GEMM_ITERS", 10)
+    warmup = _env_int("DPERF_GEMM_WARMUP", 1)
+    iters = _env_int("DPERF_GEMM_ITERS", 4)
+    base = _fetch_baseline(backend)
     for tag, dtype in [("f32", "float32"), ("fp16", "float16"), ("bf16", "bfloat16"), ("u32", "uint32")]:
         table = getattr(di.gpu.benchmarks, tag)
         for exp in range(min(max_batch_exp, len(_BATCH_TAGS))):
             setattr(
                 table,
                 _BATCH_TAGS[exp],
-                _gemm_flops(backend, 2**exp, size, size, size, dtype, warmup, iters),
+                _gemm_flops(backend, 2**exp, size, size, size, dtype, warmup, iters, base),
             )
 
 
@@ -168,6 +270,8 @@ def get_sysmem_info(di: DeviceInfo) -> None:
     di.memory.can_swap = 1 if sm.total > 0 else 0
 
     cpu = jax.devices("cpu")[0]
+    _fetch_baseline("cpu")  # warm the trace/compile of the sync path before
+    # the one-shot cold probes below, so they time memory, not tracing
     mb = _env_int("DPERF_MEM_MB", 128)
     n = (mb * 1024 * 1024) // 4
     A = jax.device_put(jnp.ones((n,), dtype=jnp.float32), cpu)
@@ -175,7 +279,7 @@ def get_sysmem_info(di: DeviceInfo) -> None:
 
     read = jax.jit(jnp.max)  # runs on the CPU: A is CPU-resident
     di.memory.cpu_read_cold_bw = nbytes / bench(lambda: read(A), 0, 1)
-    warm_read = jax.jit(jnp.abs)
+    warm_read = jax.jit(jnp.sum)  # scalar output: bench() fetches it to sync
     di.memory.cpu_read_warm_bw = nbytes / bench(lambda: warm_read(A), 5, 10)
 
     # No input to anchor placement: pin the fill's output to the CPU device.
@@ -243,10 +347,38 @@ def accel_get_memory_info(di: DeviceInfo) -> None:
         ms = dev.memory_stats() or {}
         total = ms.get("bytes_limit", 0)
         in_use = ms.get("bytes_in_use", 0)
-        di.gpu.memory.total = float(total)
-        di.gpu.memory.free = float(max(total - in_use, 0))
     except Exception:
-        pass
+        total = in_use = 0
+    if total <= 0:
+        # Some runtimes (remote/tunneled TPUs) expose no memory_stats; fall
+        # back to the known per-chip HBM of the device kind. Overridable via
+        # DPERF_HBM_BYTES for unlisted parts.
+        total = _env_int("DPERF_HBM_BYTES", _hbm_by_kind(dev.device_kind))
+        in_use = 0
+    di.gpu.memory.total = float(total)
+    di.gpu.memory.free = float(max(total - in_use, 0))
+
+
+# Known HBM per chip, bytes. Keys are matched as lowercase substrings of
+# ``Device.device_kind`` (e.g. "TPU v5 lite" -> v5e, 16 GiB).
+_HBM_TABLE = (
+    ("v5 lite", 16 << 30),
+    ("v5e", 16 << 30),
+    ("v5p", 95 << 30),
+    ("v6 lite", 32 << 30),
+    ("v6e", 32 << 30),
+    ("v4", 32 << 30),
+    ("v3", 32 << 30),
+    ("v2", 16 << 30),
+)
+
+
+def _hbm_by_kind(kind: str) -> int:
+    k = (kind or "").lower()
+    for pat, size in _HBM_TABLE:
+        if pat in k:
+            return size
+    return 0
 
 
 def accel_bench_mem_to_compute(di: DeviceInfo) -> None:
@@ -263,8 +395,22 @@ def accel_bench_mem_to_compute(di: DeviceInfo) -> None:
     n = (mb * 1024 * 1024) // 4
     try:
         A = jax.device_put(jnp.ones((n,), dtype=jnp.float32), dev)
-        reduce = jax.jit(jnp.sum)  # placement follows the device_put input
-        di.gpu.memory.vram_to_compute = (n * 4) / bench(lambda: reduce(A), 2, 8)
+        # Chained full-feedback data movement (roll carries the array through
+        # the loop, so nothing can be hoisted or folded), timed at two chain
+        # lengths; the slope cancels the dispatch round-trip. Each iteration
+        # reads and writes the array once -> 2 passes of n*4 bytes.
+        chain = 8 * _env_int("DPERF_CHAIN", 8)
+
+        @jax.jit
+        def rolled(x, c):
+            def body(_, x):
+                return jnp.roll(x, 1)
+
+            return jax.lax.fori_loop(0, c, body, x)[0]
+
+        di.gpu.memory.vram_to_compute = _chained_rate(
+            lambda c: rolled(A, c), chain, 2 * n * 4, 2, 6, _fetch_baseline(backend)
+        )
         del A
         gc.collect()
     except Exception:

@@ -71,11 +71,16 @@ def measure_interconnect(
                 out_specs=P(None),
             )
         )
-        jax.block_until_ready(f(tiny))  # compile
+        # Sync by FETCHING one element, not block_until_ready: tunneled
+        # runtimes acknowledge before completion (see profiler.device.bench).
+        def sync(out):
+            np.asarray(jnp.ravel(out)[0])
+
+        sync(f(tiny))  # compile
         times = []
         for _ in range(latency_iters):
             t0 = time.perf_counter()
-            jax.block_until_ready(f(tiny))
+            sync(f(tiny))
             times.append(time.perf_counter() - t0)
         info.ici_allreduce_latency_s = sorted(times)[len(times) // 2]
 
@@ -94,9 +99,9 @@ def measure_interconnect(
                 check_vma=False,  # output is replicated; inference can't prove it
             )
         )
-        jax.block_until_ready(g(big))  # compile
+        sync(g(big))  # compile
         t0 = time.perf_counter()
-        jax.block_until_ready(g(big))
+        sync(g(big))
         dt = time.perf_counter() - t0
         # Each device receives (n-1) remote shards of per_dev floats.
         info.ici_bandwidth = (n - 1) * per_dev * 4 / dt if dt > 0 else 0.0
