@@ -72,8 +72,10 @@ def solve_sweep_sharded(
     coeffs,
     mesh: Mesh,
     mip_gap: float = 1e-3,
-    ipm_iters: int = 26,
+    ipm_iters: Optional[int] = None,
     max_rounds: int = 48,
+    beam: Optional[int] = None,
+    node_cap: Optional[int] = None,
 ):
     """Run the fused B&B sweep with the frontier sharded across ``mesh``.
 
@@ -81,16 +83,22 @@ def solve_sweep_sharded(
     the only difference is input placement — the frontier arrays enter
     node-sharded and GSPMD partitions the batched IPM along the node axis,
     turning the incumbent/compaction reductions into ICI collectives.
+
+    ``beam``/``ipm_iters``/``node_cap`` default like the unsharded backend
+    (``default_search_params``), except the beam is rounded up to a multiple
+    of the mesh size so every device solves the same number of frontier rows
+    (GSPMD shards the IPM batch along the node axis), and the cap to a
+    multiple likewise.
     """
     import jax.numpy as jnp
 
     from ..solver.backend_jax import (
         BDTYPE,
-        NODE_CAP,
         _init_state,
         _solve_fused,
         _sweep_data,
         build_standard_form,
+        default_search_params,
         rounding_data,
     )
 
@@ -100,16 +108,30 @@ def solve_sweep_sharded(
         raise RuntimeError("No feasible MILP found for any k.")
 
     sf = build_standard_form(arrays, coeffs, feasible)
+    d_cap, d_beam, d_iters = default_search_params(sf.moe, len(sf.ks))
+    cap = pad_cap_to_mesh(
+        max(node_cap if node_cap is not None else d_cap, 2 * len(sf.ks)), mesh
+    )
+    beam = beam if beam is not None else d_beam
+    beam = min(pad_cap_to_mesh(beam, mesh), cap)
+    ipm_iters = ipm_iters if ipm_iters is not None else d_iters
+
     data = _sweep_data(sf, rounding_data(coeffs, arrays.moe))
     gap = jnp.asarray(mip_gap, BDTYPE)
 
-    state = _init_state(sf, cap=pad_cap_to_mesh(max(NODE_CAP, 2 * len(sf.ks)), mesh))
+    state = _init_state(sf, cap=cap)
     state = shard_state(state, mesh)
     replicated = NamedSharding(mesh, P())
     data = jax.tree.map(lambda x: jax.device_put(x, replicated), data)
 
     with mesh:
         state = _solve_fused(
-            data, state, gap, ipm_iters=ipm_iters, max_rounds=max_rounds, moe=sf.moe
+            data,
+            state,
+            gap,
+            ipm_iters=ipm_iters,
+            max_rounds=max_rounds,
+            beam=beam,
+            moe=sf.moe,
         )
     return state, sf

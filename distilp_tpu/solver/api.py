@@ -36,6 +36,10 @@ def halda_solve(
     time_limit: Optional[float] = 3600.0,
     moe: Optional[bool] = None,
     warm: Optional[HALDAResult] = None,
+    max_rounds: Optional[int] = None,
+    beam: Optional[int] = None,
+    ipm_iters: Optional[int] = None,
+    node_cap: Optional[int] = None,
 ) -> HALDAResult:
     """Pick the best (k, w, n[, y]) placement over all candidate segment counts.
 
@@ -50,7 +54,17 @@ def halda_solve(
     prune from round one; the CPU backend ignores it (scipy's MILP API has
     no warm-start hook).
 
-    Returns the assignment minimizing the modeled per-round latency; raises
+    JAX-backend search controls (all ``None`` = problem-class defaults, see
+    ``backend_jax.default_search_params``; the CPU backend ignores them):
+
+    - ``max_rounds``: branch-and-bound round budget. Raise it when a solve
+      warns that the mip-gap certificate was not met.
+    - ``beam``: frontier rows that get an IPM solve per round.
+    - ``ipm_iters``: interior-point iterations per LP relaxation.
+    - ``node_cap``: frontier capacity (overflow floors the certificate).
+
+    Returns the assignment minimizing the modeled per-round latency, with
+    ``certified``/``gap`` reporting the optimality certificate; raises
     ``RuntimeError`` if no candidate k admits a feasible assignment.
     """
     use_moe = model_has_moe_components(model) if moe is None else bool(moe)
@@ -104,6 +118,10 @@ def halda_solve(
             coeffs=coeffs,
             debug=debug,
             warm=warm_ilp,
+            max_rounds=max_rounds,
+            beam=beam,
+            ipm_iters=ipm_iters,
+            node_cap=node_cap,
         )
         for k, res in zip(Ks, results):
             per_k_objs.append((k, res.obj_value if res is not None else None))
@@ -139,6 +157,8 @@ def halda_solve(
         obj_value=best.obj_value,
         sets={name: list(v) for name, v in sets.items()},
         y=list(best.y) if best.y is not None else None,
+        certified=best.certified,
+        gap=best.gap,
     )
 
     if plot:

@@ -67,6 +67,21 @@ BEAM = 16
 MOE_LOCAL_MOVES = 8
 
 
+def default_search_params(moe: bool, n_k: int) -> Tuple[int, int, int]:
+    """(node_cap, beam, ipm_iters) defaults by problem class.
+
+    Dense HALDA trees certify in a couple of rounds with a handful of live
+    nodes, so a small frontier and a short IPM keep the one-dispatch program
+    lean (measured on the v5e north-star instance: cap 64 / beam 8 / 14 iters
+    certifies identically to cap 256 / beam 16 / 26 and shaves ~1/3 of the
+    device program). Wide-expert MoE instances (E up to 256) need the full
+    budget. Callers override any of these through ``halda_solve``.
+    """
+    if moe:
+        return NODE_CAP, BEAM, IPM_ITERS
+    return max(64, 2 * n_k), 8, 14
+
+
 class RoundingData(NamedTuple):
     """Exact per-device MILP data for the integer rounding heuristic.
 
@@ -387,7 +402,7 @@ class SweepData(NamedTuple):
     ``halda_solve`` calls of the same shape.
     """
 
-    A: jax.Array  # (n_k, m, nf) float32
+    A: jax.Array  # (m, nf) float32 shared (dense) or (n_k, m, nf) per-k (MoE)
     b_k: jax.Array  # (n_k, m) float32
     c_k: jax.Array  # (n_k, nf) float32
     int_mask: jax.Array  # (nf,) bool
@@ -399,7 +414,7 @@ class SweepData(NamedTuple):
 
 def _sweep_data(sf: StandardForm, rd: RoundingData) -> SweepData:
     return SweepData(
-        A=jnp.asarray(sf.A, DTYPE),
+        A=jnp.asarray(sf.A if sf.moe else sf.A[0], DTYPE),
         b_k=jnp.asarray(sf.b_k, DTYPE),
         c_k=jnp.asarray(sf.c_k, DTYPE),
         int_mask=jnp.asarray(sf.int_mask),
@@ -477,7 +492,9 @@ def _bnb_round(
     kidx_p = state.node_kidx[:B]
     active_p = state.active[:B]
 
-    A_p = A[kidx_p]  # (B, m, nf): per-k constraint matrices gathered per node
+    # Dense mode shares one (m, nf) A across every k (the IPM broadcasts a
+    # 2-D A); the MoE family gathers its per-k matrices per node.
+    A_p = A if A.ndim == 2 else A[kidx_p]
     b = data.b_k[kidx_p]
     c = data.c_k[kidx_p]
     res = ipm_solve_batch(LPBatch(A=A_p, b=b, c=c, l=lo_p, u=hi_p), iters=ipm_iters)
@@ -627,43 +644,64 @@ def _pack_blob(
     mip_gap: float,
     warm: Optional[Tuple[int, Sequence[int], Sequence[int], Sequence[int]]] = None,
 ) -> np.ndarray:
-    """Flatten one sweep's entire input into a single float64 vector.
+    """Flatten one sweep's entire input into a single float32 vector.
 
-    On a remote-tunnel TPU every host->device transfer costs a full RTT
-    (~7 ms measured), so the 20-odd arrays of a sweep are shipped as ONE
-    upload and sliced apart in-trace by ``_solve_packed``.
+    On a remote-tunnel TPU the transfer (not FLOPs) is what a solve is
+    billed for, so the 20-odd arrays of a sweep are shipped as ONE upload
+    and sliced apart in-trace by ``_solve_packed``. Two size levers beyond
+    the single-transfer rule:
+
+    - The search arrays (A, b, c, boxes) ship as float32 — the IPM iterates
+      in f32 anyway, so precision is unchanged and the dominant A block
+      halves.
+    - In dense mode A is k-independent (``MilpArrays.A_ub_for_k`` returns
+      the same matrix and the row scaling is k-independent too), so ONE
+      copy ships instead of n_k; the MoE family keeps per-k copies (the
+      expert busy coefficients scale with 1/k).
+    - The certificate inputs (rounding data, obj_const, ks/Ws, warm hint)
+      must stay float64: they ride along as raw f64 *bit pairs* in the f32
+      vector and are bitcast back in-trace. (On this TPU runtime f64 is
+      stored double-double anyway, so the bit-pair trip loses nothing the
+      direct f64 upload wouldn't.)
 
     ``warm`` = (k_index, w, n, y) seeds the incumbent: the previous round's
     integer assignment, re-priced EXACTLY under this sweep's coefficients
     on-device (a stale objective would break the mip-gap certificate). The
-    slot is always present (flag 0 when cold) so the blob layout is static.
+    slot is packed only when present; ``has_warm`` is a static jit arg so
+    each layout compiles once.
     """
     M = sf.M
-    if warm is None:
-        warm_part = np.zeros(2 + 3 * M)
-    else:
-        kidx, w, n, y = warm
-        warm_part = np.concatenate(
-            [[1.0, float(kidx)], np.asarray(w, np.float64),
-             np.asarray(n, np.float64), np.asarray(y, np.float64)]
-        )
-    parts = [
-        sf.A.ravel(),
+    A_part = sf.A[:1] if not sf.moe else sf.A  # dense: one shared copy
+    f32_parts = [
+        A_part.ravel(),
         sf.b_k.ravel(),
         sf.c_k.ravel(),
         sf.lo_k.ravel(),
         sf.hi_k.ravel(),
-        sf.int_mask.astype(np.float64),
+        sf.int_mask.astype(np.float32),
+    ]
+    f64_parts = [
         np.asarray(sf.ks, np.float64),
         np.asarray(sf.Ws, np.float64),
         np.asarray([sf.obj_const, mip_gap], np.float64),
     ]
     for name in _RD_VEC_FIELDS:
-        arr = np.broadcast_to(np.asarray(rd[name], np.float64), (M,))
-        parts.append(arr)
-    parts.append(np.asarray([rd["bprime"], rd["E"]], np.float64))
-    parts.append(warm_part)
-    return np.ascontiguousarray(np.concatenate(parts))
+        f64_parts.append(np.broadcast_to(np.asarray(rd[name], np.float64), (M,)))
+    f64_parts.append(np.asarray([rd["bprime"], rd["E"]], np.float64))
+    if warm is not None:
+        kidx, w, n, y = warm
+        f64_parts.append(
+            np.concatenate(
+                [[float(kidx)], np.asarray(w, np.float64),
+                 np.asarray(n, np.float64), np.asarray(y, np.float64)]
+            )
+        )
+    f64_bits = np.ascontiguousarray(
+        np.concatenate(f64_parts, dtype=np.float64)
+    ).view(np.float32)
+    return np.concatenate(
+        [np.concatenate(f32_parts, dtype=np.float32), f64_bits]
+    )
 
 
 _RD_VEC_FIELDS = (
@@ -687,6 +725,7 @@ _RD_VEC_FIELDS = (
     jax.jit,
     static_argnames=(
         "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
+        "has_warm",
     ),
 )
 def _solve_packed(
@@ -700,6 +739,7 @@ def _solve_packed(
     max_rounds: int = MAX_ROUNDS,
     beam: Optional[int] = BEAM,
     moe: bool = False,
+    has_warm: bool = False,
 ) -> jax.Array:
     """One-dispatch sweep: unpack the blob, build the root state in-trace, run
     the fused B&B loop, and pack the answer into one float64 vector:
@@ -709,36 +749,53 @@ def _solve_packed(
     """
     off = 0
 
-    def take(n):
+    def take32(n):
         nonlocal off
         s = blob[off : off + n]
         off += n
         return s
 
-    A = take(n_k * m * nf).reshape(n_k, m, nf)
-    b_k = take(n_k * m).reshape(n_k, m)
-    c_k = take(n_k * nf).reshape(n_k, nf)
-    lo_k = take(n_k * nf).reshape(n_k, nf)
-    hi_k = take(n_k * nf).reshape(n_k, nf)
-    int_mask = take(nf) > 0.5
+    n_A = n_k if moe else 1
+    A = take32(n_A * m * nf).reshape(n_A, m, nf)
+    if not moe:
+        A = A[0]  # shared across k; _bnb_round handles the 2-D case
+    b_k = take32(n_k * m).reshape(n_k, m)
+    c_k = take32(n_k * nf).reshape(n_k, nf)
+    lo_k = take32(n_k * nf).reshape(n_k, nf)
+    hi_k = take32(n_k * nf).reshape(n_k, nf)
+    int_mask = take32(nf) > 0.5
+
+    # Everything certificate-critical rides as f64 bit pairs (see _pack_blob).
+    f64v = jax.lax.bitcast_convert_type(
+        blob[off:].reshape(-1, 2), jnp.float64
+    )
+    off64 = 0
+
+    def take(n):
+        nonlocal off64
+        s = f64v[off64 : off64 + n]
+        off64 += n
+        return s
+
     ks = take(n_k)
     Ws = take(n_k)
     obj_const, mip_gap = take(2)
     rd_vecs = {name: take(M) for name in _RD_VEC_FIELDS}
     bprime, E = take(2)
-    warm_flag, warm_kidx_f = take(2)
-    warm_w = take(M)
-    warm_n = take(M)
-    warm_y = take(M)
-    assert off == blob.shape[0], (
-        f"_pack_blob/_solve_packed layout drift: consumed {off} of {blob.shape[0]}"
+    if has_warm:
+        warm_kidx_f = take(1)[0]
+        warm_w = take(M)
+        warm_n = take(M)
+        warm_y = take(M)
+    assert off64 == f64v.shape[0], (
+        f"_pack_blob/_solve_packed layout drift: consumed {off64} of {f64v.shape[0]}"
     )
 
     rd = RoundingData(bprime=bprime, E=E, **rd_vecs)
     data = SweepData(
-        A=A.astype(DTYPE),
-        b_k=b_k.astype(DTYPE),
-        c_k=c_k.astype(DTYPE),
+        A=A,
+        b_k=b_k,
+        c_k=c_k,
         int_mask=int_mask,
         ks=ks,
         Ws=Ws,
@@ -748,33 +805,35 @@ def _solve_packed(
 
     state = _root_state(lo_k, hi_k, M, cap)
 
-    # Warm start: re-price the previous assignment under THESE coefficients
-    # (exact closed form, float64) and seed the incumbent with it. Invalid or
-    # stale-infeasible assignments price to +inf and leave the state cold.
-    warm_kidx = jnp.clip(warm_kidx_f.astype(jnp.int32), 0, n_k - 1)
-    v_warm = jnp.zeros(nf, BDTYPE)
-    v_warm = v_warm.at[:M].set(warm_w).at[M : 2 * M].set(warm_n)
-    if moe:
-        v_warm = v_warm.at[2 * M : 3 * M].set(warm_y)
-    # Seed with the vectors the pricer actually evaluated (it may have
-    # repaired the hint, e.g. redistributed y to sum E or zeroed n on a
-    # device that lost its GPU) — seeding the raw hint could return an
-    # assignment inconsistent with the certified objective.
-    warm_obj, w_rep, n_rep, y_rep = _round_to_incumbent(
-        v_warm, M, Ws[warm_kidx], ks[warm_kidx], rd, moe=moe
-    )
-    warm_obj = jnp.where(warm_flag > 0.5, warm_obj + obj_const, jnp.inf)
-    seeded = jnp.isfinite(warm_obj)
-    state = state._replace(
-        incumbent=jnp.where(seeded, warm_obj, state.incumbent),
-        inc_w=jnp.where(seeded, w_rep, state.inc_w),
-        inc_n=jnp.where(seeded, n_rep, state.inc_n),
-        inc_y=jnp.where(seeded, y_rep, state.inc_y),
-        inc_kidx=jnp.where(seeded, warm_kidx, state.inc_kidx),
-        per_k_best=state.per_k_best.at[warm_kidx].set(
-            jnp.where(seeded, warm_obj, jnp.inf)
-        ),
-    )
+    if has_warm:
+        # Warm start: re-price the previous assignment under THESE
+        # coefficients (exact closed form, float64) and seed the incumbent
+        # with it. Invalid or stale-infeasible assignments price to +inf and
+        # leave the state cold.
+        warm_kidx = jnp.clip(warm_kidx_f.astype(jnp.int32), 0, n_k - 1)
+        v_warm = jnp.zeros(nf, BDTYPE)
+        v_warm = v_warm.at[:M].set(warm_w).at[M : 2 * M].set(warm_n)
+        if moe:
+            v_warm = v_warm.at[2 * M : 3 * M].set(warm_y)
+        # Seed with the vectors the pricer actually evaluated (it may have
+        # repaired the hint, e.g. redistributed y to sum E or zeroed n on a
+        # device that lost its GPU) — seeding the raw hint could return an
+        # assignment inconsistent with the certified objective.
+        warm_obj, w_rep, n_rep, y_rep = _round_to_incumbent(
+            v_warm, M, Ws[warm_kidx], ks[warm_kidx], rd, moe=moe
+        )
+        warm_obj = warm_obj + obj_const
+        seeded = jnp.isfinite(warm_obj)
+        state = state._replace(
+            incumbent=jnp.where(seeded, warm_obj, state.incumbent),
+            inc_w=jnp.where(seeded, w_rep, state.inc_w),
+            inc_n=jnp.where(seeded, n_rep, state.inc_n),
+            inc_y=jnp.where(seeded, y_rep, state.inc_y),
+            inc_kidx=jnp.where(seeded, warm_kidx, state.inc_kidx),
+            per_k_best=state.per_k_best.at[warm_kidx].set(
+                jnp.where(seeded, warm_obj, jnp.inf)
+            ),
+        )
 
     state = _run_bnb_loop(
         data,
@@ -876,8 +935,10 @@ def solve_sweep_jax(
     kWs: Sequence[Tuple[int, int]],
     mip_gap: float = 1e-4,
     coeffs: Optional[HaldaCoeffs] = None,
-    ipm_iters: int = IPM_ITERS,
-    max_rounds: int = MAX_ROUNDS,
+    ipm_iters: Optional[int] = None,
+    max_rounds: Optional[int] = None,
+    beam: Optional[int] = None,
+    node_cap: Optional[int] = None,
     debug: bool = False,
     warm: Optional[ILPResult] = None,
 ) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
@@ -887,11 +948,16 @@ def solve_sweep_jax(
     (re-priced exactly on-device under the current coefficients), so a
     streaming re-solve prunes against a strong incumbent from round one.
 
+    ``ipm_iters`` / ``beam`` / ``node_cap`` default by problem class (see
+    ``default_search_params``); ``max_rounds`` caps the B&B rounds. All four
+    are reachable from the public API (``halda_solve``).
+
     Returns ``(per_k_results, best)``: one entry per (k, W) pair carrying that
-    k's best found incumbent objective (reporting), and the global optimum
-    with its integer assignment and the mip-gap certificate. Ks whose
-    subproblem is structurally infeasible (W < M: fewer layers per segment
-    than devices) come back as None.
+    k's best found incumbent objective (reporting-only — ``w``/``n`` are
+    ``None`` for non-winning k's, see ``ILPResult``), and the global optimum
+    with its integer assignment and the mip-gap certificate (``certified`` /
+    ``gap``). Ks whose subproblem is structurally infeasible (W < M: fewer
+    layers per segment than devices) come back as None.
     """
     if coeffs is None:
         raise ValueError("solve_sweep_jax requires the HaldaCoeffs used for assembly")
@@ -904,10 +970,14 @@ def solve_sweep_jax(
 
     sf = build_standard_form(arrays, coeffs, feasible)
     n_k = len(sf.ks)
-    cap = _default_cap(n_k)
+    d_cap, d_beam, d_iters = default_search_params(sf.moe, n_k)
+    cap = max(node_cap, n_k) if node_cap is not None else d_cap
+    beam = beam if beam is not None else d_beam
+    ipm_iters = ipm_iters if ipm_iters is not None else d_iters
+    max_rounds = max_rounds if max_rounds is not None else MAX_ROUNDS
 
     warm_tuple = None
-    if warm is not None and len(warm.w) == M:
+    if warm is not None and warm.w is not None and len(warm.w) == M:
         k_index = {k: j for j, (k, _) in enumerate(feasible)}
         if warm.k in k_index:
             if sf.moe:
@@ -939,7 +1009,9 @@ def solve_sweep_jax(
                 cap=cap,
                 ipm_iters=ipm_iters,
                 max_rounds=max_rounds,
+                beam=beam,
                 moe=sf.moe,
+                has_warm=warm_tuple is not None,
             )
         )
     )
@@ -950,7 +1022,13 @@ def solve_sweep_jax(
         print(f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f}")
     if not np.isfinite(incumbent):
         return results, None
-    if incumbent - best_bound > mip_gap * abs(incumbent) + 1e-12:
+    achieved_gap = (
+        (incumbent - best_bound) / abs(incumbent) if incumbent != 0.0
+        else incumbent - best_bound
+    )
+    achieved_gap = max(0.0, achieved_gap)
+    certified = incumbent - best_bound <= mip_gap * abs(incumbent) + 1e-12
+    if not certified:
         # Search exhausted max_rounds (or overflowed the frontier) without
         # closing the gap; the incumbent is still the best found integer
         # point, but the certificate failed — say so instead of implying it.
@@ -958,8 +1036,10 @@ def solve_sweep_jax(
 
         warnings.warn(
             f"HALDA jax backend: mip-gap certificate NOT met "
-            f"(incumbent={incumbent:.6g}, bound={best_bound:.6g}, "
-            f"requested gap={mip_gap:g}); raise max_rounds or mip_gap.",
+            f"(incumbent={incumbent:.6g}, bound={best_bound:.6g}, achieved "
+            f"gap={achieved_gap:.3g}, requested {mip_gap:g}); raise "
+            f"halda_solve(max_rounds=..., node_cap=...) or relax mip_gap. "
+            f"The result carries certified=False and the achieved gap.",
             RuntimeWarning,
             stacklevel=2,
         )
@@ -977,15 +1057,17 @@ def solve_sweep_jax(
         if not np.isfinite(obj_j):
             continue
         if j == inc_k_idx:
-            w, n = inc_w, inc_n
             y = inc_y if sf.moe else None
-            best = ILPResult(k=k, w=w, n=n, y=y, obj_value=obj_j)
+            best = ILPResult(
+                k=k, w=inc_w, n=inc_n, y=y, obj_value=obj_j,
+                certified=certified, gap=achieved_gap,
+            )
             results[pos_of[(k, W)]] = best
         else:
             # Reporting-only entry: the k didn't win; re-deriving its exact
             # integer vector would cost another solve, so carry the objective
-            # with the assignment left empty.
+            # with the assignment explicitly absent (w=n=None, uncertified).
             results[pos_of[(k, W)]] = ILPResult(
-                k=k, w=[0] * M, n=[0] * M, obj_value=obj_j
+                k=k, obj_value=obj_j, certified=False
             )
     return results, best

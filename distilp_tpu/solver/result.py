@@ -10,14 +10,29 @@ from ..common import DeviceProfile
 
 
 class ILPResult(BaseModel):
-    """Solution of one fixed-k subproblem."""
+    """Solution of one fixed-k subproblem.
+
+    The JAX backend's k-sweep returns one winning entry with the full integer
+    assignment plus reporting-only entries for the other k's: those carry the
+    best *found* incumbent objective for that k with ``w``/``n`` left as
+    ``None`` (re-deriving the losing assignments would cost another solve) and
+    ``certified=False``. The reference returns certified per-k optima
+    (/root/reference/src/distilp/solver/halda_p_solver.py:392-412); consumers
+    that need a losing k's assignment should re-solve with
+    ``k_candidates=[k]``.
+    """
 
     k: int
-    w: List[int]
-    n: List[int]
+    w: Optional[List[int]] = None
+    n: Optional[List[int]] = None
     obj_value: float
     # MoE co-assignment: routed experts hosted per device (None in dense mode)
     y: Optional[List[int]] = None
+    # Optimality certificate: achieved relative gap (incumbent - best bound)
+    # / |incumbent| when the backend computed one, and whether it met the
+    # requested mip_gap. The CPU/HiGHS backend certifies by construction.
+    certified: bool = True
+    gap: Optional[float] = None
 
 
 class HALDAResult(BaseModel):
@@ -30,6 +45,9 @@ class HALDAResult(BaseModel):
     sets: Dict[str, List[int]]
     # MoE co-assignment: routed experts hosted per device (None in dense mode)
     y: Optional[List[int]] = None
+    # Optimality certificate of the winning solve (see ILPResult.certified).
+    certified: bool = True
+    gap: Optional[float] = None
 
     def solution_text(self, devices: Sequence[DeviceProfile]) -> str:
         lines = [
