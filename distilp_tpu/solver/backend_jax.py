@@ -269,7 +269,9 @@ def _int_redistribute(vals, rem, lo, hi, target, M):
     toward ``sum(vals) == target``, preferring large fractional remainders on
     the way up and small ones on the way down. Returns the adjusted vector;
     the caller re-checks the sum (|residual| <= M for near-feasible LP
-    points; the scan length covers that)."""
+    points; the scan length covers that — Lagrangian-primal y hints with
+    larger residuals go through the exact-priced greedy repair in
+    ``_round_to_incumbent`` instead)."""
 
     def body(state, _):
         v, d = state
@@ -292,7 +294,10 @@ def _int_redistribute(vals, rem, lo, hi, target, M):
     return vals
 
 
-def _round_to_incumbent(v, M, W, k, rd: RoundingData, moe: bool = False):
+def _round_to_incumbent(
+    v, M, W, k, rd: RoundingData, moe: bool = False,
+    y_steps: Optional[int] = None,
+):
     """Exact MILP objective of the best integer point near the LP solution v.
 
     Given integer (w, n, y), the minimal feasible slacks are closed-form, and
@@ -347,29 +352,67 @@ def _round_to_incumbent(v, M, W, k, rd: RoundingData, moe: bool = False):
         C = jnp.max(busy + 0.5 * fetch)
         return jnp.where(ok, (k_f - 1.0) * C + jnp.sum(lin), jnp.inf)
 
-    # MoE expert counts: floor + largest-remainder redistribution to sum E,
-    # then a greedy local search over single-expert moves i -> j. The LP
-    # point is a good region but its rounding is rarely the best lattice
-    # point when E is large (DeepSeek: E=256); a few exact-priced moves
-    # close most of that gap.
+    # MoE expert counts. LP points (y_steps=None): floor + largest-remainder
+    # redistribution, residual <= M by near-feasibility. Lagrangian-primal
+    # hints (y_steps=k) can be short/long by up to E experts, and their
+    # remainders carry no information — repair those with an exact-priced
+    # greedy scan instead (each step adds the unit where the true objective
+    # grows least / removes where it shrinks most). Either way a greedy
+    # single-expert-move local search polishes the result: the rounding is
+    # rarely the best lattice point when E is large (DeepSeek: E=256).
     if moe:
         y_frac = v[2 * M : 3 * M]
-        y_rem = y_frac - jnp.floor(y_frac)
-        y = jnp.clip(jnp.floor(y_frac), 0.0, rd.E)
-        y = _int_redistribute(y, y_rem, 0.0, rd.E, rd.E, M)
+        if y_steps is None:
+            y_rem = y_frac - jnp.floor(y_frac)
+            y = jnp.clip(jnp.floor(y_frac), 0.0, rd.E)
+            y = _int_redistribute(y, y_rem, 0.0, rd.E, rd.E, M)
+        else:
+            y0 = jnp.clip(jnp.round(y_frac), 0.0, rd.E)
+            eyeM_r = jnp.eye(M, dtype=BDTYPE)
+
+            def repair(y_t, _):
+                d = rd.E - y_t.sum()
+                add_cost = jnp.where(
+                    y_t < rd.E, jax.vmap(price)(y_t[None, :] + eyeM_r), jnp.inf
+                )
+                sub_cost = jnp.where(
+                    y_t > 0, jax.vmap(price)(y_t[None, :] - eyeM_r), jnp.inf
+                )
+                i_add = jnp.argmin(add_cost)
+                i_sub = jnp.argmin(sub_cost)
+                y_t = jnp.where(
+                    d > 0,
+                    y_t.at[i_add].add(1.0),
+                    jnp.where(d < 0, y_t.at[i_sub].add(-1.0), y_t),
+                )
+                return y_t, None
+
+            y, _ = jax.lax.scan(repair, y0, None, length=y_steps)
         valid &= y.sum() == rd.E
 
         eyeM = jnp.eye(M, dtype=BDTYPE)
         not_diag = ~jnp.eye(M, dtype=bool)
+        # Move quanta: single-expert moves alone stall on the ceil staircase
+        # of the RAM-slack penalty (moving 1 of 2 needed experts can be
+        # neutral while moving both wins), so each step also prices coarser
+        # i -> j transfers.
+        qs = jnp.asarray([1.0, 2.0, 4.0, 8.0, 16.0], BDTYPE)
 
         def move(y_t, _):
-            cand = y_t[None, None, :] + eyeM[None, :, :] - eyeM[:, None, :]
-            feas = (y_t[:, None] > 0) & (y_t[None, :] < rd.E) & not_diag
-            objs = jnp.where(feas, jax.vmap(jax.vmap(price))(cand), jnp.inf)
+            diff = eyeM[None, :, :] - eyeM[:, None, :]  # (i, j, M)
+            cand = y_t[None, None, None, :] + qs[:, None, None, None] * diff[None]
+            feas = (
+                (y_t[None, :, None] >= qs[:, None, None])
+                & (y_t[None, None, :] + qs[:, None, None] <= rd.E)
+                & not_diag[None]
+            )
+            objs = jnp.where(
+                feas, jax.vmap(jax.vmap(jax.vmap(price)))(cand), jnp.inf
+            )
             flat = jnp.argmin(objs)
-            i, j = flat // M, flat % M
-            better = objs[i, j] < price(y_t) - 1e-12
-            return jnp.where(better, cand[i, j], y_t), None
+            q, i, j = flat // (M * M), (flat // M) % M, flat % M
+            better = objs[q, i, j] < price(y_t) - 1e-12
+            return jnp.where(better, cand[q, i, j], y_t), None
 
         y, _ = jax.lax.scan(move, y, None, length=MOE_LOCAL_MOVES)
     else:
@@ -377,6 +420,254 @@ def _round_to_incumbent(v, M, W, k, rd: RoundingData, moe: bool = False):
 
     obj = jnp.where(valid, price(y), jnp.inf)
     return obj, w, n, y
+
+
+def _decomp_terms(rd: RoundingData, ks, Ws, w_max: int, e_max: int, dtype):
+    """Enumeration tensors of the Lagrangian decomposition bound.
+
+    For each k-candidate j, device i, integer w in [1, w_max], y in
+    [0, e_max], and the complete n-candidate set {0, w, the VRAM boundary
+    floor(V), the RAM-slack kink ceil(K)}, price the device EXACTLY as the
+    MILP does (integer ceil slacks, penalties, busy constant). The candidate
+    set is exact, not heuristic: over integer n the cost is piecewise linear
+    with slope b_gpu - pen_set while the RAM slack is positive, b_gpu
+    between the kinks, and b_gpu + pen_vram past the VRAM boundary — a
+    convex slope sequence, so the integer minimum sits at an endpoint or a
+    breakpoint. (Omitting ceil(K) would overstate the per-device minimum
+    whenever 0 < b_gpu < pen_set — a slower-than-CPU accelerator — and an
+    overstated minimum makes the Lagrangian BOUND unsound.)
+
+        lin  = a w + b_gpu n + pen_ram ceil + pen_vram ceil + (g/k) y
+        cyc  = lin + busy_const + (b'/s_disk) w / 2
+
+    Returns (lin, cyc, ok) each shaped (4, n_k, M, w_max, e_max+1); ``ok``
+    masks infeasible cells (slack caps exceeded, w > W_j).
+    """
+    M = rd.a.shape[0]
+    bp = rd.bprime
+    w_vals = jnp.arange(1, w_max + 1, dtype=dtype)  # (W,)
+    y_vals = jnp.arange(0, e_max + 1, dtype=dtype)  # (Y,)
+    Wg = w_vals[None, None, :, None]  # (1, 1, W, 1)
+    Yg = y_vals[None, None, None, :]  # (1, 1, 1, Y)
+    Wj = Ws.astype(dtype)[:, None, None, None]  # (n_k, 1, 1, 1)
+    kj = ks.astype(dtype)[:, None, None, None]
+
+    def dev(x):
+        return x.astype(dtype)[None, :, None, None]  # (1, M, 1, 1)
+
+    a = dev(rd.a)
+    b_gpu = dev(rd.b_gpu)
+    pen_set = dev(rd.pen_set)
+    pen_vram = dev(rd.pen_vram)
+    busy_const = dev(rd.busy_const)
+    s_disk = dev(rd.s_disk)
+    ram_rhs = dev(rd.ram_rhs)
+    rm = dev(rd.ram_minus_n)
+    cuda = dev(rd.cuda_rhs)
+    metal = dev(rd.metal_rhs)
+    hg = dev(rd.has_gpu)
+    eb = dev(rd.eb)
+    g_k = dev(rd.g_raw) / kj
+    bp_d = bp.astype(dtype)
+    E_d = rd.E.astype(dtype)
+    s_cap = Wj + jnp.ceil(eb * E_d / bp_d)
+
+    vram_rhs = jnp.minimum(cuda, metal)
+    n_boundary = jnp.clip(jnp.floor(vram_rhs / bp_d), 0.0, Wg) * hg
+    n_boundary = jnp.where(jnp.isfinite(n_boundary), n_boundary, Wg * hg)
+    # RAM-slack kink: smallest n with zero RAM slack, ceil(K) for
+    # K = (bp w + eb y - rhs)/bp. Only meaningful when n relieves the RAM
+    # row (ram_minus_n=1); elsewhere it degenerates to a harmless duplicate.
+    ram_kink = jnp.clip(
+        jnp.ceil((bp_d * Wg + eb * Yg - ram_rhs) / bp_d - 1e-9), 0.0, Wg
+    ) * hg * rm
+    ram_kink = jnp.where(jnp.isfinite(ram_kink), ram_kink, 0.0)
+    n_cands = jnp.stack(
+        [
+            jnp.zeros_like(Wg * hg * jnp.ones_like(Yg)),
+            Wg * hg * jnp.ones_like(Yg),
+            n_boundary * jnp.ones_like(Yg),
+            ram_kink * jnp.ones_like(Wg),
+        ]
+    )  # (4, n_k, M, W, Y)
+
+    resident = bp_d * Wg - bp_d * n_cands * rm + eb * Yg
+    s_ram = jnp.ceil(jnp.maximum(resident - ram_rhs, 0.0) / bp_d - 1e-9)
+    ok = s_ram <= s_cap
+    viol_v = jnp.maximum(
+        jnp.maximum(bp_d * n_cands - cuda, bp_d * n_cands - metal), 0.0
+    )
+    viol_v = jnp.where(jnp.isfinite(viol_v), viol_v, 0.0)
+    t = jnp.ceil(viol_v / bp_d - 1e-9)
+    ok &= t <= Wg * hg + 1e-9
+    ok &= (Wg <= Wj) & (Yg <= E_d)
+
+    lin = a * Wg + b_gpu * n_cands + pen_set * s_ram + pen_vram * t + g_k * Yg
+    cyc = lin + busy_const + 0.5 * (bp_d / s_disk) * Wg
+    return lin, cyc, ok, w_vals, y_vals
+
+
+def _decomp_bound_roots(
+    rd: RoundingData,
+    ks,
+    Ws,
+    w_max: int,
+    e_max: int,
+    steps: int = 300,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-k Lagrangian decomposition lower bounds on the fixed-k MILP.
+
+    Dualize the two coupling constraints (sum w = W, sum y = E) and split the
+    cycle-time weight (k-1) over devices as theta_i = (k-1) softmax(tau_i)
+    (valid because C >= B_i + F_i/2 for every feasible point — add the cycle
+    and prefetch rows). For ANY (lambda, mu, tau) the per-device subproblems
+    decouple and are solved EXACTLY over the integer lattice by the
+    enumeration tensors, so
+
+        bound(l, m, tau) = sum_i min_{w,n,y} [lin_i + theta_i cyc_i
+                           - l w - m y] + l W + m E
+
+    is a rigorous lower bound accounting for per-device integrality the LP
+    relaxation cannot see (the MoE root integrality gap that box branching
+    cannot close — cf. HiGHS closing it with cutting planes). Multipliers are
+    optimized by momentum ascent in f32 (gradients through the min pick the
+    argmin cell); the returned bound is ONE final f64 evaluation at the best
+    multipliers, so f32 only costs tightness, never soundness.
+    """
+    n_k = ks.shape[0]
+    M = rd.a.shape[0]
+    lin32, cyc32, ok, w_vals, y_vals = _decomp_terms(rd, ks, Ws, w_max, e_max, DTYPE)
+    big = jnp.asarray(3.4e37, DTYPE)
+    wv = w_vals[None, None, :, None]
+    yv = y_vals[None, None, None, :]
+
+    def neg_bound32(params):
+        lam, mu, tau = params  # (n_k,), (n_k,), (n_k, M)
+        theta = (ks.astype(DTYPE) - 1.0)[:, None] * jax.nn.softmax(tau, axis=1)
+        term = (
+            lin32
+            + theta[None, :, :, None, None] * cyc32
+            - lam[None, :, None, None, None] * wv[None]
+            - mu[None, :, None, None, None] * yv[None]
+        )
+        term = jnp.where(ok, term, big)
+        per_dev = jnp.min(term, axis=(0, 3, 4))  # (n_k, M)
+        b = per_dev.sum(axis=1) + lam * Ws.astype(DTYPE) + mu * rd.E.astype(DTYPE)
+        return -jnp.sum(b), b
+
+    grad_fn = jax.grad(lambda p: neg_bound32(p)[0])
+    params0 = (
+        jnp.zeros(n_k, DTYPE),
+        jnp.zeros(n_k, DTYPE),
+        jnp.zeros((n_k, M), DTYPE),
+    )
+
+    # Adam ascent on the bounds. The dual function is piecewise linear and
+    # badly scaled across instances (dual-optimal multipliers range from
+    # ~0.03 on the DeepSeek fleet to ~3 on Mixtral), so the step size sweeps
+    # three decades in phases; any visited multiplier yields a valid bound
+    # and ``best_b``/``best_params`` keep the tightest one, so an overshooting
+    # phase can only waste steps, never weaken the result.
+    b1, b2, eps = 0.9, 0.999, 1e-12
+    phase_len = max(1, steps // 3)
+
+    def step(carry, i):
+        params, m_st, v_st, best_b, best_params = carry
+        g = grad_fn(params)
+        t = i.astype(DTYPE) + 1.0
+        lr = 0.01 * 10.0 ** jnp.minimum(i // phase_len, 2).astype(DTYPE)
+        m_st = jax.tree.map(lambda m, gg: b1 * m + (1 - b1) * gg, m_st, g)
+        v_st = jax.tree.map(lambda v, gg: b2 * v + (1 - b2) * gg * gg, v_st, g)
+        params = jax.tree.map(
+            lambda p, m, v: p
+            - lr * (m / (1 - b1**t)) / (jnp.sqrt(v / (1 - b2**t)) + eps),
+            params,
+            m_st,
+            v_st,
+        )
+        b = neg_bound32(params)[1]  # (n_k,)
+        better = b > best_b
+        best_params = jax.tree.map(
+            lambda bp_, p: jnp.where(
+                better.reshape((n_k,) + (1,) * (p.ndim - 1)), p, bp_
+            ),
+            best_params,
+            params,
+        )
+        best_b = jnp.maximum(best_b, b)
+        return (params, m_st, v_st, best_b, best_params), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params0)
+    init = (params0, zeros, zeros, jnp.full(n_k, -jnp.inf, DTYPE), params0)
+    (_, _, _, _, best_params), _ = jax.lax.scan(
+        step, init, jnp.arange(steps), length=steps
+    )
+
+    # Rigorous final evaluation: f64 pricing at the chosen multipliers.
+    lin64, cyc64, ok64, w64, y64 = _decomp_terms(rd, ks, Ws, w_max, e_max, BDTYPE)
+    lam, mu, tau = jax.tree.map(lambda p: p.astype(BDTYPE), best_params)
+    theta = (ks - 1.0)[:, None] * jax.nn.softmax(tau, axis=1)
+    term = (
+        lin64
+        + theta[None, :, :, None, None] * cyc64
+        - lam[None, :, None, None, None] * w64[None, None, None, :, None]
+        - mu[None, :, None, None, None] * y64[None, None, None, None, :]
+    )
+    term = jnp.where(ok64, term, jnp.inf)
+    per_dev = jnp.min(term, axis=(0, 3, 4))  # (n_k, M)
+    bound = per_dev.sum(axis=1) + lam * Ws + mu * rd.E
+    # A device with NO feasible cell proves the whole k infeasible (+inf is
+    # the honest bound); a non-finite optimization artifact must degrade to
+    # -inf (vacuous) instead.
+    any_feasible = jnp.any(ok64, axis=(0, 3, 4)).all(axis=1)
+    bound = jnp.where(jnp.isnan(bound), -jnp.inf, bound)
+    bound = jnp.where(any_feasible, bound, jnp.inf)
+
+    # Lagrangian primal hint: each device's argmin cell at the chosen
+    # multipliers, INCLUDING its optimal n-candidate (leaving n at zero
+    # would hand the pricer a GPU-less placement). sum(w*) is usually
+    # exactly W near the dual optimum and sum(y*) within ~E/2 of E; the
+    # caller repairs and exact-prices it as an incumbent candidate (LP
+    # rounding alone lands far from the optimum on wide-expert instances).
+    Y = e_max + 1
+    WY = w_max * Y
+    n_cand_count = term.shape[0]
+    t_flat = jnp.transpose(term, (1, 2, 0, 3, 4)).reshape(
+        n_k, M, n_cand_count * WY
+    )
+    flat = t_flat.argmin(axis=2)
+    c_star = flat // WY
+    rest = flat % WY
+    w_star = (rest // Y + 1).astype(BDTYPE)
+    y_star = (rest % Y).astype(BDTYPE)
+    # Reconstruct the n value of the chosen candidate: 0, w, the VRAM
+    # boundary, or the RAM-slack kink (mirrors the n_cands construction in
+    # _decomp_terms).
+    hg = rd.has_gpu[None, :]
+    rm = rd.ram_minus_n[None, :]
+    vram_rhs = jnp.minimum(rd.cuda_rhs, rd.metal_rhs)[None, :]
+    n_bnd = jnp.clip(jnp.floor(vram_rhs / rd.bprime), 0.0, w_star) * hg
+    n_bnd = jnp.where(jnp.isfinite(n_bnd), n_bnd, w_star * hg)
+    n_kink = (
+        jnp.clip(
+            jnp.ceil(
+                (rd.bprime * w_star + rd.eb[None, :] * y_star - rd.ram_rhs[None, :])
+                / rd.bprime
+                - 1e-9
+            ),
+            0.0,
+            w_star,
+        )
+        * hg
+        * rm
+    )
+    n_kink = jnp.where(jnp.isfinite(n_kink), n_kink, 0.0)
+    n_star = jnp.where(
+        c_star == 0,
+        0.0,
+        jnp.where(c_star == 1, w_star * hg, jnp.where(c_star == 2, n_bnd, n_kink)),
+    )
+    return bound, w_star, n_star, y_star
 
 
 class SearchState(NamedTuple):
@@ -725,7 +1016,7 @@ _RD_VEC_FIELDS = (
     jax.jit,
     static_argnames=(
         "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
-        "has_warm",
+        "has_warm", "w_max", "e_max", "decomp_steps",
     ),
 )
 def _solve_packed(
@@ -740,6 +1031,9 @@ def _solve_packed(
     beam: Optional[int] = BEAM,
     moe: bool = False,
     has_warm: bool = False,
+    w_max: int = 0,
+    e_max: int = 0,
+    decomp_steps: int = 0,
 ) -> jax.Array:
     """One-dispatch sweep: unpack the blob, build the root state in-trace, run
     the fused B&B loop, and pack the answer into one float64 vector:
@@ -805,6 +1099,52 @@ def _solve_packed(
 
     state = _root_state(lo_k, hi_k, M, cap)
 
+    if decomp_steps > 0 and w_max > 0:
+        # Root Lagrangian decomposition bounds: per-device integrality the LP
+        # relaxation cannot express. Children inherit them through the
+        # max(ipm, parent) in _bnb_round, and losing k's whose decomposition
+        # bound already exceeds the incumbent prune without a single IPM
+        # solve. This is what closes wide-expert MoE root gaps (see
+        # _decomp_bound_roots).
+        raw_bounds, w_star, n_star, y_star = _decomp_bound_roots(
+            rd, ks, Ws, w_max, e_max, steps=decomp_steps
+        )
+        root_bounds = raw_bounds + obj_const
+        state = state._replace(
+            node_bound=state.node_bound.at[:n_k].set(root_bounds)
+        )
+
+        # Seed the incumbent from the Lagrangian primal: repair each k's
+        # per-device argmin cells to a feasible placement (greedy exact-priced
+        # y repair, scan budget E) and keep the best. On wide-expert
+        # instances this lands within the certificate window on round 0
+        # where LP-point rounding lands ~0.5% off.
+        def price_root(j):
+            v_hint = jnp.zeros(nf, BDTYPE)
+            v_hint = v_hint.at[:M].set(w_star[j])
+            v_hint = v_hint.at[M : 2 * M].set(n_star[j])
+            if moe:
+                v_hint = v_hint.at[2 * M : 3 * M].set(y_star[j])
+            return _round_to_incumbent(
+                v_hint, M, Ws[j], ks[j], rd, moe=moe, y_steps=e_max + 4
+            )
+        lag_obj, lag_w, lag_n, lag_y = jax.vmap(price_root)(jnp.arange(n_k))
+        lag_obj = lag_obj + obj_const
+        jbest = jnp.argmin(lag_obj)
+        lag_better = lag_obj[jbest] < state.incumbent
+        state = state._replace(
+            incumbent=jnp.where(lag_better, lag_obj[jbest], state.incumbent),
+            inc_w=jnp.where(lag_better, lag_w[jbest], state.inc_w),
+            inc_n=jnp.where(lag_better, lag_n[jbest], state.inc_n),
+            inc_y=jnp.where(lag_better, lag_y[jbest], state.inc_y),
+            inc_kidx=jnp.where(
+                lag_better, jbest.astype(jnp.int32), state.inc_kidx
+            ),
+            per_k_best=jnp.minimum(
+                state.per_k_best, jnp.where(jnp.isfinite(lag_obj), lag_obj, jnp.inf)
+            ),
+        )
+
     if has_warm:
         # Warm start: re-price the previous assignment under THESE
         # coefficients (exact closed form, float64) and seed the incumbent
@@ -823,15 +1163,18 @@ def _solve_packed(
             v_warm, M, Ws[warm_kidx], ks[warm_kidx], rd, moe=moe
         )
         warm_obj = warm_obj + obj_const
-        seeded = jnp.isfinite(warm_obj)
+        # Adopt the warm point only when it beats whatever already seeded the
+        # state (the Lagrangian primal may be strictly better on a MoE tick;
+        # a stale-infeasible hint prices to +inf and changes nothing).
+        seeded = jnp.isfinite(warm_obj) & (warm_obj < state.incumbent)
         state = state._replace(
             incumbent=jnp.where(seeded, warm_obj, state.incumbent),
             inc_w=jnp.where(seeded, w_rep, state.inc_w),
             inc_n=jnp.where(seeded, n_rep, state.inc_n),
             inc_y=jnp.where(seeded, y_rep, state.inc_y),
             inc_kidx=jnp.where(seeded, warm_kidx, state.inc_kidx),
-            per_k_best=state.per_k_best.at[warm_kidx].set(
-                jnp.where(seeded, warm_obj, jnp.inf)
+            per_k_best=state.per_k_best.at[warm_kidx].min(
+                jnp.where(jnp.isfinite(warm_obj), warm_obj, jnp.inf)
             ),
         )
 
@@ -975,6 +1318,16 @@ def solve_sweep_jax(
     beam = beam if beam is not None else d_beam
     ipm_iters = ipm_iters if ipm_iters is not None else d_iters
     max_rounds = max_rounds if max_rounds is not None else MAX_ROUNDS
+    # Root decomposition bounds are what certify wide-expert MoE instances
+    # (the LP root gap there is structural); dense sweeps certify from the
+    # IPM bounds alone, so they skip the extra program — with w_max/e_max
+    # zeroed so the unused statics don't key extra jit cache entries.
+    if sf.moe:
+        w_max = max(W for _, W in feasible)
+        e_max = int(arrays.moe.E)
+        decomp_steps = 300
+    else:
+        w_max = e_max = decomp_steps = 0
 
     warm_tuple = None
     if warm is not None and warm.w is not None and len(warm.w) == M:
@@ -1012,6 +1365,9 @@ def solve_sweep_jax(
                 beam=beam,
                 moe=sf.moe,
                 has_warm=warm_tuple is not None,
+                w_max=w_max,
+                e_max=e_max,
+                decomp_steps=decomp_steps,
             )
         )
     )

@@ -37,14 +37,15 @@ Formulation (new design — there is no reference implementation):
   dense layers), so ``w`` carries the pipeline-resident part and ``y``
   carries the expert part.
 
-Certification note: on wide-expert instances (DeepSeek-V3: E=256 over 32
-devices) the JAX backend finds the true optimum (verified against HiGHS) and
-its local-search rounding lands on it reliably, but the box branch-and-bound
-cannot always close the last ~0.2% of the root integrality gap that HiGHS
-closes with cutting planes — ``halda_solve`` then returns the optimum with a
-``RuntimeWarning`` that the requested mip-gap certificate was not met. Use
-``mip_gap=2e-3`` (or the CPU backend) when a certificate on such instances
-matters more than latency.
+Certification note: the LP root integrality gap on wide-expert instances is
+structural (box branch-and-bound alone stalls several percent short of the
+optimum HiGHS reaches with cutting planes). The JAX backend closes it with
+per-k Lagrangian decomposition root bounds — the coupling constraints
+(sum w = W, sum y = E) are dualized and each device's subproblem is solved
+exactly over its integer lattice on-device — which certify mip_gap<=1e-3 on
+both flagships (Mixtral 8x7B and DeepSeek-V3 E=256 over 32 devices; see
+``tests/test_solver_moe.py::test_deepseek_v3_flagship_certified`` and
+``backend_jax._decomp_bound_roots``).
 
 Deliberate v1 simplifications (documented, not hidden):
 - Experts charge the device's primary (RAM/unified) pool, not VRAM — a
