@@ -13,8 +13,9 @@ except z and C:
     z_i  pipeline stall seconds (continuous)
     C    steady-state cycle time seconds (continuous)
 
-Constraint rows are emitted at a fixed count (6M inequality + 1 or 2
-equality) so every (M, k) instance of one fleet shares a single array shape —
+Constraint rows are emitted at a fixed count (6M inequality + 1 equality
+dense; 8M + 2 with MoE) so every (M, k) instance of one fleet shares a
+single array shape —
 that is what lets the JAX backend vmap the k-sweep and batch branch-and-bound
 nodes. Rows that don't apply to a device (no CUDA, no Metal) keep their
 structural columns but get a huge RHS, and the variable bounds already pin
@@ -23,11 +24,18 @@ their variables to 0.
 Row layout of A_ub:
     [0,  M)   n_i - w_i <= 0
     [M, 2M)   RAM/unified residency cap per device (set-dependent shape;
-              MoE mode adds eb_i * y_i resident expert bytes)
-    [2M,3M)   CUDA VRAM cap
+              MoE mode adds eb_ram_i * y_i resident expert bytes)
+    [2M,3M)   CUDA VRAM cap (MoE mode adds eb_vram_i * y_i)
     [3M,4M)   Metal shared-memory cap
     [4M,5M)   cycle bound:   B_i + z_i - C <= -(xi_i + t_comm_i)
     [5M,6M)   prefetch bound: B_i + F_i - z_i - C <= -(xi_i + t_comm_i)
+    [6M,7M)   (MoE only) s_i - w_i <= 0: a device cannot stream more layers
+              than it hosts. Dense mode satisfies this automatically (the
+              RAM violation is at most b'*w_i), but expert bytes would
+              otherwise ride the layer slack; algebraically s_i <= w_i
+              forces eb_ram*y to fit in physical capacity.
+    [7M,8M)   (MoE only) t_i - n_i <= 0, same for the VRAM slack: forces
+              eb_vram*y to fit in VRAM.
 
 where B_i is the device busy time (a_i w_i + b_i n_i + disk penalties on the
 slacks, plus the constant xi_i + t_comm_i — and, in MoE mode, the expert
@@ -175,8 +183,9 @@ def assemble(coeffs: HaldaCoeffs, moe: Optional[MoEArrays] = None) -> MilpArrays
     lay = VarLayout(M, moe=moe is not None)
     N = lay.n_vars
 
-    A_ub = np.zeros((6 * M, N))
-    b_ub = np.zeros(6 * M)
+    n_rows = 8 * M if moe is not None else 6 * M
+    A_ub = np.zeros((n_rows, N))
+    b_ub = np.zeros(n_rows)
     bp = coeffs.bprime
 
     # Per-device slack penalty coefficients reused by busy rows and objective.
@@ -202,15 +211,17 @@ def assemble(coeffs: HaldaCoeffs, moe: Optional[MoEArrays] = None) -> MilpArrays
         if coeffs.ram_minus_n[i]:
             A_ub[r, lay.n(i)] = -bp
         if moe is not None:
-            A_ub[r, lay.y(i)] = moe.eb[i]  # resident expert bytes
+            A_ub[r, lay.y(i)] = moe.eb_ram[i]  # resident expert bytes
         sid = int(coeffs.set_id[i])
         slack_col = {1: lay.s1, 2: lay.s2, 3: lay.s3}[sid](i)
         A_ub[r, slack_col] = -bp
         b_ub[r] = coeffs.ram_rhs[i] if np.isfinite(coeffs.ram_rhs[i]) else INACTIVE_RHS
 
-        # --- CUDA VRAM row ---
+        # --- CUDA VRAM row (VRAM-resident experts charge it in MoE mode) ---
         r = 2 * M + i
         A_ub[r, lay.n(i)] = bp
+        if moe is not None:
+            A_ub[r, lay.y(i)] = moe.eb_vram[i]
         A_ub[r, lay.t(i)] = -bp
         b_ub[r] = coeffs.cuda_rhs[i] if coeffs.cuda_row[i] else INACTIVE_RHS
 
@@ -245,6 +256,15 @@ def assemble(coeffs: HaldaCoeffs, moe: Optional[MoEArrays] = None) -> MilpArrays
         A_ub[r, lay.C] -= 1.0
         b_ub[r] = -busy_const
 
+        # --- MoE hard caps: s_i <= w_i and t_i <= n_i (see row layout) ---
+        if moe is not None:
+            r = 6 * M + i
+            A_ub[r, slack_col] = 1.0
+            A_ub[r, lay.w(i)] = -1.0
+            r = 7 * M + i
+            A_ub[r, lay.t(i)] = 1.0
+            A_ub[r, lay.n(i)] = -1.0
+
     # --- equalities: sum w_i = W; MoE mode adds sum y_i = E ---
     A_eq = np.zeros((lay.n_eq, N))
     A_eq[0, :M] = 1.0
@@ -275,12 +295,12 @@ def assemble(coeffs: HaldaCoeffs, moe: Optional[MoEArrays] = None) -> MilpArrays
     for sid, sl in ((1, lay.s1), (2, lay.s2), (3, lay.s3)):
         for i in range(M):
             in_set = int(coeffs.set_id[i]) == sid
+            # Slack counts disk-streamed pipeline-window LAYERS, so its cap
+            # is W in MoE mode too: expert weights are needed at every MoE
+            # layer and cannot stream, so eb*y gets no slack — a fleet that
+            # cannot hold E experts is infeasible, not "optimal at a disk
+            # penalty" it could never realize.
             ub_scale[sl(i)] = 1.0 if in_set else 0.0
-            if moe is not None and in_set:
-                # Expert residency can exceed RAM too; the overflow rides the
-                # same disk-streaming slack (unit = b' bytes), so its cap
-                # grows by the expert bytes expressed in slack units.
-                ub_const[sl(i)] = np.ceil(moe.eb[i] * moe.E / bp)
     for i in range(M):
         ub_scale[lay.t(i)] = 1.0 if coeffs.has_gpu[i] else 0.0
     ub_const[lay.z0 :] = np.inf  # z, C unbounded above

@@ -101,7 +101,8 @@ class RoundingData(NamedTuple):
     metal_rhs: jax.Array  # +inf when row inactive
     has_gpu: jax.Array  # float 0/1
     g_raw: jax.Array  # (M,) MoE expert busy seconds per y-unit, times k
-    eb: jax.Array  # (M,) MoE resident bytes per y-unit
+    eb_ram: jax.Array  # (M,) MoE bytes per y-unit charged to the primary pool
+    eb_vram: jax.Array  # (M,) MoE bytes per y-unit charged to discrete VRAM
     bprime: jax.Array  # scalar
     E: jax.Array  # scalar: routed experts per MoE layer (0 = dense)
 
@@ -127,7 +128,12 @@ def _rounding_arrays_np(coeffs: HaldaCoeffs, moe=None) -> dict:
         metal_rhs=np.where(coeffs.metal_row, coeffs.metal_rhs, np.inf),
         has_gpu=coeffs.has_gpu.astype(np.float64),
         g_raw=np.asarray(moe.g_raw if moe is not None else np.zeros(M), np.float64),
-        eb=np.asarray(moe.eb if moe is not None else np.zeros(M), np.float64),
+        eb_ram=np.asarray(
+            moe.eb_ram if moe is not None else np.zeros(M), np.float64
+        ),
+        eb_vram=np.asarray(
+            moe.eb_vram if moe is not None else np.zeros(M), np.float64
+        ),
         bprime=np.float64(coeffs.bprime),
         E=np.float64(moe.E if moe is not None else 0.0),
     )
@@ -181,7 +187,7 @@ def _root_boxes(
     lo, hi = arrays.bounds_for_k(W)
 
     F_max = W * rd["bprime"] / rd["s_disk"]
-    s_cap = W + np.ceil(rd["eb"] * rd["E"] / rd["bprime"])  # slack upper bound
+    s_cap = float(W)  # slack counts streamable LAYERS; experts get no slack
     B_max = (
         rd["a"] * W
         + np.maximum(rd["b_gpu"], 0.0) * W
@@ -322,15 +328,7 @@ def _round_to_incumbent(
     n = jnp.clip(jnp.round(n_frac), 0.0, w) * rd.has_gpu
 
     bp = rd.bprime
-    s_cap = Wf + jnp.ceil(rd.eb * rd.E / bp)
-
-    # VRAM slack: one t_i covers both CUDA and Metal rows (independent of y)
-    viol_vram = jnp.maximum(
-        jnp.maximum(bp * n - rd.cuda_rhs, bp * n - rd.metal_rhs), 0.0
-    )
-    viol_vram = jnp.where(jnp.isfinite(viol_vram), viol_vram, 0.0)
-    t = jnp.ceil(viol_vram / bp - 1e-9)
-    valid &= jnp.all(t <= Wf * rd.has_gpu + 1e-9)
+    s_cap = Wf  # slack counts streamable LAYERS; expert bytes get no slack
 
     fetch = bp / rd.s_disk * w
 
@@ -341,11 +339,28 @@ def _round_to_incumbent(
 
     def price(y_t):
         """Exact objective of (w, n, y_t) with closed-form optimal slacks and
-        continuous block; +inf when the RAM slack cap is exceeded."""
-        resident = bp * w - bp * n * rd.ram_minus_n + rd.eb * y_t
+        continuous block; +inf when a slack cap is exceeded (RAM-overflowing
+        expert residency is infeasible, not penalized — experts can't be
+        disk-streamed)."""
+        resident = bp * w - bp * n * rd.ram_minus_n + rd.eb_ram * y_t
         viol_ram = jnp.maximum(resident - rd.ram_rhs, 0.0)
         s_ram = jnp.ceil(viol_ram / bp - 1e-9)
-        ok = jnp.all(s_ram <= s_cap)
+        # Hard caps: a device cannot stream more layers than it hosts
+        # (s <= w) — dense mode satisfies this automatically, MoE mode needs
+        # it so expert bytes never ride the layer slack. s_cap (= W) stays
+        # as the structural bound.
+        ok = jnp.all(s_ram <= jnp.minimum(w, s_cap))
+        # VRAM slack: one t_i covers both CUDA and Metal rows; VRAM-resident
+        # experts (eb_vram) make it y-dependent. t <= n mirrors s <= w.
+        viol_vram = jnp.maximum(
+            jnp.maximum(
+                bp * n + rd.eb_vram * y_t - rd.cuda_rhs, bp * n - rd.metal_rhs
+            ),
+            0.0,
+        )
+        viol_vram = jnp.where(jnp.isfinite(viol_vram), viol_vram, 0.0)
+        t = jnp.ceil(viol_vram / bp - 1e-9)
+        ok &= jnp.all(t <= n + 1e-9)
         pen_cost = rd.pen_set * s_ram + rd.pen_vram * t
         lin = rd.a * w + rd.b_gpu * n + pen_cost + g_k * y_t
         busy = lin + rd.busy_const
@@ -427,20 +442,22 @@ def _decomp_terms(rd: RoundingData, ks, Ws, w_max: int, e_max: int, dtype):
 
     For each k-candidate j, device i, integer w in [1, w_max], y in
     [0, e_max], and the complete n-candidate set {0, w, the VRAM boundary
-    floor(V), the RAM-slack kink ceil(K)}, price the device EXACTLY as the
-    MILP does (integer ceil slacks, penalties, busy constant). The candidate
-    set is exact, not heuristic: over integer n the cost is piecewise linear
-    with slope b_gpu - pen_set while the RAM slack is positive, b_gpu
-    between the kinks, and b_gpu + pen_vram past the VRAM boundary — a
-    convex slope sequence, so the integer minimum sits at an endpoint or a
-    breakpoint. (Omitting ceil(K) would overstate the per-device minimum
-    whenever 0 < b_gpu < pen_set — a slower-than-CPU accelerator — and an
-    overstated minimum makes the Lagrangian BOUND unsound.)
+    floor(V), the RAM-slack kink ceil(K), the s<=w feasibility endpoint},
+    price the device EXACTLY as the MILP does (integer ceil slacks,
+    penalties, busy constant). The candidate set is exact, not heuristic:
+    over integer n the cost is piecewise linear with slope b_gpu - pen_set
+    while the RAM slack is positive, b_gpu between the kinks, and b_gpu +
+    pen_vram past the VRAM boundary — a convex slope sequence over the
+    contiguous feasible interval [n_smin, w], so the integer minimum sits
+    at an endpoint or a breakpoint. (Omitting ceil(K) would overstate the
+    per-device minimum whenever 0 < b_gpu < pen_set — a slower-than-CPU
+    accelerator — and an overstated minimum makes the Lagrangian BOUND
+    unsound.)
 
         lin  = a w + b_gpu n + pen_ram ceil + pen_vram ceil + (g/k) y
         cyc  = lin + busy_const + (b'/s_disk) w / 2
 
-    Returns (lin, cyc, ok) each shaped (4, n_k, M, w_max, e_max+1); ``ok``
+    Returns (lin, cyc, ok) each shaped (5, n_k, M, w_max, e_max+1); ``ok``
     masks infeasible cells (slack caps exceeded, w > W_j).
     """
     M = rd.a.shape[0]
@@ -466,40 +483,55 @@ def _decomp_terms(rd: RoundingData, ks, Ws, w_max: int, e_max: int, dtype):
     cuda = dev(rd.cuda_rhs)
     metal = dev(rd.metal_rhs)
     hg = dev(rd.has_gpu)
-    eb = dev(rd.eb)
+    ebr = dev(rd.eb_ram)
+    ebv = dev(rd.eb_vram)
     g_k = dev(rd.g_raw) / kj
     bp_d = bp.astype(dtype)
     E_d = rd.E.astype(dtype)
-    s_cap = Wj + jnp.ceil(eb * E_d / bp_d)
+    s_cap = Wj  # hard cap: slack streams layers, never expert bytes
 
-    vram_rhs = jnp.minimum(cuda, metal)
+    # VRAM headroom left for n after the VRAM-resident expert slice (the
+    # CUDA row carries eb_vram*y; the Metal row never does).
+    cuda_head = cuda - ebv * Yg
+    vram_rhs = jnp.minimum(cuda_head, metal)
     n_boundary = jnp.clip(jnp.floor(vram_rhs / bp_d), 0.0, Wg) * hg
     n_boundary = jnp.where(jnp.isfinite(n_boundary), n_boundary, Wg * hg)
     # RAM-slack kink: smallest n with zero RAM slack, ceil(K) for
-    # K = (bp w + eb y - rhs)/bp. Only meaningful when n relieves the RAM
-    # row (ram_minus_n=1); elsewhere it degenerates to a harmless duplicate.
+    # K = (bp w + eb_ram y - rhs)/bp. Only meaningful when n relieves the
+    # RAM row (ram_minus_n=1); elsewhere it's a harmless duplicate.
     ram_kink = jnp.clip(
-        jnp.ceil((bp_d * Wg + eb * Yg - ram_rhs) / bp_d - 1e-9), 0.0, Wg
+        jnp.ceil((bp_d * Wg + ebr * Yg - ram_rhs) / bp_d - 1e-9), 0.0, Wg
     ) * hg * rm
     ram_kink = jnp.where(jnp.isfinite(ram_kink), ram_kink, 0.0)
+    # Smallest n satisfying the s <= w hard cap (rm=1): the feasible-interval
+    # endpoint the convex argmin lands on when expert bytes force offload.
+    n_smin = jnp.clip(
+        jnp.ceil((ebr * Yg - ram_rhs) / bp_d - 1e-9), 0.0, Wg
+    ) * hg * rm
+    n_smin = jnp.where(jnp.isfinite(n_smin), n_smin, 0.0)
     n_cands = jnp.stack(
         [
             jnp.zeros_like(Wg * hg * jnp.ones_like(Yg)),
             Wg * hg * jnp.ones_like(Yg),
-            n_boundary * jnp.ones_like(Yg),
+            n_boundary * jnp.ones_like(Wg),
             ram_kink * jnp.ones_like(Wg),
+            n_smin * jnp.ones_like(Wg),
         ]
-    )  # (4, n_k, M, W, Y)
+    )  # (5, n_k, M, W, Y)
 
-    resident = bp_d * Wg - bp_d * n_cands * rm + eb * Yg
+    resident = bp_d * Wg - bp_d * n_cands * rm + ebr * Yg
     s_ram = jnp.ceil(jnp.maximum(resident - ram_rhs, 0.0) / bp_d - 1e-9)
-    ok = s_ram <= s_cap
+    # Hard caps mirroring the MILP rows: s <= min(w, W) and t <= n (a device
+    # cannot stream more layers than it hosts, so expert bytes never ride
+    # the slack; vacuous in dense mode where viol <= b'*w anyway).
+    ok = s_ram <= jnp.minimum(Wg, s_cap)
     viol_v = jnp.maximum(
-        jnp.maximum(bp_d * n_cands - cuda, bp_d * n_cands - metal), 0.0
+        jnp.maximum(bp_d * n_cands + ebv * Yg - cuda, bp_d * n_cands - metal),
+        0.0,
     )
     viol_v = jnp.where(jnp.isfinite(viol_v), viol_v, 0.0)
     t = jnp.ceil(viol_v / bp_d - 1e-9)
-    ok &= t <= Wg * hg + 1e-9
+    ok &= t <= n_cands + 1e-9
     ok &= (Wg <= Wj) & (Yg <= E_d)
 
     lin = a * Wg + b_gpu * n_cands + pen_set * s_ram + pen_vram * t + g_k * Yg
@@ -645,13 +677,19 @@ def _decomp_bound_roots(
     # _decomp_terms).
     hg = rd.has_gpu[None, :]
     rm = rd.ram_minus_n[None, :]
-    vram_rhs = jnp.minimum(rd.cuda_rhs, rd.metal_rhs)[None, :]
+    vram_rhs = jnp.minimum(
+        rd.cuda_rhs[None, :] - rd.eb_vram[None, :] * y_star, rd.metal_rhs[None, :]
+    )
     n_bnd = jnp.clip(jnp.floor(vram_rhs / rd.bprime), 0.0, w_star) * hg
     n_bnd = jnp.where(jnp.isfinite(n_bnd), n_bnd, w_star * hg)
     n_kink = (
         jnp.clip(
             jnp.ceil(
-                (rd.bprime * w_star + rd.eb[None, :] * y_star - rd.ram_rhs[None, :])
+                (
+                    rd.bprime * w_star
+                    + rd.eb_ram[None, :] * y_star
+                    - rd.ram_rhs[None, :]
+                )
                 / rd.bprime
                 - 1e-9
             ),
@@ -662,10 +700,29 @@ def _decomp_bound_roots(
         * rm
     )
     n_kink = jnp.where(jnp.isfinite(n_kink), n_kink, 0.0)
+    n_smin = (
+        jnp.clip(
+            jnp.ceil(
+                (rd.eb_ram[None, :] * y_star - rd.ram_rhs[None, :]) / rd.bprime
+                - 1e-9
+            ),
+            0.0,
+            w_star,
+        )
+        * hg
+        * rm
+    )
+    n_smin = jnp.where(jnp.isfinite(n_smin), n_smin, 0.0)
     n_star = jnp.where(
         c_star == 0,
         0.0,
-        jnp.where(c_star == 1, w_star * hg, jnp.where(c_star == 2, n_bnd, n_kink)),
+        jnp.where(
+            c_star == 1,
+            w_star * hg,
+            jnp.where(
+                c_star == 2, n_bnd, jnp.where(c_star == 3, n_kink, n_smin)
+            ),
+        ),
     )
     return bound, w_star, n_star, y_star
 
@@ -1008,7 +1065,8 @@ _RD_VEC_FIELDS = (
     "metal_rhs",
     "has_gpu",
     "g_raw",
-    "eb",
+    "eb_ram",
+    "eb_vram",
 )
 
 

@@ -47,11 +47,30 @@ both flagships (Mixtral 8x7B and DeepSeek-V3 E=256 over 32 devices; see
 ``tests/test_solver_moe.py::test_deepseek_v3_flagship_certified`` and
 ``backend_jax._decomp_bound_roots``).
 
-Deliberate v1 simplifications (documented, not hidden):
-- Experts charge the device's primary (RAM/unified) pool, not VRAM — a
-  ``y_gpu`` split mirroring ``n`` is future work.
-- Expert compute uses the CPU throughput table (consistent with the alpha
-  base path); the GPU delta for experts rides the same simplification.
+Expert pool placement (v2): each device hosts its expert slice in the
+memory pool where expert compute is fastest, decided per device at
+coefficient-build time:
+
+- split-memory accelerator (CUDA/TPU) whose measured expert throughput
+  beats the CPU's: expert bytes charge the VRAM capacity row
+  (``eb_vram``) and expert compute uses the accelerator table;
+- unified-memory accelerator (Apple Metal): compute at the faster of the
+  two tables; bytes charge the unified budget either way (``eb_ram``);
+- otherwise: CPU table, primary-RAM residency (``eb_ram``).
+
+This is a per-device *static* choice, not a per-expert solver variable: a
+fractional ``y_gpu`` split of one device's experts across its two pools is
+deliberately out of scope (expert slices are few and large, so the split
+granularity buys almost nothing, while the extra integer block would grow
+every backend — see git history for the trade study).
+
+Expert residency is HARD-capped: expert weights are needed at every MoE
+layer and cannot ride the disk-streaming slack the way pipeline-window
+layers can, so the memory rows admit no slack on the ``eb*y`` term — a
+fleet that cannot physically hold E experts is reported infeasible instead
+of "optimal at a disk penalty" (physically unrealizable).
+
+Deliberate v2 simplifications (documented, not hidden):
 - Dispatch cost reuses the measured per-device ``t_comm`` scalar as the
   all-to-all hop cost (2x: dispatch + combine).
 """
@@ -74,7 +93,8 @@ class MoEArrays:
     E: int  # routed experts per MoE layer
     n_moe: int  # MoE layer count
     g_raw: np.ndarray  # (M,) seconds per y-unit per segment, times k
-    eb: np.ndarray  # (M,) resident bytes per y-unit
+    eb_ram: np.ndarray  # (M,) resident bytes per y-unit in the primary pool
+    eb_vram: np.ndarray  # (M,) resident bytes per y-unit in discrete VRAM
 
 
 def model_has_moe_components(model: ModelProfile) -> bool:
@@ -174,11 +194,28 @@ def build_moe_arrays(
         model.experts_per_token
         * _moe_mean(model.flops_per_active_expert_per_token)
     )
+    f_dict = {"b_1": f_exp}
 
+    bytes_per_y = (1.0 + rho_w) * bpe * n_moe
     g_raw = np.zeros(M)
-    eb = np.zeros(M)
+    eb_ram = np.full(M, bytes_per_y)
+    eb_vram = np.zeros(M)
     for i, d in enumerate(devs):
-        sec = flops_over_flops_per_s({"b_1": f_exp}, d.scpu, model.Q)
+        sec_cpu = flops_over_flops_per_s(f_dict, d.scpu, model.Q)
+        sec_gpu = flops_over_flops_per_s(f_dict, d.gpu_table(), model.Q)
+        has_split_accel = (d.has_tpu and d.d_avail_tpu is not None) or (
+            d.has_cuda and d.d_avail_cuda is not None
+        )
+        # Pool choice (see module docstring). A 0.0 sec means "no table" —
+        # never treat it as infinitely fast on either side.
+        if d.is_unified_mem and sec_gpu > 0.0:
+            sec = min(sec_cpu, sec_gpu) if sec_cpu > 0.0 else sec_gpu
+        elif has_split_accel and sec_gpu > 0.0 and (
+            sec_gpu < sec_cpu or sec_cpu == 0.0
+        ):
+            sec = sec_gpu
+            eb_ram[i], eb_vram[i] = 0.0, bytes_per_y
+        else:
+            sec = sec_cpu
         g_raw[i] = (n_moe / float(E)) * (sec + 2.0 * d.t_comm)
-        eb[i] = (1.0 + rho_w) * bpe * n_moe
-    return MoEArrays(E=E, n_moe=n_moe, g_raw=g_raw, eb=eb)
+    return MoEArrays(E=E, n_moe=n_moe, g_raw=g_raw, eb_ram=eb_ram, eb_vram=eb_vram)

@@ -25,6 +25,22 @@ CONFIGS = Path(__file__).resolve().parent / "configs"
 MIXTRAL = str(CONFIGS / "mixtral_8x7b.json")
 
 
+def moe_fleet(M: int, seed: int, ram: float = 64e9):
+    """Synthetic fleet with enough memory to actually hold the expert set.
+
+    Expert residency is hard-capped (experts are hit at every MoE layer and
+    cannot disk-stream), so MoE instances need fleets whose pools can hold
+    E expert slices — Mixtral 8x7B carries ~10 GB per expert slot."""
+    devs = make_synthetic_fleet(M, seed=seed)
+    for d in devs:
+        d.d_avail_ram = int(ram)
+        if d.d_avail_metal is not None:
+            d.d_avail_metal = int(ram)
+        if d.d_avail_cuda is not None:
+            d.d_avail_cuda = int(ram)
+    return devs
+
+
 @pytest.fixture(scope="module")
 def moe_model():
     split = profile_model(MIXTRAL, batch_sizes=[1], sequence_length=128)
@@ -53,12 +69,20 @@ def test_build_moe_arrays(moe_model):
     moe = build_moe_arrays(devs, moe_model)
     assert moe.E == 8 and moe.n_moe == 32
     assert moe.g_raw.shape == (4,) and (moe.g_raw > 0).all()
-    # Resident bytes per expert-slot: all 32 layers' slice of one expert.
-    assert (moe.eb > 32 * 3e8).all()
+    # Resident bytes per expert-slot: all 32 layers' slice of one expert,
+    # charged to exactly one pool per device.
+    eb_total = moe.eb_ram + moe.eb_vram
+    assert (eb_total > 32 * 3e8).all()
+    assert ((moe.eb_ram == 0) | (moe.eb_vram == 0)).all()
+    # The fleet cycles mac_metal/linux_cuda/linux_cpu/android: the CUDA box
+    # (index 1) has the faster expert table, so its slice lives in VRAM;
+    # the others charge their primary pool.
+    assert moe.eb_vram[1] > 0 and moe.eb_ram[1] == 0
+    assert moe.eb_vram[0] == moe.eb_vram[2] == moe.eb_vram[3] == 0
 
 
 def test_cpu_moe_solve(moe_model):
-    devs = make_synthetic_fleet(4, seed=7)
+    devs = moe_fleet(4, seed=7)
     res = halda_solve(devs, moe_model, kv_bits="8bit", backend="cpu", mip_gap=1e-3)
     assert res.y is not None
     assert sum(res.y) == moe_model.n_routed_experts
@@ -67,7 +91,7 @@ def test_cpu_moe_solve(moe_model):
 
 
 def test_moe_off_by_flag(moe_model):
-    devs = make_synthetic_fleet(4, seed=7)
+    devs = moe_fleet(4, seed=7)
     res = halda_solve(
         devs, moe_model, kv_bits="8bit", backend="cpu", mip_gap=1e-3, moe=False
     )
@@ -86,7 +110,7 @@ def test_moe_flag_requires_components():
 
 def test_memory_affinity(moe_model):
     """Experts should concentrate on the device with memory headroom."""
-    devs = make_synthetic_fleet(2, seed=3)
+    devs = moe_fleet(2, seed=3)
     big, small = devs[0], devs[1]
     big.d_avail_ram = int(400e9)
     if big.d_avail_metal is not None:
@@ -94,6 +118,8 @@ def test_memory_affinity(moe_model):
     small.d_avail_ram = int(2e9)
     if small.d_avail_metal is not None:
         small.d_avail_metal = int(2e9)
+    if small.d_avail_cuda is not None:
+        small.d_avail_cuda = int(2e9)
     res = halda_solve(devs, moe_model, kv_bits="8bit", backend="cpu", mip_gap=1e-3)
     assert res.y is not None
     assert res.y[0] > res.y[1]
@@ -101,7 +127,7 @@ def test_memory_affinity(moe_model):
 
 @pytest.mark.parametrize("M", [4, 8])
 def test_jax_matches_cpu(moe_model, M):
-    devs = make_synthetic_fleet(M, seed=7)
+    devs = moe_fleet(M, seed=7)
     gap = 1e-3
     ref = halda_solve(devs, moe_model, kv_bits="8bit", backend="cpu", mip_gap=gap)
     got = halda_solve(devs, moe_model, kv_bits="8bit", backend="jax", mip_gap=gap)
@@ -111,6 +137,53 @@ def test_jax_matches_cpu(moe_model, M):
     # differ by at most twice that.
     tol = 2 * gap * abs(ref.obj_value) + 1e-9
     assert abs(got.obj_value - ref.obj_value) <= tol
+
+
+def test_gpu_heavy_fleet_experts_shift_to_accelerators(moe_model):
+    """On a fleet mixing fast-GPU boxes and CPU-only boxes with EQUAL memory,
+    expert placement must favor the accelerator devices (their expert slices
+    run on the GPU table and live in VRAM), and the CPU oracle must agree —
+    the v1 formulation priced every expert at CPU speed and charged RAM, so
+    a GPU-heavy fleet's expert objective was systematically wrong."""
+    # 2 CUDA boxes + 2 slow CPU-only boxes, equal memory and t_comm: the
+    # only expert signal left is compute throughput and the VRAM pool.
+    pool = moe_fleet(8, seed=1)
+    devs = [pool[1], pool[5], pool[2], pool[6]]  # cuda, cuda, cpu, cpu
+    for i, d in enumerate(devs):
+        d.is_head = i == 0
+        d.t_comm = 0.01
+        if d.d_avail_cuda is not None:
+            d.d_avail_cuda = int(250e9)
+        else:
+            # Slow, GPU-less edge boxes: expert FLOPs on them actually hurt.
+            d.scpu = {
+                q: {b: v / 50.0 for b, v in cols.items()}
+                for q, cols in d.scpu.items()
+            }
+    moe = build_moe_arrays(devs, moe_model)
+    assert (moe.eb_vram[[0, 1]] > 0).all() and (moe.eb_vram[[2, 3]] == 0).all()
+    # GPU expert throughput beats the slow CPUs: smaller busy coefficient.
+    assert moe.g_raw[0] < moe.g_raw[2] and moe.g_raw[1] < moe.g_raw[3]
+
+    gap = 1e-3
+    ref = halda_solve(devs, moe_model, kv_bits="8bit", backend="cpu", mip_gap=gap)
+    got = halda_solve(devs, moe_model, kv_bits="8bit", backend="jax", mip_gap=gap)
+    tol = 2 * gap * abs(ref.obj_value) + 1e-9
+    assert abs(got.obj_value - ref.obj_value) <= tol
+    # Accelerator devices host the majority of the expert set.
+    assert got.y[0] + got.y[1] > got.y[2] + got.y[3]
+
+
+def test_expert_residency_is_hard_capped(moe_model):
+    """A fleet whose pools cannot physically hold the E expert slices is
+    reported infeasible — not 'optimal' at a disk penalty the hardware could
+    never realize (expert weights are needed at every MoE layer and cannot
+    ride the layer-streaming slack)."""
+    devs = moe_fleet(2, seed=3, ram=4e9)  # ~10 GB per expert slot won't fit
+    with pytest.raises(RuntimeError, match="No feasible"):
+        halda_solve(devs, moe_model, kv_bits="8bit", backend="cpu", mip_gap=1e-3)
+    with pytest.raises(RuntimeError, match="No feasible"):
+        halda_solve(devs, moe_model, kv_bits="8bit", backend="jax", mip_gap=1e-3)
 
 
 def test_deepseek_v3_flagship_certified():
@@ -127,7 +200,9 @@ def test_deepseek_v3_flagship_certified():
     )
     model = split.to_model_profile()
     assert model.n_routed_experts == 256
-    devs = make_synthetic_fleet(32, seed=11)
+    # ~1.6 GB per expert slot x 256 slots: the fleet needs ~420 GB of pools
+    # to hold the expert set honestly (residency is hard-capped).
+    devs = moe_fleet(32, seed=11, ram=32e9)
     gap = 1e-3
     ref = halda_solve(devs, model, kv_bits="8bit", backend="cpu", mip_gap=gap)
     with warnings.catch_warnings():
