@@ -26,7 +26,8 @@ Row layout of A_ub:
     [M, 2M)   RAM/unified residency cap per device (set-dependent shape;
               MoE mode adds eb_ram_i * y_i resident expert bytes)
     [2M,3M)   CUDA VRAM cap (MoE mode adds eb_vram_i * y_i)
-    [3M,4M)   Metal shared-memory cap
+    [3M,4M)   Metal shared-memory cap (MoE mode adds eb_metal_i * y_i for
+              unified devices whose expert compute elects the GPU table)
     [4M,5M)   cycle bound:   B_i + z_i - C <= -(xi_i + t_comm_i)
     [5M,6M)   prefetch bound: B_i + F_i - z_i - C <= -(xi_i + t_comm_i)
     [6M,7M)   (MoE only) s_i - w_i <= 0: a device cannot stream more layers
@@ -225,9 +226,11 @@ def assemble(coeffs: HaldaCoeffs, moe: Optional[MoEArrays] = None) -> MilpArrays
         A_ub[r, lay.t(i)] = -bp
         b_ub[r] = coeffs.cuda_rhs[i] if coeffs.cuda_row[i] else INACTIVE_RHS
 
-        # --- Metal shared-memory row ---
+        # --- Metal shared-memory row (wired expert slices charge it too) ---
         r = 3 * M + i
         A_ub[r, lay.n(i)] = bp
+        if moe is not None:
+            A_ub[r, lay.y(i)] = moe.eb_metal[i]
         A_ub[r, lay.t(i)] = -bp
         b_ub[r] = coeffs.metal_rhs[i] if coeffs.metal_row[i] else INACTIVE_RHS
 

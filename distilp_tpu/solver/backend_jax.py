@@ -103,6 +103,7 @@ class RoundingData(NamedTuple):
     g_raw: jax.Array  # (M,) MoE expert busy seconds per y-unit, times k
     eb_ram: jax.Array  # (M,) MoE bytes per y-unit charged to the primary pool
     eb_vram: jax.Array  # (M,) MoE bytes per y-unit charged to discrete VRAM
+    eb_metal: jax.Array  # (M,) MoE bytes per y-unit on the Metal wired row
     bprime: jax.Array  # scalar
     E: jax.Array  # scalar: routed experts per MoE layer (0 = dense)
 
@@ -133,6 +134,9 @@ def _rounding_arrays_np(coeffs: HaldaCoeffs, moe=None) -> dict:
         ),
         eb_vram=np.asarray(
             moe.eb_vram if moe is not None else np.zeros(M), np.float64
+        ),
+        eb_metal=np.asarray(
+            moe.eb_metal if moe is not None else np.zeros(M), np.float64
         ),
         bprime=np.float64(coeffs.bprime),
         E=np.float64(moe.E if moe is not None else 0.0),
@@ -350,17 +354,26 @@ def _round_to_incumbent(
         # it so expert bytes never ride the layer slack. s_cap (= W) stays
         # as the structural bound.
         ok = jnp.all(s_ram <= jnp.minimum(w, s_cap))
-        # VRAM slack: one t_i covers both CUDA and Metal rows; VRAM-resident
-        # experts (eb_vram) make it y-dependent. t <= n mirrors s <= w.
+        # VRAM slack: one t_i covers both CUDA and Metal rows; pool-resident
+        # experts (eb_vram / eb_metal) make it y-dependent.
         viol_vram = jnp.maximum(
             jnp.maximum(
-                bp * n + rd.eb_vram * y_t - rd.cuda_rhs, bp * n - rd.metal_rhs
+                bp * n + rd.eb_vram * y_t - rd.cuda_rhs,
+                bp * n + rd.eb_metal * y_t - rd.metal_rhs,
             ),
             0.0,
         )
         viol_vram = jnp.where(jnp.isfinite(viol_vram), viol_vram, 0.0)
         t = jnp.ceil(viol_vram / bp - 1e-9)
-        ok &= jnp.all(t <= n + 1e-9)
+        if moe:
+            # t <= n mirrors the MoE-only MILP row (rows 7M..8M): expert
+            # bytes must fit VRAM, they cannot ride the offload slack.
+            ok &= jnp.all(t <= n + 1e-9)
+        else:
+            # Dense MILP bounds t only by W*has_gpu — a device with negative
+            # VRAM headroom (c_gpu > d_avail) legitimately pays the disk
+            # penalty at n = 0, exactly like the CPU/HiGHS oracle.
+            ok &= jnp.all(t <= Wf * rd.has_gpu + 1e-9)
         pen_cost = rd.pen_set * s_ram + rd.pen_vram * t
         lin = rd.a * w + rd.b_gpu * n + pen_cost + g_k * y_t
         busy = lin + rd.busy_const
@@ -437,7 +450,9 @@ def _round_to_incumbent(
     return obj, w, n, y
 
 
-def _decomp_terms(rd: RoundingData, ks, Ws, w_max: int, e_max: int, dtype):
+def _decomp_terms(
+    rd: RoundingData, ks, Ws, w_max: int, e_max: int, dtype, moe: bool = True
+):
     """Enumeration tensors of the Lagrangian decomposition bound.
 
     For each k-candidate j, device i, integer w in [1, w_max], y in
@@ -485,15 +500,17 @@ def _decomp_terms(rd: RoundingData, ks, Ws, w_max: int, e_max: int, dtype):
     hg = dev(rd.has_gpu)
     ebr = dev(rd.eb_ram)
     ebv = dev(rd.eb_vram)
+    ebm = dev(rd.eb_metal)
     g_k = dev(rd.g_raw) / kj
     bp_d = bp.astype(dtype)
     E_d = rd.E.astype(dtype)
     s_cap = Wj  # hard cap: slack streams layers, never expert bytes
 
-    # VRAM headroom left for n after the VRAM-resident expert slice (the
-    # CUDA row carries eb_vram*y; the Metal row never does).
+    # VRAM headroom left for n after the pool-resident expert slice (the
+    # CUDA row carries eb_vram*y; the Metal row eb_metal*y).
     cuda_head = cuda - ebv * Yg
-    vram_rhs = jnp.minimum(cuda_head, metal)
+    metal_head = metal - ebm * Yg
+    vram_rhs = jnp.minimum(cuda_head, metal_head)
     n_boundary = jnp.clip(jnp.floor(vram_rhs / bp_d), 0.0, Wg) * hg
     n_boundary = jnp.where(jnp.isfinite(n_boundary), n_boundary, Wg * hg)
     # RAM-slack kink: smallest n with zero RAM slack, ceil(K) for
@@ -526,12 +543,17 @@ def _decomp_terms(rd: RoundingData, ks, Ws, w_max: int, e_max: int, dtype):
     # the slack; vacuous in dense mode where viol <= b'*w anyway).
     ok = s_ram <= jnp.minimum(Wg, s_cap)
     viol_v = jnp.maximum(
-        jnp.maximum(bp_d * n_cands + ebv * Yg - cuda, bp_d * n_cands - metal),
+        jnp.maximum(
+            bp_d * n_cands + ebv * Yg - cuda, bp_d * n_cands + ebm * Yg - metal
+        ),
         0.0,
     )
     viol_v = jnp.where(jnp.isfinite(viol_v), viol_v, 0.0)
     t = jnp.ceil(viol_v / bp_d - 1e-9)
-    ok &= t <= n_cands + 1e-9
+    if moe:
+        ok &= t <= n_cands + 1e-9  # MoE rows 7M..8M: t <= n
+    else:
+        ok &= t <= Wj * hg + 1e-9  # dense: t only bounded by W*has_gpu
     ok &= (Wg <= Wj) & (Yg <= E_d)
 
     lin = a * Wg + b_gpu * n_cands + pen_set * s_ram + pen_vram * t + g_k * Yg
@@ -546,7 +568,9 @@ def _decomp_bound_roots(
     w_max: int,
     e_max: int,
     steps: int = 300,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    moe: bool = True,
+    init_params: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, Tuple[jax.Array, ...]]:
     """Per-k Lagrangian decomposition lower bounds on the fixed-k MILP.
 
     Dualize the two coupling constraints (sum w = W, sum y = E) and split the
@@ -565,10 +589,19 @@ def _decomp_bound_roots(
     optimized by momentum ascent in f32 (gradients through the min pick the
     argmin cell); the returned bound is ONE final f64 evaluation at the best
     multipliers, so f32 only costs tightness, never soundness.
+
+    ``init_params`` warm-starts the ascent from a previous solve's best
+    (lambda, mu, tau) — the bound is valid for ANY multipliers, so a
+    streaming tick can run a short (or zero-step) ascent from the stored
+    duals and still certify. The initial point is always evaluated and kept
+    in the best-of tracking, and the chosen multipliers are returned so the
+    caller can persist them for the next tick.
     """
     n_k = ks.shape[0]
     M = rd.a.shape[0]
-    lin32, cyc32, ok, w_vals, y_vals = _decomp_terms(rd, ks, Ws, w_max, e_max, DTYPE)
+    lin32, cyc32, ok, w_vals, y_vals = _decomp_terms(
+        rd, ks, Ws, w_max, e_max, DTYPE, moe=moe
+    )
     big = jnp.asarray(3.4e37, DTYPE)
     wv = w_vals[None, None, :, None]
     yv = y_vals[None, None, None, :]
@@ -588,11 +621,14 @@ def _decomp_bound_roots(
         return -jnp.sum(b), b
 
     grad_fn = jax.grad(lambda p: neg_bound32(p)[0])
-    params0 = (
-        jnp.zeros(n_k, DTYPE),
-        jnp.zeros(n_k, DTYPE),
-        jnp.zeros((n_k, M), DTYPE),
-    )
+    if init_params is not None:
+        params0 = tuple(p.astype(DTYPE) for p in init_params)
+    else:
+        params0 = (
+            jnp.zeros(n_k, DTYPE),
+            jnp.zeros(n_k, DTYPE),
+            jnp.zeros((n_k, M), DTYPE),
+        )
 
     # Adam ascent on the bounds. The dual function is piecewise linear and
     # badly scaled across instances (dual-optimal multipliers range from
@@ -630,13 +666,20 @@ def _decomp_bound_roots(
         return (params, m_st, v_st, best_b, best_params), None
 
     zeros = jax.tree.map(jnp.zeros_like, params0)
-    init = (params0, zeros, zeros, jnp.full(n_k, -jnp.inf, DTYPE), params0)
-    (_, _, _, _, best_params), _ = jax.lax.scan(
-        step, init, jnp.arange(steps), length=steps
-    )
+    # The initial point (stored duals on a warm tick, zeros cold) is a valid
+    # multiplier vector: evaluate it and let the ascent only improve on it.
+    init = (params0, zeros, zeros, neg_bound32(params0)[1], params0)
+    if steps > 0:
+        (_, _, _, _, best_params), _ = jax.lax.scan(
+            step, init, jnp.arange(steps), length=steps
+        )
+    else:
+        best_params = params0
 
     # Rigorous final evaluation: f64 pricing at the chosen multipliers.
-    lin64, cyc64, ok64, w64, y64 = _decomp_terms(rd, ks, Ws, w_max, e_max, BDTYPE)
+    lin64, cyc64, ok64, w64, y64 = _decomp_terms(
+        rd, ks, Ws, w_max, e_max, BDTYPE, moe=moe
+    )
     lam, mu, tau = jax.tree.map(lambda p: p.astype(BDTYPE), best_params)
     theta = (ks - 1.0)[:, None] * jax.nn.softmax(tau, axis=1)
     term = (
@@ -678,7 +721,8 @@ def _decomp_bound_roots(
     hg = rd.has_gpu[None, :]
     rm = rd.ram_minus_n[None, :]
     vram_rhs = jnp.minimum(
-        rd.cuda_rhs[None, :] - rd.eb_vram[None, :] * y_star, rd.metal_rhs[None, :]
+        rd.cuda_rhs[None, :] - rd.eb_vram[None, :] * y_star,
+        rd.metal_rhs[None, :] - rd.eb_metal[None, :] * y_star,
     )
     n_bnd = jnp.clip(jnp.floor(vram_rhs / rd.bprime), 0.0, w_star) * hg
     n_bnd = jnp.where(jnp.isfinite(n_bnd), n_bnd, w_star * hg)
@@ -724,7 +768,7 @@ def _decomp_bound_roots(
             ),
         ),
     )
-    return bound, w_star, n_star, y_star
+    return bound, w_star, n_star, y_star, (lam, mu, tau)
 
 
 class SearchState(NamedTuple):
@@ -1067,6 +1111,7 @@ _RD_VEC_FIELDS = (
     "g_raw",
     "eb_ram",
     "eb_vram",
+    "eb_metal",
 )
 
 

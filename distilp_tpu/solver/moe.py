@@ -55,7 +55,9 @@ coefficient-build time:
   beats the CPU's: expert bytes charge the VRAM capacity row
   (``eb_vram``) and expert compute uses the accelerator table;
 - unified-memory accelerator (Apple Metal): compute at the faster of the
-  two tables; bytes charge the unified budget either way (``eb_ram``);
+  two tables; bytes charge the unified budget either way (``eb_ram``), and
+  when GPU compute wins they additionally charge the Metal working-set row
+  (``eb_metal``) — the wired budget can be smaller than the unified one;
 - otherwise: CPU table, primary-RAM residency (``eb_ram``).
 
 This is a per-device *static* choice, not a per-expert solver variable: a
@@ -95,6 +97,11 @@ class MoEArrays:
     g_raw: np.ndarray  # (M,) seconds per y-unit per segment, times k
     eb_ram: np.ndarray  # (M,) resident bytes per y-unit in the primary pool
     eb_vram: np.ndarray  # (M,) resident bytes per y-unit in discrete VRAM
+    # (M,) bytes per y-unit charged to the Metal working-set row: unified
+    # devices whose expert compute elects the GPU table wire their expert
+    # slice, so it must fit the (possibly smaller) wired budget too — the
+    # unified budget row (eb_ram) alone would miss d_avail_metal < d_avail_ram.
+    eb_metal: np.ndarray
 
 
 def model_has_moe_components(model: ModelProfile) -> bool:
@@ -200,6 +207,7 @@ def build_moe_arrays(
     g_raw = np.zeros(M)
     eb_ram = np.full(M, bytes_per_y)
     eb_vram = np.zeros(M)
+    eb_metal = np.zeros(M)
     for i, d in enumerate(devs):
         sec_cpu = flops_over_flops_per_s(f_dict, d.scpu, model.Q)
         sec_gpu = flops_over_flops_per_s(f_dict, d.gpu_table(), model.Q)
@@ -209,7 +217,12 @@ def build_moe_arrays(
         # Pool choice (see module docstring). A 0.0 sec means "no table" —
         # never treat it as infinitely fast on either side.
         if d.is_unified_mem and sec_gpu > 0.0:
-            sec = min(sec_cpu, sec_gpu) if sec_cpu > 0.0 else sec_gpu
+            use_gpu = sec_cpu == 0.0 or sec_gpu < sec_cpu
+            sec = sec_gpu if use_gpu else sec_cpu
+            if use_gpu:
+                # GPU-resident experts are wired: they must also fit the
+                # Metal working-set budget, not only the unified RAM row.
+                eb_metal[i] = bytes_per_y
         elif has_split_accel and sec_gpu > 0.0 and (
             sec_gpu < sec_cpu or sec_cpu == 0.0
         ):
@@ -218,4 +231,7 @@ def build_moe_arrays(
         else:
             sec = sec_cpu
         g_raw[i] = (n_moe / float(E)) * (sec + 2.0 * d.t_comm)
-    return MoEArrays(E=E, n_moe=n_moe, g_raw=g_raw, eb_ram=eb_ram, eb_vram=eb_vram)
+    return MoEArrays(
+        E=E, n_moe=n_moe, g_raw=g_raw, eb_ram=eb_ram, eb_vram=eb_vram,
+        eb_metal=eb_metal,
+    )
