@@ -1,0 +1,15 @@
+.PHONY: lint
+lint:
+	@command -v ruff >/dev/null 2>&1 && ruff check . || python tools/lint.py
+
+.PHONY: format
+format:
+	ruff format --diff .
+
+.PHONY: test
+test:
+	python -m pytest tests/ -q
+
+.PHONY: bench
+bench:
+	python bench.py

@@ -8,18 +8,27 @@ equivalent scipy/HiGHS branch-and-cut sweep measured in-process (the same
 engine the reference uses, see BASELINE.md).
 
 Prints ONE JSON line:
-    {"metric": ..., "value": <cold jax ms>, "unit": "ms", "vs_baseline":
-     <speedup>, "warm_tick_ms": <warm-start streaming re-solve ms>,
-     "placements_per_sec": <1000 / warm_tick_ms>}
+    {"metric": ..., "value": <cold jax ms, median of N>, "unit": "ms",
+     "vs_baseline": <speedup>,
+     "warm_tick_ms": <warm-start streaming re-solve ms>,
+     "placements_per_sec": <1000 / warm_tick_ms>,
+     "moe_warm_tick_ms": <DeepSeek-V3 E=256 32-device streaming MoE
+                          re-placement, certified, median ms>,
+     "breakdown": {"pack_ms", "upload_ms", "solve_ms"}}
 
-The extra keys report the streaming north star (BASELINE.json
-"placements/sec over k-sweep"): each tick perturbs the fleet's measured
-t_comm and re-solves warm-started from the previous placement.
+All headline numbers are medians of REPEATS runs (best-of flattered the
+result; the median is what a user sees). The extra keys report the
+streaming north star (BASELINE.json "placements/sec over k-sweep" and
+config 5 "DeepSeek-V3 MoE real-time re-placement"): each tick perturbs the
+fleet's measured t_comm and re-solves warm-started from the previous
+placement — for MoE, the previous tick's Lagrangian multipliers certify
+the re-solve without re-running the root ascent.
 """
 
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 import time
 from pathlib import Path
@@ -30,6 +39,7 @@ sys.path.insert(0, str(REPO))
 REPEATS = 10
 MIP_GAP = 1e-3
 M_DEVICES = 16
+MOE_DEVICES = 32
 
 
 def main() -> int:
@@ -50,7 +60,7 @@ def main() -> int:
     ref = halda_solve(devs, model, mip_gap=MIP_GAP, kv_bits="4bit", backend="cpu")
     cpu_ms = (time.perf_counter() - t0) * 1e3
 
-    # JAX backend: warm up (compile), then best-of-N wall clock.
+    # JAX backend: warm up (compile), then median-of-N wall clock.
     got = halda_solve(devs, model, mip_gap=MIP_GAP, kv_bits="4bit", backend="jax")
     assert abs(got.obj_value - ref.obj_value) <= 2 * MIP_GAP * abs(ref.obj_value) + 1e-9, (
         f"backend disagreement: jax={got.obj_value} cpu={ref.obj_value}"
@@ -58,11 +68,18 @@ def main() -> int:
     assert got.certified, f"north-star solve not certified (gap={got.gap})"
 
     times = []
+    breakdown: dict = {}
     for _ in range(REPEATS):
+        tm: dict = {}
         t0 = time.perf_counter()
-        halda_solve(devs, model, mip_gap=MIP_GAP, kv_bits="4bit", backend="jax")
+        halda_solve(
+            devs, model, mip_gap=MIP_GAP, kv_bits="4bit", backend="jax", timings=tm
+        )
         times.append((time.perf_counter() - t0) * 1e3)
-    jax_ms = min(times)
+        for k, v in tm.items():
+            breakdown.setdefault(k, []).append(v)
+    jax_ms = statistics.median(times)
+    breakdown = {k: round(statistics.median(v), 3) for k, v in breakdown.items()}
 
     # Streaming re-placement: warm-started ticks under drifting t_comm.
     planner = StreamingReplanner(mip_gap=MIP_GAP, kv_bits="4bit", backend="jax")
@@ -75,7 +92,12 @@ def main() -> int:
         t0 = time.perf_counter()
         planner.step(devs, model)
         warm_times.append((time.perf_counter() - t0) * 1e3)
-    warm_ms = min(warm_times)
+    warm_ms = statistics.median(warm_times)
+
+    # MoE real-time re-placement (BASELINE.json config 5): DeepSeek-V3,
+    # E=256 routed experts co-assigned over a 32-device fleet. Warm ticks
+    # re-certify against the bound at the previous tick's multipliers.
+    moe_ms, moe_result = _moe_warm_tick(rng)
 
     print(
         json.dumps(
@@ -86,10 +108,50 @@ def main() -> int:
                 "vs_baseline": round(cpu_ms / jax_ms, 3),
                 "warm_tick_ms": round(warm_ms, 3),
                 "placements_per_sec": round(1000.0 / warm_ms, 1),
+                "moe_warm_tick_ms": round(moe_ms, 3),
+                "moe_certified": moe_result.certified,
+                "breakdown": breakdown,
             }
         )
     )
     return 0
+
+
+def _moe_warm_tick(rng):
+    """Median certified warm-tick ms on the DeepSeek-V3 32-device flagship."""
+    from distilp_tpu.profiler.api import profile_model
+    from distilp_tpu.solver.streaming import StreamingReplanner
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    split = profile_model(
+        str(REPO / "tests" / "configs" / "deepseek_v3.json"),
+        batch_sizes=[1],
+        sequence_length=128,
+    )
+    model = split.to_model_profile()
+    devs = make_synthetic_fleet(MOE_DEVICES, seed=11)
+    for d in devs:
+        # Expert residency is hard-capped: the fleet must physically hold
+        # the E=256 expert slices (~1.6 GB each), so give every pool 32 GB.
+        d.d_avail_ram = int(32e9)
+        if d.d_avail_metal is not None:
+            d.d_avail_metal = int(32e9)
+        if d.d_avail_cuda is not None:
+            d.d_avail_cuda = int(32e9)
+    planner = StreamingReplanner(mip_gap=MIP_GAP, kv_bits="8bit", backend="jax")
+    planner.step(devs, model)  # cold solve + compile
+    planner.step(devs, model)  # compile the warm layout
+    times = []
+    result = planner.last
+    for _ in range(REPEATS):
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.95, 1.05)))
+        t0 = time.perf_counter()
+        result = planner.step(devs, model)
+        times.append((time.perf_counter() - t0) * 1e3)
+    assert result.certified, f"MoE warm tick not certified (gap={result.gap})"
+    assert sum(result.y) == model.n_routed_experts
+    return statistics.median(times), result
 
 
 if __name__ == "__main__":

@@ -50,6 +50,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="expert+layer co-assignment: auto (when the profile has MoE "
         "component metrics), on (require them), off (dense formulation)",
     )
+    # JAX-backend search knobs (None = problem-class defaults, see
+    # backend_jax.default_search_params). The certificate warning names
+    # these; they must be reachable from the shell, not only the API.
+    p.add_argument(
+        "--max-rounds", type=int, default=None,
+        help="branch-and-bound round budget (jax backend)",
+    )
+    p.add_argument(
+        "--beam", type=int, default=None,
+        help="frontier rows given an IPM solve per round (jax backend)",
+    )
+    p.add_argument(
+        "--ipm-iters", type=int, default=None,
+        help="interior-point iterations per LP relaxation (jax backend)",
+    )
+    p.add_argument(
+        "--node-cap", type=int, default=None,
+        help="frontier capacity; overflow floors the certificate (jax backend)",
+    )
     return p
 
 
@@ -81,11 +100,18 @@ def main(argv=None) -> int:
             backend=args.backend,
             time_limit=args.time_limit,
             moe={"auto": None, "on": True, "off": False}[args.moe],
+            max_rounds=args.max_rounds,
+            beam=args.beam,
+            ipm_iters=args.ipm_iters,
+            node_cap=args.node_cap,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     result.print_solution(devices)
+    status = "certified" if result.certified else "NOT certified"
+    gap_txt = f"{result.gap:.3g}" if result.gap is not None else "exact (HiGHS)"
+    print(f"Optimality: {status} (achieved gap {gap_txt})")
 
     if args.save_solution:
         payload = {
@@ -95,6 +121,8 @@ def main(argv=None) -> int:
             "obj_value": result.obj_value,
             "sets": result.sets,
             "devices": [d.name for d in devices],
+            "certified": result.certified,
+            "gap": result.gap,
         }
         if result.y is not None:
             payload["y"] = result.y

@@ -48,6 +48,13 @@ class DeviceProfile(BaseModel):
     t_ram2vram: float = 0.0
     t_vram2ram: float = 0.0
     t_comm: float = 0.0  # t^{comm}_m: per-round inter-device communication time
+    # Interconnect link shape behind t_comm (extension; 0 = unmeasured).
+    # t_comm above is latency + activation_payload/bandwidth at profile time;
+    # carrying the two terms lets the solver price OTHER payloads (e.g. the
+    # MoE all-to-all token dispatch) on the same measured link instead of
+    # reusing the scalar for every message size.
+    comm_latency: float = 0.0  # seconds, small-message collective latency
+    comm_bandwidth: float = 0.0  # bytes/s, sustained large-message link rate
 
     # Disk read throughput s^{disk}_m (bytes/s).
     s_disk: float = 0.0

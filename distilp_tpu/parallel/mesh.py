@@ -94,7 +94,9 @@ def solve_sweep_sharded(
 
     from ..solver.backend_jax import (
         BDTYPE,
+        DECOMP_STEPS_COLD,
         _init_state,
+        _seed_root_bounds,
         _solve_fused,
         _sweep_data,
         build_standard_form,
@@ -116,10 +118,29 @@ def solve_sweep_sharded(
     beam = min(pad_cap_to_mesh(beam, mesh), cap)
     ipm_iters = ipm_iters if ipm_iters is not None else d_iters
 
-    data = _sweep_data(sf, rounding_data(coeffs, arrays.moe))
+    rd = rounding_data(coeffs, arrays.moe)
+    data = _sweep_data(sf, rd)
     gap = jnp.asarray(mip_gap, BDTYPE)
 
     state = _init_state(sf, cap=cap)
+    if sf.moe:
+        # Same Lagrangian decomposition root bounds + primal seeding as the
+        # single-chip packed path: without them, wide-expert MoE instances
+        # cannot close the structural LP root gap and the sharded sweep
+        # would silently miss the certificate the single-chip path earns.
+        state, _ = _seed_root_bounds(
+            state,
+            rd,
+            jnp.asarray(sf.ks, BDTYPE),
+            jnp.asarray(sf.Ws, BDTYPE),
+            jnp.asarray(sf.obj_const, BDTYPE),
+            sf.A.shape[2],
+            M,
+            True,
+            max(W for _, W in feasible),
+            int(arrays.moe.E),
+            DECOMP_STEPS_COLD,
+        )
     state = shard_state(state, mesh)
     replicated = NamedSharding(mesh, P())
     data = jax.tree.map(lambda x: jax.device_put(x, replicated), data)

@@ -15,7 +15,7 @@ Wire-compatible superset of the reference's ``DeviceInfo`` tree
 
 from __future__ import annotations
 
-from typing import Literal
+from typing import Dict, Literal
 
 from pydantic import BaseModel, Field
 
@@ -48,13 +48,27 @@ class CPUCache(BaseModel):
 
 
 class Stat(BaseModel):
+    """Distribution of one microbenchmark's timed samples (seconds).
+
+    The reference prints p50/p95/p99 at debug>=1 and then discards them
+    (/root/reference/src/distilp/profiler/profiler/device.py:188-197); here
+    the spread is carried on the profile so a consumer can judge whether a
+    throughput entry is trustworthy. ``valid=False`` marks a measurement
+    whose net time was within the dispatch round-trip noise — its derived
+    throughput is NOT stored (the table keeps the 0.0 "no table" sentinel
+    instead of an absurd number).
+    """
+
     samples: int = 0
     min: float = 0.0
     p50: float = 0.0
     p95: float = 0.0
+    p99: float = 0.0
     max: float = 0.0
     mean: float = 0.0
     stddev: float = 0.0
+    baseline: float = 0.0  # subtracted dispatch/fetch round-trip floor
+    valid: bool = True
 
 
 class Batches(BaseModel):
@@ -126,6 +140,11 @@ class GPUMemory(BaseModel):
     two_read_one_write_bw: float = 0.0
     vram_to_compute: float = 0.0  # device-memory streaming bytes/s
     unified_memory: bool = False
+    # Where ``total``/``free`` came from: "memory_stats" (runtime-reported),
+    # "table:<device kind>" (static per-chip HBM table), "env:DPERF_HBM_BYTES"
+    # (operator override), or "unknown" (unlisted kind — capacity is 0 and
+    # must not be trusted).
+    capacity_source: str = ""
 
 
 class GPUInfo(BaseModel):
@@ -148,6 +167,7 @@ class InterconnectInfo(BaseModel):
     num_slices: int = 1
     ici_allreduce_latency_s: float = 0.0  # small-message all-reduce time
     ici_bandwidth: float = 0.0  # bytes/s per link, large-message all-gather
+    dcn_latency_s: float = 0.0  # cross-slice small-message latency (0 = unknown)
     dcn_bandwidth: float = 0.0  # bytes/s across slices (0 = unknown)
     topology: str = ""  # e.g. "2x4" when coords are available
 
@@ -159,3 +179,7 @@ class DeviceInfo(BaseModel):
     disk: DiskInfo = Field(default_factory=DiskInfo)
     memory: SystemMemory = Field(default_factory=SystemMemory)
     interconnect: InterconnectInfo = Field(default_factory=InterconnectInfo)
+    # Timing spread of each microbenchmark, keyed "<area>.<detail>" (e.g.
+    # "gemm.tpu.bf16.b_8", "mem.cpu_read_warm"): the raw-measurement
+    # observability the reference prints at debug>=1 and throws away.
+    stats: Dict[str, Stat] = Field(default_factory=dict)

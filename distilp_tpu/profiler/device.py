@@ -32,7 +32,7 @@ import os
 import platform
 import statistics as stats
 import time
-from math import ceil
+
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional
 
@@ -58,11 +58,18 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _quantile(sorted_times: List[float], q: float) -> float:
+    idx = min(len(sorted_times) - 1, int(round(q * (len(sorted_times) - 1))))
+    return sorted_times[idx]
+
+
 def bench(
     fn: Callable[[], Any],
     warmup: int = 3,
     iters: int = 10,
     baseline: float = 0.0,
+    label: str = "",
+    sink: Optional[Dict[str, Any]] = None,
 ) -> float:
     """Median wall-clock seconds of ``fn`` (reference profiler/device.py:
     175-199), minus ``baseline`` (the round-trip floor on remote devices).
@@ -71,10 +78,24 @@ def bench(
     ``block_until_ready``: on tunneled accelerator runtimes the latter
     acknowledges before the computation finishes (measured: a 137-GFLOP
     matmul "completed" in 0.05 ms), while a value fetch cannot lie.
+
+    Returns ``nan`` when the baseline-subtracted median is inside the
+    measurement noise (non-positive, or smaller than the interquartile
+    sample spread while a baseline is being subtracted): a kernel
+    indistinguishable from the round-trip floor has NO measurable time, and
+    the old behavior of clamping to 1e-9 s turned exactly those cases into
+    absurd throughputs.
+
+    ``sink[label]`` (when given) records the raw sample distribution as a
+    ``Stat`` — including ``valid`` — so profiles carry the spread instead of
+    discarding it; ``DPERF_DEBUG>=1`` prints it, like the reference's debug
+    output (/root/reference/src/distilp/profiler/profiler/device.py:188-197).
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    from .datatypes import Stat
 
     def run() -> None:
         out = fn()
@@ -94,7 +115,41 @@ def bench(
         t0 = time.perf_counter()
         run()
         times.append(time.perf_counter() - t0)
-    return max(stats.median(times) - baseline, 1e-9)
+
+    srt = sorted(times)
+    st = Stat(
+        samples=len(times),
+        min=srt[0],
+        p50=stats.median(times),
+        p95=_quantile(srt, 0.95),
+        p99=_quantile(srt, 0.99),
+        max=srt[-1],
+        mean=stats.fmean(times),
+        stddev=stats.pstdev(times) if len(times) > 1 else 0.0,
+        baseline=baseline,
+    )
+    net = st.p50 - baseline
+    # Robust jitter estimate: the interquartile spread. NOT p95-p50 — at the
+    # default iters=10 the p95 index IS the max sample, so one GC pause or
+    # network hiccup would invalidate an otherwise tightly-clustered
+    # measurement.
+    noise = _quantile(srt, 0.75) - _quantile(srt, 0.25)
+    if net <= 0 or (baseline > 0 and net < noise):
+        st.valid = False
+    if sink is not None and label:
+        sink[label] = st
+    if _env_int("DPERF_DEBUG", 0) >= 1:
+        import sys
+
+        flag = "" if st.valid else "  [SUB-NOISE: discarded]"
+        print(
+            f"[dperf] {label or 'bench'}: n={st.samples} "
+            f"min={st.min * 1e3:.3f}ms p50={st.p50 * 1e3:.3f}ms "
+            f"p95={st.p95 * 1e3:.3f}ms p99={st.p99 * 1e3:.3f}ms "
+            f"max={st.max * 1e3:.3f}ms baseline={baseline * 1e3:.3f}ms{flag}",
+            file=sys.stderr,
+        )
+    return net if st.valid else float("nan")
 
 
 def _fetch_baseline(backend: str) -> float:
@@ -106,7 +161,8 @@ def _fetch_baseline(backend: str) -> float:
         dev = jax.devices(backend)[0]
         x = jax.device_put(jnp.ones((8,), jnp.float32), dev)
         probe = jax.jit(lambda v: v * 1.0)
-        return bench(lambda: probe(x), warmup=3, iters=10)
+        v = bench(lambda: probe(x), warmup=3, iters=10)
+        return v if v == v else 0.0  # NaN-guard (baseline=0 never triggers)
     except Exception:
         return 0.0
 
@@ -118,20 +174,32 @@ def _chained_rate(
     warmup: int,
     iters: int,
     baseline: float,
+    label: str = "",
+    sink: Optional[Dict[str, Any]] = None,
 ) -> float:
     """Units/second of a chained kernel ``fn(chain_length)`` measured at two
     chain lengths; the slope cancels the dispatch round-trip and per-call
     overheads. Falls back to single-point (baseline-subtracted) timing when
-    jitter swamps the slope."""
+    jitter swamps the slope; returns 0.0 ("no table") when even that is
+    inside the round-trip noise — never an absurd clamped throughput."""
     import jax.numpy as jnp
 
     c_lo = max(1, chain // 4)
-    t_hi = bench(lambda: fn(jnp.asarray(chain, jnp.int32)), warmup, iters)
-    t_lo = bench(lambda: fn(jnp.asarray(c_lo, jnp.int32)), warmup, iters)
+    t_hi = bench(
+        lambda: fn(jnp.asarray(chain, jnp.int32)), warmup, iters,
+        label=f"{label}.hi" if label else "", sink=sink,
+    )
+    t_lo = bench(
+        lambda: fn(jnp.asarray(c_lo, jnp.int32)), warmup, iters,
+        label=f"{label}.lo" if label else "", sink=sink,
+    )
     dt = t_hi - t_lo
     if dt > 0:
         return units_per_iter * (chain - c_lo) / dt
-    return units_per_iter * chain / max(t_hi - baseline, 1e-9)
+    net = t_hi - baseline
+    if net > 0:
+        return units_per_iter * chain / net
+    return 0.0
 
 
 def _gemm_flops(
@@ -144,11 +212,14 @@ def _gemm_flops(
     warmup: int,
     iters: int,
     baseline: float = 0.0,
+    label: str = "",
+    sink: Optional[Dict[str, Any]] = None,
 ) -> float:
     """FLOPS of a jitted batched GEMM ``(B,M,K) @ (K,N)`` on ``backend``.
 
     Returns 0.0 on failure, like the reference (:134-137) — e.g. integer
-    matmul on accelerators that lack it.
+    matmul on accelerators that lack it — and 0.0 (the "no table" sentinel)
+    when the measurement is sub-noise (see ``bench``).
     """
     import jax
     import jax.numpy as jnp
@@ -175,8 +246,11 @@ def _gemm_flops(
             # Reduce via max|.| — a plain [0] slice lets XLA rewrite
             # slice-of-dot into a one-element dot.
             mm = jax.jit(lambda a, b: jnp.max(jnp.abs(jnp.matmul(a, b))))
-            median = bench(lambda: mm(a, b), warmup, iters, baseline=baseline)
-            result = flop / median
+            median = bench(
+                lambda: mm(a, b), warmup, iters, baseline=baseline,
+                label=label, sink=sink,
+            )
+            result = flop / median if median == median else 0.0
         else:
             # Chain matmuls inside ONE jitted call with FULL matrix feedback
             # (the output, normalized, is the next input). Anything weaker is
@@ -208,7 +282,8 @@ def _gemm_flops(
                 return jax.lax.fori_loop(0, c, body, x).ravel()[0]
 
             result = _chained_rate(
-                lambda c: chained(a, b, c), chain, flop, warmup, iters, baseline
+                lambda c: chained(a, b, c), chain, flop, warmup, iters,
+                baseline, label=label, sink=sink,
             )
         del a, b
         gc.collect()
@@ -229,7 +304,11 @@ def run_host_benchmarks(di: DeviceInfo, n_embd: int, max_batch_exp: int) -> None
             setattr(
                 table,
                 _BATCH_TAGS[exp],
-                _gemm_flops("cpu", 2**exp, size, size, size, dtype, warmup, iters, base),
+                _gemm_flops(
+                    "cpu", 2**exp, size, size, size, dtype, warmup, iters,
+                    base, label=f"gemm.cpu.{tag}.{_BATCH_TAGS[exp]}",
+                    sink=di.stats,
+                ),
             )
 
 
@@ -250,7 +329,11 @@ def run_accel_benchmarks(di: DeviceInfo, n_embd: int, max_batch_exp: int) -> Non
             setattr(
                 table,
                 _BATCH_TAGS[exp],
-                _gemm_flops(backend, 2**exp, size, size, size, dtype, warmup, iters, base),
+                _gemm_flops(
+                    backend, 2**exp, size, size, size, dtype, warmup, iters,
+                    base, label=f"gemm.{backend}.{tag}.{_BATCH_TAGS[exp]}",
+                    sink=di.stats,
+                ),
             )
 
 
@@ -278,21 +361,30 @@ def get_sysmem_info(di: DeviceInfo) -> None:
     nbytes = n * 4
 
     read = jax.jit(jnp.max)  # runs on the CPU: A is CPU-resident
-    di.memory.cpu_read_cold_bw = nbytes / bench(lambda: read(A), 0, 1)
+    di.memory.cpu_read_cold_bw = nbytes / bench(
+        lambda: read(A), 0, 1, label="mem.cpu_read_cold", sink=di.stats
+    )
     warm_read = jax.jit(jnp.sum)  # scalar output: bench() fetches it to sync
-    di.memory.cpu_read_warm_bw = nbytes / bench(lambda: warm_read(A), 5, 10)
+    di.memory.cpu_read_warm_bw = nbytes / bench(
+        lambda: warm_read(A), 5, 10, label="mem.cpu_read_warm", sink=di.stats
+    )
 
     # No input to anchor placement: pin the fill's output to the CPU device.
     fill = jax.jit(
         lambda: jnp.full((n,), 23.4, dtype=jnp.float32),
         out_shardings=jax.sharding.SingleDeviceSharding(cpu),
     )
-    di.memory.cpu_write_cold_bw = nbytes / bench(fill, 0, 1)
-    di.memory.cpu_write_warm_bw = nbytes / bench(fill, 5, 10)
+    di.memory.cpu_write_cold_bw = nbytes / bench(
+        fill, 0, 1, label="mem.cpu_write_cold", sink=di.stats
+    )
+    di.memory.cpu_write_warm_bw = nbytes / bench(
+        fill, 5, 10, label="mem.cpu_write_warm", sink=di.stats
+    )
 
     host_buf = np.random.randn(n // 8).astype(np.float32)
     di.memory.memcpy_delay = 1000 * bench(
-        lambda: jax.device_put(host_buf, cpu), 1, 5
+        lambda: jax.device_put(host_buf, cpu), 1, 5,
+        label="mem.memcpy", sink=di.stats,
     )
     del A, host_buf
     gc.collect()
@@ -349,12 +441,31 @@ def accel_get_memory_info(di: DeviceInfo) -> None:
         in_use = ms.get("bytes_in_use", 0)
     except Exception:
         total = in_use = 0
+    source = "memory_stats"
     if total <= 0:
         # Some runtimes (remote/tunneled TPUs) expose no memory_stats; fall
-        # back to the known per-chip HBM of the device kind. Overridable via
-        # DPERF_HBM_BYTES for unlisted parts.
-        total = _env_int("DPERF_HBM_BYTES", _hbm_by_kind(dev.device_kind))
+        # back to the DPERF_HBM_BYTES override, then the known per-chip HBM
+        # of the device kind. An unparsable override falls through to the
+        # table rather than silently zeroing the capacity.
         in_use = 0
+        if _env_int("DPERF_HBM_BYTES", 0) > 0:
+            total = _env_int("DPERF_HBM_BYTES", 0)
+            source = "env:DPERF_HBM_BYTES"
+        else:
+            total = _hbm_by_kind(dev.device_kind)
+            source = f"table:{dev.device_kind}" if total > 0 else "unknown"
+        if total <= 0:
+            import sys
+
+            source = "unknown"
+            print(
+                f"[dperf] WARNING: no memory_stats and unlisted device kind "
+                f"{dev.device_kind!r}: HBM capacity recorded as 0 "
+                f"(capacity_source='unknown'); set DPERF_HBM_BYTES to the "
+                f"per-chip HBM bytes.",
+                file=sys.stderr,
+            )
+    di.gpu.memory.capacity_source = source
     di.gpu.memory.total = float(total)
     di.gpu.memory.free = float(max(total - in_use, 0))
 
@@ -409,7 +520,8 @@ def accel_bench_mem_to_compute(di: DeviceInfo) -> None:
             return jax.lax.fori_loop(0, c, body, x)[0]
 
         di.gpu.memory.vram_to_compute = _chained_rate(
-            lambda c: rolled(A, c), chain, 2 * n * 4, 2, 6, _fetch_baseline(backend)
+            lambda c: rolled(A, c), chain, 2 * n * 4, 2, 6,
+            _fetch_baseline(backend), label="hbm.stream", sink=di.stats,
         )
         del A
         gc.collect()
@@ -433,11 +545,13 @@ def bench_host_accel_transfers(di: DeviceInfo, n_embd: int) -> None:
         host = np.ones((n,), dtype=np.float32)
         nbytes = n * 4
         di.gpu.memory.read_bw = nbytes / bench(
-            lambda: jax.device_put(host, dev), 1, 5
+            lambda: jax.device_put(host, dev), 1, 5,
+            label="xfer.host_to_accel", sink=di.stats,
         )  # host -> device
         resident = jax.device_put(host, dev)
         di.gpu.memory.write_bw = nbytes / bench(
-            lambda: np.asarray(resident), 1, 5
+            lambda: np.asarray(resident), 1, 5,
+            label="xfer.accel_to_host", sink=di.stats,
         )  # device -> host
         di.gpu.memory.read_write_bw = 2.0 / (
             1.0 / di.gpu.memory.read_bw + 1.0 / di.gpu.memory.write_bw
@@ -446,7 +560,6 @@ def bench_host_accel_transfers(di: DeviceInfo, n_embd: int) -> None:
         gc.collect()
     except Exception:
         pass
-
 
 # -- Disk benchmark (reference :264-420) -----------------------------------
 
@@ -540,7 +653,6 @@ def bench_disk_mainfs(di: DeviceInfo, config: Optional[Dict[str, Any]] = None) -
             path.unlink(missing_ok=True)
         except OSError:
             pass
-
 
 # -- Orchestration + DeviceProfile mapping (reference :551-744) -------------
 
@@ -670,14 +782,26 @@ def profile_device(
         if di.gpu.memory.write_bw > 0:
             ret.t_vram2ram = transfer / di.gpu.memory.write_bw
 
-    # Inter-device communication: measured ICI all-reduce latency when a
-    # multi-device mesh is visible; 0 on a single device like the reference
-    # (:719, where it is always 0 because nothing measures it).
-    ret.t_comm = (
-        di.interconnect.ici_allreduce_latency_s
-        if di.interconnect.num_devices > 1
-        else 0.0
-    )
+    # Inter-device communication: payload-aware latency + bytes/bandwidth
+    # from the timed collectives, with the payload sized to the activation
+    # handoff a pipeline round actually ships (one token's hidden state in
+    # bf16). 0 on a single device like the reference (:719, where it is
+    # always 0 because nothing measures it). The link terms ride along so
+    # the solver can price other payloads (MoE all-to-all) on the same link.
+    if di.interconnect.num_devices > 1:
+        from .topology import estimate_t_comm
+
+        act_payload = config.hidden_size() * 2  # bf16 activations
+        ret.t_comm = estimate_t_comm(act_payload, info=di.interconnect)
+        # Same link-selection rule as estimate_t_comm, so t_comm and the
+        # carried link terms always describe the SAME link.
+        ic = di.interconnect
+        if ic.num_slices > 1 and (ic.dcn_latency_s > 0 or ic.dcn_bandwidth > 0):
+            ret.comm_latency = ic.dcn_latency_s
+            ret.comm_bandwidth = ic.dcn_bandwidth
+        else:
+            ret.comm_latency = ic.ici_allreduce_latency_s
+            ret.comm_bandwidth = ic.ici_bandwidth
 
     ret.s_disk = di.disk.read
     ret.d_avail_ram = int(di.memory.available)

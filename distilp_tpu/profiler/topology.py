@@ -30,33 +30,73 @@ def _topology_string(devices) -> str:
     return "x".join(str(e) for e in extents)
 
 
+def _slice_of(dev) -> int:
+    """Slice id of a device: which ICI island it belongs to. Devices in
+    different slices only reach each other over DCN."""
+    return int(getattr(dev, "slice_index", 0) or 0)
+
+
 def measure_interconnect(
     latency_iters: int = 10,
     bandwidth_mb: int = 32,
     devices: Optional[List] = None,
+    slice_of=None,
 ) -> InterconnectInfo:
-    """Time collectives over all local devices (shard_map psum/all_gather)."""
+    """Time collectives over all local devices (shard_map psum/all_gather).
+
+    When the device set spans more than one slice (multi-slice TPU pods:
+    ICI inside a slice, DCN between slices), a second pair of collectives
+    over one-device-per-slice measures the DCN latency and bandwidth
+    separately — the cross-slice numbers the solver needs to price
+    pipeline hops that leave the slice. ``slice_of`` overrides the slice
+    keying (tests use it to split a virtual CPU mesh into fake slices).
+    """
     import jax
 
     devs = devices if devices is not None else jax.devices()
+    slice_of = slice_of if slice_of is not None else _slice_of
     info = InterconnectInfo(num_devices=len(devs))
     info.topology = _topology_string(devs)
     try:
-        info.num_slices = len({getattr(d, "slice_index", 0) for d in devs})
+        slices: dict = {}
+        for d in devs:
+            slices.setdefault(slice_of(d), []).append(d)
+        info.num_slices = len(slices)
     except Exception:
+        slices = {0: list(devs)}
         info.num_slices = 1
     if len(devs) < 2:
         return info
 
+    # ICI: collectives inside ONE slice (the largest with >=2 devices);
+    # with a single slice that is simply all devices.
+    ici_devs = max(slices.values(), key=len)
+    if len(ici_devs) >= 2:
+        lat, bw = _collective_times(ici_devs, latency_iters, bandwidth_mb)
+        info.ici_allreduce_latency_s = lat
+        info.ici_bandwidth = bw
+
+    # DCN: collectives across slices, one device per slice, so every hop
+    # of the measured ring leaves its ICI island.
+    if info.num_slices > 1:
+        dcn_devs = [group[0] for group in slices.values()]
+        lat, bw = _collective_times(dcn_devs, latency_iters, bandwidth_mb)
+        info.dcn_latency_s = lat
+        info.dcn_bandwidth = bw
+    return info
+
+
+def _collective_times(devs: List, latency_iters: int, bandwidth_mb: int):
+    """(small-psum latency s, large-all-gather bytes/s) over ``devs``."""
+    import jax
     import numpy as np
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     shard_map = jax.shard_map
-
     n = len(devs)
     mesh = Mesh(np.array(devs), ("d",))
-
+    latency = bandwidth = 0.0
     try:
         # Small-message all-reduce latency.
         tiny = jax.device_put(
@@ -82,7 +122,7 @@ def measure_interconnect(
             t0 = time.perf_counter()
             sync(f(tiny))
             times.append(time.perf_counter() - t0)
-        info.ici_allreduce_latency_s = sorted(times)[len(times) // 2]
+        latency = sorted(times)[len(times) // 2]
 
         # Large-message all-gather bandwidth.
         per_dev = (bandwidth_mb * 1024 * 1024) // 4
@@ -104,20 +144,32 @@ def measure_interconnect(
         sync(g(big))
         dt = time.perf_counter() - t0
         # Each device receives (n-1) remote shards of per_dev floats.
-        info.ici_bandwidth = (n - 1) * per_dev * 4 / dt if dt > 0 else 0.0
+        bandwidth = (n - 1) * per_dev * 4 / dt if dt > 0 else 0.0
     except Exception:
         pass
-    return info
+    return latency, bandwidth
 
 
-def estimate_t_comm(payload_bytes: int = 1024 * 1024) -> float:
+def estimate_t_comm(
+    payload_bytes: int = 1024 * 1024,
+    info: Optional[InterconnectInfo] = None,
+) -> float:
     """Per-round inter-device time for a payload: latency + payload/bandwidth.
 
     The TPU-native replacement for the reference's hand-measured ``t_comm``
-    fixture scalar (test/profiles/llama_3_70b/online/m1.json).
+    fixture scalar (test/profiles/llama_3_70b/online/m1.json, 0.06355 s for
+    a home-network fleet): the same latency + size/bandwidth shape, derived
+    from timed collectives instead of a hand edit. Uses the slowest link the
+    fleet spans — DCN when the mesh crosses slices, ICI otherwise — because
+    a pipeline round is paced by its slowest hop. Pass a pre-measured
+    ``info`` to avoid re-running the collectives.
     """
-    info = measure_interconnect()
+    if info is None:
+        info = measure_interconnect()
     if info.num_devices < 2:
         return 0.0
-    bw = info.ici_bandwidth or float("inf")
-    return info.ici_allreduce_latency_s + payload_bytes / bw
+    if info.num_slices > 1 and (info.dcn_latency_s > 0 or info.dcn_bandwidth > 0):
+        lat, bw = info.dcn_latency_s, info.dcn_bandwidth or float("inf")
+    else:
+        lat, bw = info.ici_allreduce_latency_s, info.ici_bandwidth or float("inf")
+    return lat + payload_bytes / bw

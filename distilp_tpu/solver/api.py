@@ -40,6 +40,7 @@ def halda_solve(
     beam: Optional[int] = None,
     ipm_iters: Optional[int] = None,
     node_cap: Optional[int] = None,
+    timings: Optional[dict] = None,
 ) -> HALDAResult:
     """Pick the best (k, w, n[, y]) placement over all candidate segment counts.
 
@@ -62,6 +63,10 @@ def halda_solve(
     - ``beam``: frontier rows that get an IPM solve per round.
     - ``ipm_iters``: interior-point iterations per LP relaxation.
     - ``node_cap``: frontier capacity (overflow floors the certificate).
+
+    ``timings``: pass a dict to receive the JAX backend's wall-clock
+    breakdown (pack/upload/solve+fetch milliseconds, see
+    ``solve_sweep_jax``).
 
     Returns the assignment minimizing the modeled per-round latency, with
     ``certified``/``gap`` reporting the optimality certificate; raises
@@ -109,7 +114,8 @@ def halda_solve(
         warm_ilp = None
         if warm is not None:
             warm_ilp = ILPResult(
-                k=warm.k, w=warm.w, n=warm.n, y=warm.y, obj_value=warm.obj_value
+                k=warm.k, w=warm.w, n=warm.n, y=warm.y,
+                obj_value=warm.obj_value, duals=warm.duals,
             )
         results, best = solve_sweep_jax(
             arrays,
@@ -122,6 +128,7 @@ def halda_solve(
             beam=beam,
             ipm_iters=ipm_iters,
             node_cap=node_cap,
+            timings=timings,
         )
         for k, res in zip(Ks, results):
             per_k_objs.append((k, res.obj_value if res is not None else None))
@@ -159,6 +166,7 @@ def halda_solve(
         y=list(best.y) if best.y is not None else None,
         certified=best.certified,
         gap=best.gap,
+        duals=best.duals,
     )
 
     if plot:

@@ -65,6 +65,18 @@ FRAC_TOL = 1e-4
 BEAM = 16
 # Greedy single-expert-move refinement steps on rounded MoE incumbents.
 MOE_LOCAL_MOVES = 8
+# Lagrangian root-ascent budgets: a cold MoE solve pays the full ascent; a
+# warm streaming tick re-EVALUATES the bound at the previous tick's best
+# multipliers with zero ascent steps — the bound is valid at ANY multiplier
+# vector, so staleness only costs tightness, never soundness. Measured on
+# the DeepSeek-V3 32-device flagship under ±5% t_comm drift: 0 steps still
+# certifies at gap ~1e-6 and the tick drops ~12x (each ascent step is a
+# softmax+argmin over the full (k,M,w,y) enumeration tensor, so steps
+# dominate the warm program). If drift ever grows the gap past mip_gap the
+# result comes back certified=False and StreamingReplanner re-solves cold,
+# refreshing the duals.
+DECOMP_STEPS_COLD = 300
+DECOMP_STEPS_WARM = 0
 
 
 def default_search_params(moe: bool, n_k: int) -> Tuple[int, int, int]:
@@ -1030,11 +1042,81 @@ def _bnb_round(
     )
 
 
+def _seed_root_bounds(
+    state: SearchState,
+    rd: RoundingData,
+    ks: jax.Array,
+    Ws: jax.Array,
+    obj_const,
+    nf: int,
+    M: int,
+    moe: bool,
+    w_max: int,
+    e_max: int,
+    decomp_steps: int,
+    init_duals: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+) -> Tuple[SearchState, Tuple[jax.Array, ...]]:
+    """Root Lagrangian decomposition bounds + primal incumbent seeding.
+
+    Per-device integrality the LP relaxation cannot express: children
+    inherit the bounds through the max(ipm, parent) in ``_bnb_round``, and
+    losing k's whose decomposition bound already exceeds the incumbent prune
+    without a single IPM solve. This is what closes wide-expert MoE root
+    gaps (see ``_decomp_bound_roots``). Shared by the packed single-dispatch
+    path (``_solve_packed``) and the mesh-sharded path
+    (``parallel.mesh.solve_sweep_sharded``), so certified MoE is not a
+    single-chip-only property.
+    """
+    n_k = ks.shape[0]
+    raw_bounds, w_star, n_star, y_star, duals = _decomp_bound_roots(
+        rd, ks, Ws, w_max, e_max, steps=decomp_steps, moe=moe,
+        init_params=init_duals,
+    )
+    root_bounds = raw_bounds + obj_const
+    state = state._replace(
+        node_bound=state.node_bound.at[:n_k].set(root_bounds)
+    )
+
+    # Seed the incumbent from the Lagrangian primal: repair each k's
+    # per-device argmin cells to a feasible placement (greedy exact-priced
+    # y repair, scan budget E) and keep the best. On wide-expert instances
+    # this lands within the certificate window on round 0 where LP-point
+    # rounding lands ~0.5% off.
+    def price_root(j):
+        v_hint = jnp.zeros(nf, BDTYPE)
+        v_hint = v_hint.at[:M].set(w_star[j])
+        v_hint = v_hint.at[M : 2 * M].set(n_star[j])
+        if moe:
+            v_hint = v_hint.at[2 * M : 3 * M].set(y_star[j])
+        return _round_to_incumbent(
+            v_hint, M, Ws[j], ks[j], rd, moe=moe, y_steps=e_max + 4
+        )
+
+    lag_obj, lag_w, lag_n, lag_y = jax.vmap(price_root)(jnp.arange(n_k))
+    lag_obj = lag_obj + obj_const
+    jbest = jnp.argmin(lag_obj)
+    lag_better = lag_obj[jbest] < state.incumbent
+    state = state._replace(
+        incumbent=jnp.where(lag_better, lag_obj[jbest], state.incumbent),
+        inc_w=jnp.where(lag_better, lag_w[jbest], state.inc_w),
+        inc_n=jnp.where(lag_better, lag_n[jbest], state.inc_n),
+        inc_y=jnp.where(lag_better, lag_y[jbest], state.inc_y),
+        inc_kidx=jnp.where(
+            lag_better, jbest.astype(jnp.int32), state.inc_kidx
+        ),
+        per_k_best=jnp.minimum(
+            state.per_k_best, jnp.where(jnp.isfinite(lag_obj), lag_obj, jnp.inf)
+        ),
+    )
+    return state, duals
+
+
 def _pack_blob(
     sf: StandardForm,
     rd: dict,
     mip_gap: float,
     warm: Optional[Tuple[int, Sequence[int], Sequence[int], Sequence[int]]] = None,
+    duals: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
 ) -> np.ndarray:
     """Flatten one sweep's entire input into a single float32 vector.
 
@@ -1061,6 +1143,10 @@ def _pack_blob(
     on-device (a stale objective would break the mip-gap certificate). The
     slot is packed only when present; ``has_warm`` is a static jit arg so
     each layout compiles once.
+
+    ``duals`` = (lam (n_k,), mu (n_k,), tau (n_k, M)) warm-starts the
+    Lagrangian root ascent from a previous tick's best multipliers (see
+    ``_decomp_bound_roots``); gated by the static ``has_duals``.
     """
     M = sf.M
     A_part = sf.A[:1] if not sf.moe else sf.A  # dense: one shared copy
@@ -1086,6 +1172,15 @@ def _pack_blob(
             np.concatenate(
                 [[float(kidx)], np.asarray(w, np.float64),
                  np.asarray(n, np.float64), np.asarray(y, np.float64)]
+            )
+        )
+    if duals is not None:
+        lam, mu, tau = duals
+        f64_parts.append(
+            np.concatenate(
+                [np.asarray(lam, np.float64).ravel(),
+                 np.asarray(mu, np.float64).ravel(),
+                 np.asarray(tau, np.float64).ravel()]
             )
         )
     f64_bits = np.ascontiguousarray(
@@ -1119,7 +1214,7 @@ _RD_VEC_FIELDS = (
     jax.jit,
     static_argnames=(
         "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
-        "has_warm", "w_max", "e_max", "decomp_steps",
+        "has_warm", "w_max", "e_max", "decomp_steps", "has_duals",
     ),
 )
 def _solve_packed(
@@ -1137,12 +1232,18 @@ def _solve_packed(
     w_max: int = 0,
     e_max: int = 0,
     decomp_steps: int = 0,
+    has_duals: bool = False,
 ) -> jax.Array:
     """One-dispatch sweep: unpack the blob, build the root state in-trace, run
     the fused B&B loop, and pack the answer into one float64 vector:
 
         [incumbent, best_bound, inc_kidx, dropped_bound,
          inc_w (M), inc_n (M), inc_y (M), per_k_best (n_k)]
+
+    When the root decomposition runs (``decomp_steps > 0 and w_max > 0``) the
+    chosen Lagrangian multipliers are appended as
+    ``[lam (n_k), mu (n_k), tau (n_k*M)]`` so the caller can persist them and
+    warm-start the next streaming tick's ascent (``has_duals``).
     """
     off = 0
 
@@ -1184,6 +1285,12 @@ def _solve_packed(
         warm_w = take(M)
         warm_n = take(M)
         warm_y = take(M)
+    init_duals = None
+    if has_duals:
+        d_lam = take(n_k)
+        d_mu = take(n_k)
+        d_tau = take(n_k * M).reshape(n_k, M)
+        init_duals = (d_lam, d_mu, d_tau)
     assert off64 == f64v.shape[0], (
         f"_pack_blob/_solve_packed layout drift: consumed {off64} of {f64v.shape[0]}"
     )
@@ -1202,50 +1309,11 @@ def _solve_packed(
 
     state = _root_state(lo_k, hi_k, M, cap)
 
-    if decomp_steps > 0 and w_max > 0:
-        # Root Lagrangian decomposition bounds: per-device integrality the LP
-        # relaxation cannot express. Children inherit them through the
-        # max(ipm, parent) in _bnb_round, and losing k's whose decomposition
-        # bound already exceeds the incumbent prune without a single IPM
-        # solve. This is what closes wide-expert MoE root gaps (see
-        # _decomp_bound_roots).
-        raw_bounds, w_star, n_star, y_star = _decomp_bound_roots(
-            rd, ks, Ws, w_max, e_max, steps=decomp_steps
-        )
-        root_bounds = raw_bounds + obj_const
-        state = state._replace(
-            node_bound=state.node_bound.at[:n_k].set(root_bounds)
-        )
-
-        # Seed the incumbent from the Lagrangian primal: repair each k's
-        # per-device argmin cells to a feasible placement (greedy exact-priced
-        # y repair, scan budget E) and keep the best. On wide-expert
-        # instances this lands within the certificate window on round 0
-        # where LP-point rounding lands ~0.5% off.
-        def price_root(j):
-            v_hint = jnp.zeros(nf, BDTYPE)
-            v_hint = v_hint.at[:M].set(w_star[j])
-            v_hint = v_hint.at[M : 2 * M].set(n_star[j])
-            if moe:
-                v_hint = v_hint.at[2 * M : 3 * M].set(y_star[j])
-            return _round_to_incumbent(
-                v_hint, M, Ws[j], ks[j], rd, moe=moe, y_steps=e_max + 4
-            )
-        lag_obj, lag_w, lag_n, lag_y = jax.vmap(price_root)(jnp.arange(n_k))
-        lag_obj = lag_obj + obj_const
-        jbest = jnp.argmin(lag_obj)
-        lag_better = lag_obj[jbest] < state.incumbent
-        state = state._replace(
-            incumbent=jnp.where(lag_better, lag_obj[jbest], state.incumbent),
-            inc_w=jnp.where(lag_better, lag_w[jbest], state.inc_w),
-            inc_n=jnp.where(lag_better, lag_n[jbest], state.inc_n),
-            inc_y=jnp.where(lag_better, lag_y[jbest], state.inc_y),
-            inc_kidx=jnp.where(
-                lag_better, jbest.astype(jnp.int32), state.inc_kidx
-            ),
-            per_k_best=jnp.minimum(
-                state.per_k_best, jnp.where(jnp.isfinite(lag_obj), lag_obj, jnp.inf)
-            ),
+    out_duals = None
+    if decomp_steps >= 0 and w_max > 0:
+        state, out_duals = _seed_root_bounds(
+            state, rd, ks, Ws, obj_const, nf, M, moe, w_max, e_max,
+            decomp_steps, init_duals=init_duals,
         )
 
     if has_warm:
@@ -1291,22 +1359,28 @@ def _solve_packed(
         moe=moe,
     )
 
-    return jnp.concatenate(
-        [
-            jnp.stack(
-                [
-                    state.incumbent,
-                    _best_bound(state),
-                    state.inc_kidx.astype(BDTYPE),
-                    state.dropped_bound,
-                ]
-            ),
-            state.inc_w,
-            state.inc_n,
-            state.inc_y,
-            state.per_k_best,
+    parts = [
+        jnp.stack(
+            [
+                state.incumbent,
+                _best_bound(state),
+                state.inc_kidx.astype(BDTYPE),
+                state.dropped_bound,
+            ]
+        ),
+        state.inc_w,
+        state.inc_n,
+        state.inc_y,
+        state.per_k_best,
+    ]
+    if out_duals is not None:
+        lam, mu, tau = out_duals
+        parts += [
+            lam.astype(BDTYPE).ravel(),
+            mu.astype(BDTYPE).ravel(),
+            tau.astype(BDTYPE).ravel(),
         ]
-    )
+    return jnp.concatenate(parts)
 
 
 def _best_bound(state: SearchState) -> jax.Array:
@@ -1387,8 +1461,17 @@ def solve_sweep_jax(
     node_cap: Optional[int] = None,
     debug: bool = False,
     warm: Optional[ILPResult] = None,
+    timings: Optional[dict] = None,
 ) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
     """Solve the whole k-sweep on the accelerator.
+
+    ``timings`` (when a dict is passed) receives the wall-clock breakdown of
+    the solve in milliseconds: ``pack_ms`` (host-side blob assembly),
+    ``upload_ms`` (host->device transfer of the packed blob), ``solve_ms``
+    (device program: dispatch + execution + result fetch, indivisible on an
+    async runtime — the fetch is what blocks). ``debug=True`` prints it.
+    This is what locates the latency floor: on a tunneled TPU the
+    upload+fetch round-trip is the irreducible part.
 
     ``warm`` seeds the search with a previous solve's integer assignment
     (re-priced exactly on-device under the current coefficients), so a
@@ -1421,17 +1504,6 @@ def solve_sweep_jax(
     beam = beam if beam is not None else d_beam
     ipm_iters = ipm_iters if ipm_iters is not None else d_iters
     max_rounds = max_rounds if max_rounds is not None else MAX_ROUNDS
-    # Root decomposition bounds are what certify wide-expert MoE instances
-    # (the LP root gap there is structural); dense sweeps certify from the
-    # IPM bounds alone, so they skip the extra program — with w_max/e_max
-    # zeroed so the unused statics don't key extra jit cache entries.
-    if sf.moe:
-        w_max = max(W for _, W in feasible)
-        e_max = int(arrays.moe.E)
-        decomp_steps = 300
-    else:
-        w_max = e_max = decomp_steps = 0
-
     warm_tuple = None
     if warm is not None and warm.w is not None and len(warm.w) == M:
         k_index = {k: j for j, (k, _) in enumerate(feasible)}
@@ -1449,11 +1521,59 @@ def solve_sweep_jax(
                 warm_y = [0] * M
             warm_tuple = (k_index[warm.k], warm.w, warm.n, warm_y)
 
+    # Stored root multipliers from the previous tick, when their shape still
+    # matches this sweep (same k grid, same fleet size).
+    duals_tuple = None
+    if warm is not None and warm.duals is not None and sf.moe:
+        try:
+            lam = np.asarray(warm.duals["lam"], np.float64)
+            mu = np.asarray(warm.duals["mu"], np.float64)
+            tau = np.asarray(warm.duals["tau"], np.float64)
+        except (KeyError, TypeError, ValueError):
+            lam = mu = tau = None
+        if (
+            lam is not None
+            and lam.shape == (n_k,)
+            and mu.shape == (n_k,)
+            and tau.shape == (n_k, M)
+            and np.all(np.isfinite(lam))
+            and np.all(np.isfinite(mu))
+            and np.all(np.isfinite(tau))
+        ):
+            duals_tuple = (lam, mu, tau)
+
+    # Root decomposition bounds are what certify wide-expert MoE instances
+    # (the LP root gap there is structural); dense sweeps certify from the
+    # IPM bounds alone, so they skip the extra program — with w_max/e_max
+    # zeroed so the unused statics don't key extra jit cache entries. A warm
+    # tick that carries the previous multipliers only needs a short polish
+    # ascent (the bound is valid at any multiplier vector), which is what
+    # makes streaming MoE re-placement real-time.
+    if sf.moe:
+        w_max = max(W for _, W in feasible)
+        e_max = int(arrays.moe.E)
+        decomp_steps = (
+            DECOMP_STEPS_WARM if duals_tuple is not None else DECOMP_STEPS_COLD
+        )
+    else:
+        w_max = e_max = decomp_steps = 0
+
     # One upload, one dispatch, one fetch — transfer count, not FLOPs, is
     # what a remote-tunnel TPU bills for (see _pack_blob).
-    blob = jnp.asarray(
-        _pack_blob(sf, _rounding_arrays_np(coeffs, arrays.moe), mip_gap, warm_tuple)
+    import time as _time
+
+    t0 = _time.perf_counter()
+    blob_np = _pack_blob(
+        sf, _rounding_arrays_np(coeffs, arrays.moe), mip_gap, warm_tuple,
+        duals=duals_tuple,
     )
+    t1 = _time.perf_counter()
+    blob = jnp.asarray(blob_np)
+    if timings is not None or debug:
+        # Splitting upload from solve+fetch needs a sync the async dispatch
+        # would otherwise overlap — only pay it when someone asked.
+        blob.block_until_ready()
+    t2 = _time.perf_counter()
     out = np.asarray(
         jax.device_get(
             _solve_packed(
@@ -1471,14 +1591,29 @@ def solve_sweep_jax(
                 w_max=w_max,
                 e_max=e_max,
                 decomp_steps=decomp_steps,
+                has_duals=duals_tuple is not None,
             )
         )
     )
+    t3 = _time.perf_counter()
 
     incumbent = float(out[0])
     best_bound = float(out[1])
     if debug:
         print(f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f}")
+    if timings is not None or debug:
+        tm = {
+            "pack_ms": (t1 - t0) * 1e3,
+            "upload_ms": (t2 - t1) * 1e3,
+            "solve_ms": (t3 - t2) * 1e3,
+        }
+        if timings is not None:
+            timings.update(tm)
+        if debug:
+            print(
+                f"    [jax] pack={tm['pack_ms']:.2f}ms "
+                f"upload={tm['upload_ms']:.2f}ms solve+fetch={tm['solve_ms']:.2f}ms"
+            )
     if not np.isfinite(incumbent):
         return results, None
     achieved_gap = (
@@ -1509,6 +1644,20 @@ def solve_sweep_jax(
     inc_y = [int(round(x)) for x in out[4 + 2 * M : 4 + 3 * M]]
     per_k_best = out[4 + 3 * M : 4 + 3 * M + n_k]
 
+    # Root multipliers chosen by this solve (MoE only): persist on the
+    # winning result so the next streaming tick warm-starts the ascent.
+    out_duals = None
+    if sf.moe and w_max > 0:
+        d0 = 4 + 3 * M + n_k
+        lam_out = out[d0 : d0 + n_k]
+        mu_out = out[d0 + n_k : d0 + 2 * n_k]
+        tau_out = out[d0 + 2 * n_k : d0 + 2 * n_k + n_k * M].reshape(n_k, M)
+        out_duals = {
+            "lam": lam_out.tolist(),
+            "mu": mu_out.tolist(),
+            "tau": tau_out.tolist(),
+        }
+
     best: Optional[ILPResult] = None
     pos_of = {kW: i for i, kW in enumerate(kWs)}
     for j, (k, W) in enumerate(feasible):
@@ -1519,7 +1668,7 @@ def solve_sweep_jax(
             y = inc_y if sf.moe else None
             best = ILPResult(
                 k=k, w=inc_w, n=inc_n, y=y, obj_value=obj_j,
-                certified=certified, gap=achieved_gap,
+                certified=certified, gap=achieved_gap, duals=out_duals,
             )
             results[pos_of[(k, W)]] = best
         else:

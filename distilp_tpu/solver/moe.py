@@ -72,9 +72,19 @@ layers can, so the memory rows admit no slack on the ``eb*y`` term — a
 fleet that cannot physically hold E experts is reported infeasible instead
 of "optimal at a disk penalty" (physically unrealizable).
 
-Deliberate v2 simplifications (documented, not hidden):
-- Dispatch cost reuses the measured per-device ``t_comm`` scalar as the
-  all-to-all hop cost (2x: dispatch + combine).
+Dispatch pricing (v3): when the device profile carries the measured link
+shape (``comm_latency``/``comm_bandwidth``, from the profiler's timed
+collectives), the all-to-all hop is priced as
+``2 x (latency + dispatched_bytes / bandwidth)`` — dispatch + combine,
+with ``dispatched_bytes = experts_per_token * e_embed * 2`` (each decoded
+token's bf16 hidden state shipped to its top-k experts). Profiles without
+link terms (hand-written fleets, reference fixtures) fall back to the v2
+``2 x t_comm`` scalar, so existing fixtures price identically.
+
+Deliberate simplifications (documented, not hidden):
+- The full a2a latency is charged per expert-unit share (inside the 1/E
+  factor) rather than once per layer — same structural approximation the
+  v2 scalar made; it keeps g linear in y.
 """
 
 from __future__ import annotations
@@ -230,7 +240,15 @@ def build_moe_arrays(
             eb_ram[i], eb_vram[i] = 0.0, bytes_per_y
         else:
             sec = sec_cpu
-        g_raw[i] = (n_moe / float(E)) * (sec + 2.0 * d.t_comm)
+        if d.comm_bandwidth > 0:
+            # Payload-aware all-to-all: dispatch + combine of one token's
+            # top-k expert traffic over the measured link (see module
+            # docstring, "Dispatch pricing (v3)").
+            a2a_bytes = model.experts_per_token * model.e_embed * 2.0
+            a2a = 2.0 * (d.comm_latency + a2a_bytes / d.comm_bandwidth)
+        else:
+            a2a = 2.0 * d.t_comm
+        g_raw[i] = (n_moe / float(E)) * (sec + a2a)
     return MoEArrays(
         E=E, n_moe=n_moe, g_raw=g_raw, eb_ram=eb_ram, eb_vram=eb_vram,
         eb_metal=eb_metal,

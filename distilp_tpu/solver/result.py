@@ -33,6 +33,12 @@ class ILPResult(BaseModel):
     # requested mip_gap. The CPU/HiGHS backend certifies by construction.
     certified: bool = True
     gap: Optional[float] = None
+    # Best Lagrangian root multipliers of the solve ({"lam": (n_k,), "mu":
+    # (n_k,), "tau": (n_k, M)} as nested lists; JAX MoE solves only). A
+    # streaming tick feeds them back as the ascent's starting point, so the
+    # warm re-certification needs a short polish instead of the full cold
+    # ascent — the bound is valid at ANY multiplier vector.
+    duals: Optional[Dict[str, List]] = None
 
 
 class HALDAResult(BaseModel):
@@ -48,6 +54,9 @@ class HALDAResult(BaseModel):
     # Optimality certificate of the winning solve (see ILPResult.certified).
     certified: bool = True
     gap: Optional[float] = None
+    # Lagrangian root multipliers for warm-starting the next streaming tick
+    # (see ILPResult.duals).
+    duals: Optional[Dict[str, List]] = None
 
     def solution_text(self, devices: Sequence[DeviceProfile]) -> str:
         lines = [

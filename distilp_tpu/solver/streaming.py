@@ -71,6 +71,23 @@ class StreamingReplanner:
             moe=self.moe,
             warm=warm,
         )
+        if warm is not None and warm.duals is not None and not result.certified:
+            # A warm MoE tick certifies against the bound EVALUATED at the
+            # previous tick's multipliers (zero ascent steps); when the fleet
+            # drifted far enough that those duals go stale, fall back to a
+            # cold solve — full ascent, fresh duals — instead of returning
+            # an uncertified placement. MoE-only (gated on stored duals): a
+            # dense solve that misses its certificate does so for search-
+            # budget reasons a cold re-solve would not fix.
+            result = halda_solve(
+                devs,
+                model,
+                k_candidates=k_candidates,
+                mip_gap=self.mip_gap,
+                kv_bits=self.kv_bits,
+                backend=self.backend,
+                moe=self.moe,
+            )
         self.last = result
         self._last_shape = shape
         return result

@@ -167,3 +167,52 @@ def test_solver_cli_moe_off(tmp_path):
     )
     assert rc == 0
     assert "y" not in json.loads(sol.read_text())
+
+
+def test_solver_cli_search_knobs_round_trip(tmp_path, capsys):
+    """The jax-backend search knobs must reach halda_solve from the shell
+    (the certificate warning tells users to raise them), and the solution
+    output must state the certificate."""
+    from unittest.mock import patch
+
+    from distilp_tpu.cli import solver_cli
+
+    sol = tmp_path / "solution.json"
+    seen = {}
+    real = solver_cli.main.__globals__  # noqa: F841 (documentation only)
+
+    import distilp_tpu.solver as solver_pkg
+
+    orig = solver_pkg.halda_solve
+
+    def spy(*args, **kwargs):
+        seen.update(
+            {k: kwargs.get(k) for k in ("max_rounds", "beam", "ipm_iters", "node_cap")}
+        )
+        return orig(*args, **kwargs)
+
+    with patch.object(solver_pkg, "halda_solve", side_effect=spy):
+        rc = solver_cli.main(
+            [
+                "--profile",
+                str(PROFILES / "hermes_70b"),
+                "--kv-bits",
+                "4bit",
+                "--max-rounds",
+                "12",
+                "--beam",
+                "6",
+                "--ipm-iters",
+                "18",
+                "--node-cap",
+                "128",
+                "--save-solution",
+                str(sol),
+            ]
+        )
+    assert rc == 0
+    assert seen == {"max_rounds": 12, "beam": 6, "ipm_iters": 18, "node_cap": 128}
+    payload = json.loads(sol.read_text())
+    assert "certified" in payload and "gap" in payload
+    out = capsys.readouterr().out
+    assert "Optimality:" in out

@@ -1,6 +1,5 @@
 """JAX backend parity: golden fixtures + synthetic fleets vs the CPU oracle."""
 
-import numpy as np
 import pytest
 
 pytest.importorskip("jax")
