@@ -129,15 +129,9 @@ def _moe_warm_tick(rng):
         sequence_length=128,
     )
     model = split.to_model_profile()
-    devs = make_synthetic_fleet(MOE_DEVICES, seed=11)
-    for d in devs:
-        # Expert residency is hard-capped: the fleet must physically hold
-        # the E=256 expert slices (~1.6 GB each), so give every pool 32 GB.
-        d.d_avail_ram = int(32e9)
-        if d.d_avail_metal is not None:
-            d.d_avail_metal = int(32e9)
-        if d.d_avail_cuda is not None:
-            d.d_avail_cuda = int(32e9)
+    # Expert residency is hard-capped: the fleet must physically hold the
+    # E=256 expert slices (~1.6 GB each), so give every pool 32 GB.
+    devs = make_synthetic_fleet(MOE_DEVICES, seed=11, pool_bytes=int(32e9))
     planner = StreamingReplanner(mip_gap=MIP_GAP, kv_bits="8bit", backend="jax")
     planner.step(devs, model)  # cold solve + compile
     planner.step(devs, model)  # compile the warm layout

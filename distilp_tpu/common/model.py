@@ -79,6 +79,14 @@ class ModelProfile(BaseModel):
     router_bytes: Optional[Dict[int, int]] = None
     flops_per_active_expert_per_token: Optional[Dict[int, float]] = None
 
+    # Measured expert popularity (extension; the natural carrier the
+    # reference's per-expert metric dicts suggest but never fill,
+    # /root/reference/src/distilp/common/model.py:79-85): entry e is the
+    # relative token load routed to expert e, mean-1 normalized by the
+    # solver. None = uniform routing. A streaming deployment refreshes this
+    # from router statistics and re-solves; see ``solver.routing``.
+    expert_loads: Optional[List[float]] = None
+
     def summary(self) -> str:
         mib = 1024.0**2
         lines = [

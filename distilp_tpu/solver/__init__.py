@@ -13,11 +13,23 @@ from .coeffs import (
 )
 from .moe import MoEArrays, adjust_model, build_moe_arrays, model_has_moe_components
 from .result import HALDAResult, ILPResult
+from .routing import (
+    ExpertMapping,
+    expert_makespan,
+    map_experts,
+    normalize_loads,
+    solve_load_aware,
+)
 from .streaming import StreamingReplanner
 
 __all__ = [
     "halda_solve",
     "StreamingReplanner",
+    "ExpertMapping",
+    "expert_makespan",
+    "map_experts",
+    "normalize_loads",
+    "solve_load_aware",
     "MoEArrays",
     "adjust_model",
     "build_moe_arrays",

@@ -41,6 +41,7 @@ def halda_solve(
     ipm_iters: Optional[int] = None,
     node_cap: Optional[int] = None,
     timings: Optional[dict] = None,
+    load_factors: Optional[Sequence[float]] = None,
 ) -> HALDAResult:
     """Pick the best (k, w, n[, y]) placement over all candidate segment counts.
 
@@ -93,8 +94,12 @@ def halda_solve(
     if use_moe:
         # Dense (w/n) costs come from the expert-free adjusted profile; the
         # expert block (y) carries the routed-expert bytes and compute.
+        # load_factors re-prices each device's y-units at the realized load
+        # of a concrete expert mapping (see solver.routing).
         coeffs = build_coeffs(devs, adjust_model(model), kv_factor, sets)
-        arrays = assemble(coeffs, moe=build_moe_arrays(devs, model))
+        arrays = assemble(
+            coeffs, moe=build_moe_arrays(devs, model, load_factors=load_factors)
+        )
     else:
         coeffs = build_coeffs(devs, model, kv_factor, sets)
         arrays = assemble(coeffs)

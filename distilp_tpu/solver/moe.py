@@ -198,10 +198,20 @@ def build_moe_arrays(
     model: ModelProfile,
     *,
     rho_w: float = RHO_W,
+    load_factors: Optional[Sequence[float]] = None,
 ) -> MoEArrays:
-    """Derive the per-device expert coefficients from an (unadjusted) profile."""
+    """Derive the per-device expert coefficients from an (unadjusted) profile.
+
+    ``load_factors`` (one multiplier per device, default all-1) scales each
+    device's busy coefficient ``g_i`` by the realized per-y-unit load of a
+    concrete expert->device mapping — the linearization handle of
+    load-weighted routing (``solver.routing``). Residency bytes are NOT
+    scaled: a hot expert occupies the same memory as a cold one.
+    """
     if not model_has_moe_components(model):
         raise ValueError("model profile lacks the MoE component metrics")
+    if load_factors is not None and len(load_factors) != len(devs):
+        raise ValueError("load_factors must have one entry per device")
 
     M = len(devs)
     E = model.n_routed_experts
@@ -248,7 +258,15 @@ def build_moe_arrays(
             a2a = 2.0 * (d.comm_latency + a2a_bytes / d.comm_bandwidth)
         else:
             a2a = 2.0 * d.t_comm
-        g_raw[i] = (n_moe / float(E)) * (sec + a2a)
+        # Floor the factor: a device whose mapped experts saw zero traffic
+        # must not become FREE to host experts (g=0 would let the next tick
+        # pile experts there up to memory and oscillate); 0.05 keeps a cold
+        # device cheap without making it a black hole.
+        lf = (
+            1.0 if load_factors is None
+            else max(0.05, float(load_factors[i]))
+        )
+        g_raw[i] = lf * (n_moe / float(E)) * (sec + a2a)
     return MoEArrays(
         E=E, n_moe=n_moe, g_raw=g_raw, eb_ram=eb_ram, eb_vram=eb_vram,
         eb_metal=eb_metal,

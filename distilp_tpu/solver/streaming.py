@@ -45,7 +45,9 @@ class StreamingReplanner:
         self.backend = backend
         self.moe = moe
         self.last: Optional[HALDAResult] = None
+        self.last_mapping = None  # ExpertMapping of the last load-aware tick
         self._last_shape: Optional[tuple] = None
+        self._load_factors = None  # realized per-device load multipliers
 
     def step(
         self,
@@ -53,7 +55,17 @@ class StreamingReplanner:
         model: ModelProfile,
         k_candidates: Optional[Sequence[int]] = None,
     ) -> HALDAResult:
-        """One tick: re-solve under the current profiles, warm when possible."""
+        """One tick: re-solve under the current profiles, warm when possible.
+
+        When the profile carries skewed ``expert_loads`` (refreshed per tick
+        from router statistics), the tick prices each device's y-units at
+        the PREVIOUS tick's realized load factors and maps concrete expert
+        ids afterwards (``solver.routing``) — the fixed-point iteration of
+        ``solve_load_aware`` unrolled across the stream, one mapping per
+        tick. The mapping lands on ``self.last_mapping``.
+        """
+        import numpy as np
+
         from .moe import model_has_moe_components
 
         use_moe = (
@@ -61,6 +73,18 @@ class StreamingReplanner:
         )
         shape = (len(devs), model.L, use_moe)
         warm = self.last if shape == self._last_shape else None
+
+        loads = None
+        if use_moe and model.expert_loads is not None:
+            from .routing import normalize_loads
+
+            loads = normalize_loads(model.expert_loads, model.n_routed_experts)
+            if np.allclose(loads, 1.0):
+                loads = None
+        factors = self._load_factors if loads is not None else None
+        if factors is not None and len(factors) != len(devs):
+            factors = None  # fleet changed shape; restart the fixed point
+
         result = halda_solve(
             devs,
             model,
@@ -70,6 +94,7 @@ class StreamingReplanner:
             backend=self.backend,
             moe=self.moe,
             warm=warm,
+            load_factors=factors,
         )
         if warm is not None and warm.duals is not None and not result.certified:
             # A warm MoE tick certifies against the bound EVALUATED at the
@@ -87,11 +112,27 @@ class StreamingReplanner:
                 kv_bits=self.kv_bits,
                 backend=self.backend,
                 moe=self.moe,
+                load_factors=factors,
             )
+
+        if loads is not None and result.y is not None:
+            from .moe import build_moe_arrays
+            from .routing import map_experts
+
+            g_base = build_moe_arrays(devs, model).g_raw
+            mapping = map_experts(result.y, g_base, loads)
+            self.last_mapping = mapping
+            self._load_factors = mapping.factors
+        else:
+            self.last_mapping = None
+            self._load_factors = None
+
         self.last = result
         self._last_shape = shape
         return result
 
     def reset(self) -> None:
         self.last = None
+        self.last_mapping = None
         self._last_shape = None
+        self._load_factors = None

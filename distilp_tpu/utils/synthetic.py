@@ -36,8 +36,16 @@ def _throughput_table(f32_flops: float, batches=(1, 2, 4)) -> dict:
     }
 
 
-def make_synthetic_fleet(M: int, seed: int = 0) -> List[DeviceProfile]:
-    """Deterministic heterogeneous fleet of M devices; device 0 is the head."""
+def make_synthetic_fleet(
+    M: int, seed: int = 0, pool_bytes: int = 0
+) -> List[DeviceProfile]:
+    """Deterministic heterogeneous fleet of M devices; device 0 is the head.
+
+    ``pool_bytes > 0`` raises every memory pool (RAM and, where present,
+    Metal/CUDA) to that capacity — MoE instances need fleets that can
+    physically hold the resident expert set (expert residency is
+    hard-capped; see ``solver.moe``).
+    """
     rng = np.random.default_rng(seed)
     devices: List[DeviceProfile] = []
     kinds = ["mac_metal", "linux_cuda", "linux_cpu", "android"]
@@ -94,4 +102,11 @@ def make_synthetic_fleet(M: int, seed: int = 0) -> List[DeviceProfile]:
         else:
             dev = DeviceProfile(os_type="linux", **common)
         devices.append(dev)
+    if pool_bytes > 0:
+        for d in devices:
+            d.d_avail_ram = int(pool_bytes)
+            if d.d_avail_metal is not None:
+                d.d_avail_metal = int(pool_bytes)
+            if d.d_avail_cuda is not None:
+                d.d_avail_cuda = int(pool_bytes)
     return devices

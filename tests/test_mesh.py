@@ -95,13 +95,7 @@ def test_sharded_moe_certifies(profiles_dir):
     model = profile_model(
         "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
     ).to_model_profile()
-    devs = make_synthetic_fleet(8, seed=7)
-    for d in devs:
-        d.d_avail_ram = int(64e9)
-        if d.d_avail_metal is not None:
-            d.d_avail_metal = int(64e9)
-        if d.d_avail_cuda is not None:
-            d.d_avail_cuda = int(64e9)
+    devs = make_synthetic_fleet(8, seed=7, pool_bytes=int(64e9))
     coeffs = build_coeffs(
         devs, adjust_model(model), kv_bits_to_factor("8bit"), assign_sets(devs)
     )

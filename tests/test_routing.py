@@ -1,0 +1,147 @@
+"""Load-weighted expert routing (solver.routing).
+
+Counts alone cannot see skewed expert popularity; these tests pin that the
+LPT mapper sends hot experts to fast devices, that the realized load
+factors re-price the MILP consistently on BOTH backends, and that the
+streaming loop carries the fixed point across ticks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distilp_tpu.profiler.api import profile_model
+from distilp_tpu.solver import halda_solve
+from distilp_tpu.solver.routing import (
+    expert_makespan,
+    map_experts,
+    normalize_loads,
+    solve_load_aware,
+)
+from distilp_tpu.utils import make_synthetic_fleet
+
+GAP = 1e-3
+
+
+@pytest.fixture(scope="module")
+def mixtral():
+    split = profile_model(
+        "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
+    )
+    return split.to_model_profile()
+
+
+def test_normalize_loads():
+    assert np.allclose(normalize_loads(None, 4), 1.0)
+    q = normalize_loads([4.0, 2.0, 1.0, 1.0], 4)
+    assert q.sum() == pytest.approx(4.0)
+    assert q[0] == pytest.approx(2.0)
+    with pytest.raises(ValueError):
+        normalize_loads([1.0, 2.0], 4)  # wrong length
+    with pytest.raises(ValueError):
+        normalize_loads([1.0, -1.0, 1.0, 1.0], 4)  # negative
+
+
+def test_map_experts_hot_to_fast():
+    # Device 0 is 3x faster per y-unit (smaller g). One very hot expert.
+    loads = normalize_loads([6.0, 1.0, 0.5, 0.5], 4)
+    m = map_experts([2, 2], [1.0, 3.0], loads)
+    # Every device got exactly its y_i experts.
+    assert sorted(len(ids) for ids in m.expert_of_device) == [2, 2]
+    # The hottest expert (id 0) is hosted by the fast device.
+    assert 0 in m.expert_of_device[0]
+    # The fast device serves more than its uniform share of the load.
+    assert m.load_share[0] > 0.5
+    assert m.factors[0] > 1.0 > m.factors[1]
+    assert np.isclose(m.load_share.sum(), 1.0)
+    # Makespan is priced at served load, not counts.
+    ms = expert_makespan([1.0, 3.0], m)
+    served = m.load_share * 4
+    assert ms == pytest.approx(max(1.0 * served[0], 3.0 * served[1]))
+
+
+def test_map_experts_rejects_count_mismatch():
+    with pytest.raises(ValueError):
+        map_experts([1, 1], [1.0, 1.0], normalize_loads(None, 4))
+
+
+def test_solve_load_aware_beats_contiguous_mapping(mixtral):
+    """Skewed popularity: the routed mapping's makespan must beat the naive
+    contiguous (id-order) assignment, and hot experts must land on the
+    accelerator devices."""
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    E = mixtral.n_routed_experts
+    # Two hot experts carry half the routed load.
+    raw = [4.0, 4.0] + [1.0] * (E - 2)
+    result, mapping, makespan = solve_load_aware(
+        devs, mixtral, expert_loads=raw, iters=2,
+        kv_bits="8bit", mip_gap=GAP, backend="jax",
+    )
+    assert result.certified
+    assert sum(result.y) == E
+    loads = normalize_loads(raw, E)
+
+    # Naive contiguous mapping of the same counts.
+    from distilp_tpu.solver.moe import build_moe_arrays
+
+    g = build_moe_arrays(devs, mixtral).g_raw
+    naive_share = np.zeros(len(devs))
+    e = 0
+    for i, yi in enumerate(result.y):
+        naive_share[i] = loads[e : e + yi].sum() / E
+        e += yi
+    naive_ms = float(np.max(g * naive_share * E))
+    assert makespan <= naive_ms + 1e-12
+
+    # The hot experts sit on devices whose per-unit busy is below average.
+    host_of = {}
+    for i, ids in enumerate(mapping.expert_of_device):
+        for eid in ids:
+            host_of[eid] = i
+    hot_hosts = {host_of[0], host_of[1]}
+    assert all(g[i] <= np.mean(g) for i in hot_hosts)
+
+
+def test_load_aware_backends_match(mixtral):
+    """Both backends must agree on the SAME load-factor-weighted instance."""
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    factors = [1.4, 0.8, 1.1, 0.7]
+    ref = halda_solve(
+        devs, mixtral, kv_bits="8bit", mip_gap=GAP, backend="cpu",
+        load_factors=factors,
+    )
+    got = halda_solve(
+        devs, mixtral, kv_bits="8bit", mip_gap=GAP, backend="jax",
+        load_factors=factors,
+    )
+    tol = 2 * GAP * abs(ref.obj_value) + 1e-9
+    assert abs(got.obj_value - ref.obj_value) <= tol
+
+
+def test_streaming_carries_load_fixed_point(mixtral):
+    """A streaming tick with expert_loads on the profile maps experts and
+    feeds the realized factors into the NEXT tick's pricing."""
+    from distilp_tpu.solver import StreamingReplanner
+
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    E = mixtral.n_routed_experts
+    model = mixtral.model_copy(
+        update={"expert_loads": [5.0, 3.0] + [1.0] * (E - 2)}
+    )
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
+
+    first = planner.step(devs, model)
+    assert first.certified
+    assert planner.last_mapping is not None
+    assert planner._load_factors is not None
+    assert not np.allclose(planner._load_factors, 1.0)
+
+    second = planner.step(devs, model)  # warm + factor-priced
+    assert second.certified
+    assert planner.last_mapping is not None
+    assert sum(len(ids) for ids in planner.last_mapping.expert_of_device) == E
+
+    # Dropping the loads reverts to the uniform path.
+    third = planner.step(devs, mixtral)
+    assert third.certified and planner.last_mapping is None

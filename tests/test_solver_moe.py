@@ -31,14 +31,7 @@ def moe_fleet(M: int, seed: int, ram: float = 64e9):
     Expert residency is hard-capped (experts are hit at every MoE layer and
     cannot disk-stream), so MoE instances need fleets whose pools can hold
     E expert slices — Mixtral 8x7B carries ~10 GB per expert slot."""
-    devs = make_synthetic_fleet(M, seed=seed)
-    for d in devs:
-        d.d_avail_ram = int(ram)
-        if d.d_avail_metal is not None:
-            d.d_avail_metal = int(ram)
-        if d.d_avail_cuda is not None:
-            d.d_avail_cuda = int(ram)
-    return devs
+    return make_synthetic_fleet(M, seed=seed, pool_bytes=int(ram))
 
 
 @pytest.fixture(scope="module")

@@ -87,25 +87,13 @@ def test_streaming_replanner_loop(fleet_and_model):
     assert len(third.w) == 4 and sum(third.w) * third.k == model.L
 
 
-def _moe_capable(devs, ram=64e9):
-    """Expert residency is hard-capped: give the fleet pools that can
-    actually hold the Mixtral expert set (~10 GB per expert slot)."""
-    for d in devs:
-        d.d_avail_ram = int(ram)
-        if d.d_avail_metal is not None:
-            d.d_avail_metal = int(ram)
-        if d.d_avail_cuda is not None:
-            d.d_avail_cuda = int(ram)
-    return devs
-
-
 def test_streaming_replanner_moe():
     from distilp_tpu.profiler.api import profile_model
 
     model = profile_model(
         "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
     ).to_model_profile()
-    devs = _moe_capable(make_synthetic_fleet(4, seed=7))
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
     planner = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
     first = planner.step(devs, model)
     assert first.y is not None and sum(first.y) == model.n_routed_experts
@@ -122,7 +110,7 @@ def test_warm_moe_from_dense_hint_repairs_y():
     model = profile_model(
         "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
     ).to_model_profile()
-    devs = _moe_capable(make_synthetic_fleet(4, seed=7))
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
     cold = halda_solve(devs, model, kv_bits="8bit", mip_gap=GAP, backend="jax")
     hint = cold.model_copy(update={"y": None})
     warm = halda_solve(
@@ -146,7 +134,7 @@ def test_moe_warm_tick_uses_stored_duals_and_certifies():
     model = profile_model(
         "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
     ).to_model_profile()
-    devs = _moe_capable(make_synthetic_fleet(4, seed=7))
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
     planner = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
 
     first = planner.step(devs, model)
@@ -188,7 +176,7 @@ def test_moe_warm_tick_falls_back_to_cold_when_uncertified(monkeypatch):
     model = profile_model(
         "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
     ).to_model_profile()
-    devs = _moe_capable(make_synthetic_fleet(4, seed=7))
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
     planner = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
     planner.step(devs, model)
 
