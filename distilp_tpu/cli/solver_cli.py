@@ -50,6 +50,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="expert+layer co-assignment: auto (when the profile has MoE "
         "component metrics), on (require them), off (dense formulation)",
     )
+    p.add_argument(
+        "--expert-loads",
+        default=None,
+        help="load-weighted expert routing: a JSON file with one relative "
+        "load per routed expert (or inline comma-separated values). Runs "
+        "the solve->map->re-price loop and prints the expert->device "
+        "mapping (MoE profiles only; see solver/routing.py)",
+    )
     # JAX-backend search knobs (None = problem-class defaults, see
     # backend_jax.default_search_params). The certificate warning names
     # these; they must be reachable from the shell, not only the API.
@@ -88,23 +96,70 @@ def main(argv=None) -> int:
     if args.k_candidates:
         k_candidates = [int(x) for x in args.k_candidates.split(",") if x.strip()]
 
+    expert_loads = None
+    if args.expert_loads:
+        if args.moe == "off":
+            print(
+                "error: --expert-loads needs the MoE formulation; it cannot "
+                "be combined with --moe off",
+                file=sys.stderr,
+            )
+            return 2
+        raw = args.expert_loads
+        try:
+            if Path(raw).is_file():
+                expert_loads = json.loads(Path(raw).read_text())
+            else:
+                expert_loads = [float(x) for x in raw.split(",") if x.strip()]
+            if not isinstance(expert_loads, list) or not all(
+                isinstance(x, (int, float)) for x in expert_loads
+            ):
+                raise ValueError(
+                    "expected a JSON array of numbers (one load per expert)"
+                )
+        except (OSError, TypeError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot parse --expert-loads: {e}", file=sys.stderr)
+            return 2
+
+    mapping = None
+    realized = None
     try:
-        result = halda_solve(
-            devices,
-            model,
-            k_candidates=k_candidates,
-            mip_gap=args.mip_gap,
-            plot=args.plot,
-            debug=args.debug,
-            kv_bits=args.kv_bits,
-            backend=args.backend,
-            time_limit=args.time_limit,
-            moe={"auto": None, "on": True, "off": False}[args.moe],
-            max_rounds=args.max_rounds,
-            beam=args.beam,
-            ipm_iters=args.ipm_iters,
-            node_cap=args.node_cap,
-        )
+        if expert_loads is not None:
+            from ..solver.routing import solve_load_aware
+
+            result, mapping, realized = solve_load_aware(
+                devices,
+                model,
+                expert_loads=expert_loads,
+                k_candidates=k_candidates,
+                mip_gap=args.mip_gap,
+                plot=args.plot,
+                debug=args.debug,
+                kv_bits=args.kv_bits,
+                backend=args.backend,
+                time_limit=args.time_limit,
+                max_rounds=args.max_rounds,
+                beam=args.beam,
+                ipm_iters=args.ipm_iters,
+                node_cap=args.node_cap,
+            )
+        else:
+            result = halda_solve(
+                devices,
+                model,
+                k_candidates=k_candidates,
+                mip_gap=args.mip_gap,
+                plot=args.plot,
+                debug=args.debug,
+                kv_bits=args.kv_bits,
+                backend=args.backend,
+                time_limit=args.time_limit,
+                moe={"auto": None, "on": True, "off": False}[args.moe],
+                max_rounds=args.max_rounds,
+                beam=args.beam,
+                ipm_iters=args.ipm_iters,
+                node_cap=args.node_cap,
+            )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -112,6 +167,22 @@ def main(argv=None) -> int:
     status = "certified" if result.certified else "NOT certified"
     gap_txt = f"{result.gap:.3g}" if result.gap is not None else "exact (HiGHS)"
     print(f"Optimality: {status} (achieved gap {gap_txt})")
+    if mapping is not None:
+        print("Expert routing (load-weighted):")
+        for dev, ids, share in zip(
+            devices, mapping.expert_of_device, mapping.load_share
+        ):
+            print(
+                f"  {dev.name:40s}: {len(ids):3d} experts, "
+                f"{share * 100:5.1f}% of routed load"
+            )
+        # The certificate above covers the linearized instance; this is the
+        # end-to-end objective at the mapping's realized loads. None on
+        # installs without the JAX backend (the exact pricer lives there).
+        if realized is not None:
+            print(
+                f"Realized objective (at mapped expert loads): {realized:.6f}"
+            )
 
     if args.save_solution:
         payload = {
@@ -126,6 +197,11 @@ def main(argv=None) -> int:
         }
         if result.y is not None:
             payload["y"] = result.y
+        if mapping is not None:
+            payload["expert_of_device"] = mapping.expert_of_device
+            payload["expert_load_share"] = [float(s) for s in mapping.load_share]
+            if realized is not None:
+                payload["realized_objective"] = realized
         Path(args.save_solution).write_text(json.dumps(payload, indent=2))
         print(f"Saved solution to {args.save_solution}")
     return 0

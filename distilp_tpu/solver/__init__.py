@@ -18,6 +18,7 @@ from .routing import (
     expert_makespan,
     map_experts,
     normalize_loads,
+    realized_objective,
     solve_load_aware,
 )
 from .streaming import StreamingReplanner
@@ -29,6 +30,7 @@ __all__ = [
     "expert_makespan",
     "map_experts",
     "normalize_loads",
+    "realized_objective",
     "solve_load_aware",
     "MoEArrays",
     "adjust_model",

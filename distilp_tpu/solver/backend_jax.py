@@ -462,6 +462,47 @@ def _round_to_incumbent(
     return obj, w, n, y
 
 
+def price_fixed_assignment(rd: RoundingData, k, W, w, n, y) -> jax.Array:
+    """Exact MILP objective (linear part, float64) of a FIXED integer MoE
+    assignment — no rounding, no repair, no local moves.
+
+    Same closed-form slack/continuous-block math as ``_round_to_incumbent``'s
+    inner pricer; +inf when the assignment is infeasible. Total objective =
+    returned value + ``MilpArrays.obj_const``. Host-callable (a few scalar
+    device ops); used by ``routing.solve_load_aware`` to compare iterates at
+    their REALIZED expert loads.
+    """
+    Wf = jnp.asarray(W, BDTYPE)
+    k_f = jnp.asarray(k, BDTYPE)
+    w = jnp.asarray(w, BDTYPE)
+    n = jnp.asarray(n, BDTYPE)
+    y = jnp.asarray(y, BDTYPE)
+    bp = rd.bprime
+    g_k = rd.g_raw / k_f
+    fetch = bp / rd.s_disk * w
+
+    valid = (w.sum() == Wf) & jnp.all(w >= 1.0) & (y.sum() == rd.E)
+    resident = bp * w - bp * n * rd.ram_minus_n + rd.eb_ram * y
+    viol_ram = jnp.maximum(resident - rd.ram_rhs, 0.0)
+    s_ram = jnp.ceil(viol_ram / bp - 1e-9)
+    valid &= jnp.all(s_ram <= jnp.minimum(w, Wf))
+    viol_vram = jnp.maximum(
+        jnp.maximum(
+            bp * n + rd.eb_vram * y - rd.cuda_rhs,
+            bp * n + rd.eb_metal * y - rd.metal_rhs,
+        ),
+        0.0,
+    )
+    viol_vram = jnp.where(jnp.isfinite(viol_vram), viol_vram, 0.0)
+    t = jnp.ceil(viol_vram / bp - 1e-9)
+    valid &= jnp.all(t <= n + 1e-9)
+    pen_cost = rd.pen_set * s_ram + rd.pen_vram * t
+    lin = rd.a * w + rd.b_gpu * n + pen_cost + g_k * y
+    busy = lin + rd.busy_const
+    C = jnp.max(busy + 0.5 * fetch)
+    return jnp.where(valid, (k_f - 1.0) * C + jnp.sum(lin), jnp.inf)
+
+
 def _decomp_terms(
     rd: RoundingData, ks, Ws, w_max: int, e_max: int, dtype, moe: bool = True
 ):
@@ -611,28 +652,6 @@ def _decomp_bound_roots(
     """
     n_k = ks.shape[0]
     M = rd.a.shape[0]
-    lin32, cyc32, ok, w_vals, y_vals = _decomp_terms(
-        rd, ks, Ws, w_max, e_max, DTYPE, moe=moe
-    )
-    big = jnp.asarray(3.4e37, DTYPE)
-    wv = w_vals[None, None, :, None]
-    yv = y_vals[None, None, None, :]
-
-    def neg_bound32(params):
-        lam, mu, tau = params  # (n_k,), (n_k,), (n_k, M)
-        theta = (ks.astype(DTYPE) - 1.0)[:, None] * jax.nn.softmax(tau, axis=1)
-        term = (
-            lin32
-            + theta[None, :, :, None, None] * cyc32
-            - lam[None, :, None, None, None] * wv[None]
-            - mu[None, :, None, None, None] * yv[None]
-        )
-        term = jnp.where(ok, term, big)
-        per_dev = jnp.min(term, axis=(0, 3, 4))  # (n_k, M)
-        b = per_dev.sum(axis=1) + lam * Ws.astype(DTYPE) + mu * rd.E.astype(DTYPE)
-        return -jnp.sum(b), b
-
-    grad_fn = jax.grad(lambda p: neg_bound32(p)[0])
     if init_params is not None:
         params0 = tuple(p.astype(DTYPE) for p in init_params)
     else:
@@ -642,50 +661,87 @@ def _decomp_bound_roots(
             jnp.zeros((n_k, M), DTYPE),
         )
 
-    # Adam ascent on the bounds. The dual function is piecewise linear and
-    # badly scaled across instances (dual-optimal multipliers range from
-    # ~0.03 on the DeepSeek fleet to ~3 on Mixtral), so the step size sweeps
-    # three decades in phases; any visited multiplier yields a valid bound
-    # and ``best_b``/``best_params`` keep the tightest one, so an overshooting
-    # phase can only waste steps, never weaken the result.
-    b1, b2, eps = 0.9, 0.999, 1e-12
-    phase_len = max(1, steps // 3)
-
-    def step(carry, i):
-        params, m_st, v_st, best_b, best_params = carry
-        g = grad_fn(params)
-        t = i.astype(DTYPE) + 1.0
-        lr = 0.01 * 10.0 ** jnp.minimum(i // phase_len, 2).astype(DTYPE)
-        m_st = jax.tree.map(lambda m, gg: b1 * m + (1 - b1) * gg, m_st, g)
-        v_st = jax.tree.map(lambda v, gg: b2 * v + (1 - b2) * gg * gg, v_st, g)
-        params = jax.tree.map(
-            lambda p, m, v: p
-            - lr * (m / (1 - b1**t)) / (jnp.sqrt(v / (1 - b2**t)) + eps),
-            params,
-            m_st,
-            v_st,
-        )
-        b = neg_bound32(params)[1]  # (n_k,)
-        better = b > best_b
-        best_params = jax.tree.map(
-            lambda bp_, p: jnp.where(
-                better.reshape((n_k,) + (1,) * (p.ndim - 1)), p, bp_
-            ),
-            best_params,
-            params,
-        )
-        best_b = jnp.maximum(best_b, b)
-        return (params, m_st, v_st, best_b, best_params), None
-
-    zeros = jax.tree.map(jnp.zeros_like, params0)
-    # The initial point (stored duals on a warm tick, zeros cold) is a valid
-    # multiplier vector: evaluate it and let the ascent only improve on it.
-    init = (params0, zeros, zeros, neg_bound32(params0)[1], params0)
     if steps > 0:
+        lin32, cyc32, ok, w_vals, y_vals = _decomp_terms(
+            rd, ks, Ws, w_max, e_max, DTYPE, moe=moe
+        )
+        big = jnp.asarray(3.4e37, DTYPE)
+        wv = w_vals[None, None, :, None]
+        yv = y_vals[None, None, None, :]
+
+        def neg_bound32(params):
+            lam, mu, tau = params  # (n_k,), (n_k,), (n_k, M)
+            theta = (ks.astype(DTYPE) - 1.0)[:, None] * jax.nn.softmax(
+                tau, axis=1
+            )
+            term = (
+                lin32
+                + theta[None, :, :, None, None] * cyc32
+                - lam[None, :, None, None, None] * wv[None]
+                - mu[None, :, None, None, None] * yv[None]
+            )
+            term = jnp.where(ok, term, big)
+            per_dev = jnp.min(term, axis=(0, 3, 4))  # (n_k, M)
+            b = (
+                per_dev.sum(axis=1)
+                + lam * Ws.astype(DTYPE)
+                + mu * rd.E.astype(DTYPE)
+            )
+            return -jnp.sum(b), b
+
+        grad_fn = jax.grad(lambda p: neg_bound32(p)[0])
+
+        # Adam ascent on the bounds. The dual function is piecewise linear
+        # and badly scaled across instances (dual-optimal multipliers range
+        # from ~0.03 on the DeepSeek fleet to ~3 on Mixtral), so the step
+        # size sweeps three decades in phases; any visited multiplier yields
+        # a valid bound and ``best_b``/``best_params`` keep the tightest
+        # one, so an overshooting phase can only waste steps, never weaken
+        # the result.
+        b1, b2, eps = 0.9, 0.999, 1e-12
+        phase_len = max(1, steps // 3)
+
+        def step(carry, i):
+            params, m_st, v_st, best_b, best_params = carry
+            g = grad_fn(params)
+            t = i.astype(DTYPE) + 1.0
+            lr = 0.01 * 10.0 ** jnp.minimum(i // phase_len, 2).astype(DTYPE)
+            m_st = jax.tree.map(lambda m, gg: b1 * m + (1 - b1) * gg, m_st, g)
+            v_st = jax.tree.map(
+                lambda v, gg: b2 * v + (1 - b2) * gg * gg, v_st, g
+            )
+            params = jax.tree.map(
+                lambda p, m, v: p
+                - lr * (m / (1 - b1**t)) / (jnp.sqrt(v / (1 - b2**t)) + eps),
+                params,
+                m_st,
+                v_st,
+            )
+            b = neg_bound32(params)[1]  # (n_k,)
+            better = b > best_b
+            best_params = jax.tree.map(
+                lambda bp_, p: jnp.where(
+                    better.reshape((n_k,) + (1,) * (p.ndim - 1)), p, bp_
+                ),
+                best_params,
+                params,
+            )
+            best_b = jnp.maximum(best_b, b)
+            return (params, m_st, v_st, best_b, best_params), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params0)
+        # The initial point (stored duals on a warm tick, zeros cold) is a
+        # valid multiplier vector: evaluate it and let the ascent only
+        # improve on it.
+        init = (params0, zeros, zeros, neg_bound32(params0)[1], params0)
         (_, _, _, _, best_params), _ = jax.lax.scan(
             step, init, jnp.arange(steps), length=steps
         )
     else:
+        # Zero-step (warm tick) path: the stored duals ARE the chosen
+        # multipliers, so skip the whole f32 enumeration tensor and ascent
+        # machinery — only the rigorous f64 evaluation below runs, roughly
+        # halving the warm MoE device program.
         best_params = params0
 
     # Rigorous final evaluation: f64 pricing at the chosen multipliers.

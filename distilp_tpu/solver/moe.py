@@ -199,6 +199,7 @@ def build_moe_arrays(
     *,
     rho_w: float = RHO_W,
     load_factors: Optional[Sequence[float]] = None,
+    factor_floor: float = 0.05,
 ) -> MoEArrays:
     """Derive the per-device expert coefficients from an (unadjusted) profile.
 
@@ -207,6 +208,10 @@ def build_moe_arrays(
     concrete expert->device mapping — the linearization handle of
     load-weighted routing (``solver.routing``). Residency bytes are NOT
     scaled: a hot expert occupies the same memory as a cold one.
+
+    ``factor_floor`` guards the SOLVE pricing against oscillation (see the
+    inline comment); evaluation callers that need the un-floored cost of a
+    fixed placement (``routing.realized_objective``) pass 0.0.
     """
     if not model_has_moe_components(model):
         raise ValueError("model profile lacks the MoE component metrics")
@@ -260,11 +265,11 @@ def build_moe_arrays(
             a2a = 2.0 * d.t_comm
         # Floor the factor: a device whose mapped experts saw zero traffic
         # must not become FREE to host experts (g=0 would let the next tick
-        # pile experts there up to memory and oscillate); 0.05 keeps a cold
-        # device cheap without making it a black hole.
+        # pile experts there up to memory and oscillate); the default 0.05
+        # keeps a cold device cheap without making it a black hole.
         lf = (
             1.0 if load_factors is None
-            else max(0.05, float(load_factors[i]))
+            else max(factor_floor, float(load_factors[i]))
         )
         g_raw[i] = lf * (n_moe / float(E)) * (sec + a2a)
     return MoEArrays(

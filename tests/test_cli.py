@@ -216,3 +216,59 @@ def test_solver_cli_search_knobs_round_trip(tmp_path, capsys):
     assert "certified" in payload and "gap" in payload
     out = capsys.readouterr().out
     assert "Optimality:" in out
+
+
+def test_solver_cli_expert_loads(tmp_path, capsys):
+    """--expert-loads drives the load-weighted routing loop from the shell:
+    the output prints an expert->device mapping and the solution JSON
+    carries the concrete expert ids and load shares."""
+    from distilp_tpu.cli.solver_cli import main
+
+    loads = tmp_path / "loads.json"
+    loads.write_text(json.dumps([5.0, 3.0] + [1.0] * 6))
+    sol = tmp_path / "solution.json"
+    rc = main(
+        [
+            "--profile",
+            str(PROFILES / "mixtral_8x7b"),
+            "--kv-bits",
+            "8bit",
+            "--mip-gap",
+            "1e-3",
+            "--expert-loads",
+            str(loads),
+            "--save-solution",
+            str(sol),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Expert routing (load-weighted):" in out
+    payload = json.loads(sol.read_text())
+    assert sum(payload["y"]) == 8
+    hosted = sorted(e for ids in payload["expert_of_device"] for e in ids)
+    assert hosted == list(range(8))
+    assert sum(payload["expert_load_share"]) == pytest.approx(1.0)
+
+    # Inline comma-separated values work too, and a bad value errors cleanly.
+    rc2 = main(
+        [
+            "--profile",
+            str(PROFILES / "mixtral_8x7b"),
+            "--kv-bits",
+            "8bit",
+            "--mip-gap",
+            "1e-3",
+            "--expert-loads",
+            "5,3,1,1,1,1,1,1",
+        ]
+    )
+    assert rc2 == 0
+    assert main(
+        [
+            "--profile",
+            str(PROFILES / "mixtral_8x7b"),
+            "--expert-loads",
+            "not,numbers",
+        ]
+    ) == 2

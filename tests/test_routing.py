@@ -74,12 +74,13 @@ def test_solve_load_aware_beats_contiguous_mapping(mixtral):
     E = mixtral.n_routed_experts
     # Two hot experts carry half the routed load.
     raw = [4.0, 4.0] + [1.0] * (E - 2)
-    result, mapping, makespan = solve_load_aware(
+    result, mapping, realized = solve_load_aware(
         devs, mixtral, expert_loads=raw, iters=2,
         kv_bits="8bit", mip_gap=GAP, backend="jax",
     )
     assert result.certified
     assert sum(result.y) == E
+    assert np.isfinite(realized)  # realized end-to-end objective is real
     loads = normalize_loads(raw, E)
 
     # Naive contiguous mapping of the same counts.
@@ -92,7 +93,7 @@ def test_solve_load_aware_beats_contiguous_mapping(mixtral):
         naive_share[i] = loads[e : e + yi].sum() / E
         e += yi
     naive_ms = float(np.max(g * naive_share * E))
-    assert makespan <= naive_ms + 1e-12
+    assert expert_makespan(g, mapping) <= naive_ms + 1e-12
 
     # The hot experts sit on devices whose per-unit busy is below average.
     host_of = {}
@@ -145,3 +146,31 @@ def test_streaming_carries_load_fixed_point(mixtral):
     # Dropping the loads reverts to the uniform path.
     third = planner.step(devs, mixtral)
     assert third.certified and planner.last_mapping is None
+
+
+def test_realized_objective_prices_fixed_assignment(mixtral):
+    """realized_objective must price the iterate's OWN (k,w,n,y) at the
+    mapping's factors — matching the solver's objective when the factors
+    are the ones the instance was solved with."""
+    from distilp_tpu.solver.routing import realized_objective
+
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    E = mixtral.n_routed_experts
+    loads = normalize_loads([4.0, 4.0] + [1.0] * (E - 2), E)
+    from distilp_tpu.solver.moe import build_moe_arrays
+
+    g = build_moe_arrays(devs, mixtral).g_raw
+
+    # Solve an instance at specific factors, map, and re-price.
+    result = halda_solve(
+        devs, mixtral, kv_bits="8bit", mip_gap=GAP, backend="jax", moe=True
+    )
+    mapping = map_experts(result.y, g, loads)
+    val = realized_objective(devs, mixtral, result, mapping, kv_bits="8bit")
+    assert np.isfinite(val)
+    # With uniform factors (all-1 mapping of uniform loads), the realized
+    # objective equals the solver's own certified objective.
+    uni = map_experts(result.y, g, normalize_loads(None, E))
+    assert np.allclose(uni.factors, 1.0)
+    val_uni = realized_objective(devs, mixtral, result, uni, kv_bits="8bit")
+    assert val_uni == pytest.approx(result.obj_value, rel=1e-6)
