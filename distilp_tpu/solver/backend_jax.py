@@ -170,12 +170,12 @@ class StandardForm:
 
     Variables: [x_struct (N) | row slacks (6M)]; rows: 6M scaled inequality
     rows turned equalities + the sum(w)=W (and, MoE mode, sum(y)=E)
-    equalities. A is per-k because the MoE expert busy coefficients scale
-    with 1/k (a pure copy per k in dense mode — the memory is trivial and
-    the uniform shape keeps one code path).
+    equalities. A is per-k in MoE mode because the expert busy coefficients
+    scale with 1/k; in dense mode A (and its row scaling) is k-independent,
+    so exactly ONE copy is built and shipped (leading axis length 1).
     """
 
-    A: np.ndarray  # (n_k, m, nf) row-scaled
+    A: np.ndarray  # (n_k, m, nf) row-scaled; (1, m, nf) in dense mode
     b_k: np.ndarray  # (n_k, m)
     c_k: np.ndarray  # (n_k, nf)
     lo_k: np.ndarray  # (n_k, nf) root boxes
@@ -237,24 +237,30 @@ def build_standard_form(
     rd = _rounding_arrays_np(coeffs, arrays.moe)
 
     n_k = len(kWs)
-    A = np.zeros((n_k, m, nf))
+    A = np.zeros((n_k if lay.moe else 1, m, nf))
     b_k = np.zeros((n_k, m))
     c_k = np.zeros((n_k, nf))
     lo_k = np.zeros((n_k, nf))
     hi_k = np.zeros((n_k, nf))
 
     for j, (k, W) in enumerate(kWs):
-        A_ub = arrays.A_ub_for_k(k)
-        # Row scaling: each inequality row (incl. its huge inactive RHS) is
-        # normalized by its own magnitude; the slack column keeps coefficient
-        # 1 (slacks live in scaled units, boxed below).
-        row_mag = np.maximum(np.abs(A_ub).max(axis=1), np.abs(arrays.b_ub))
-        row_scale = 1.0 / np.maximum(row_mag, 1.0)
+        ja = j if lay.moe else 0
+        if lay.moe or j == 0:
+            # Dense mode builds this once: A_ub and the row scaling are
+            # k-independent (``MilpArrays.A_ub_for_k`` returns the same
+            # matrix), and every consumer (``_pack_blob``, ``_sweep_data``)
+            # reads only A[0] then.
+            A_ub = arrays.A_ub_for_k(k)
+            # Row scaling: each inequality row (incl. its huge inactive RHS)
+            # is normalized by its own magnitude; the slack column keeps
+            # coefficient 1 (slacks live in scaled units, boxed below).
+            row_mag = np.maximum(np.abs(A_ub).max(axis=1), np.abs(arrays.b_ub))
+            row_scale = 1.0 / np.maximum(row_mag, 1.0)
 
-        A[j, :m_ub, :N] = A_ub * row_scale[:, None]
-        A[j, :m_ub, N:] = np.eye(m_ub)
-        A[j, m_ub:, :N] = arrays.A_eq
-        b_ub_scaled = arrays.b_ub * row_scale
+            A[ja, :m_ub, :N] = A_ub * row_scale[:, None]
+            A[ja, :m_ub, N:] = np.eye(m_ub)
+            A[ja, m_ub:, :N] = arrays.A_eq
+            b_ub_scaled = arrays.b_ub * row_scale
 
         b_k[j, :m_ub] = b_ub_scaled
         b_k[j, m_ub:] = arrays.b_eq_for_k(W)
@@ -264,7 +270,7 @@ def build_standard_form(
         lo_k[j, :N] = lo_s
         hi_k[j, :N] = hi_s
         # Slack boxes: s_row = b_row - min_v(A_row v) over the structural box.
-        Arow = A[j, :m_ub, :N]
+        Arow = A[ja, :m_ub, :N]
         smin = np.minimum(Arow * lo_s[None, :], Arow * hi_s[None, :]).sum(axis=1)
         hi_k[j, N:] = np.maximum(b_ub_scaled - smin, 0.0)
 

@@ -21,14 +21,15 @@ this module adds the missing pieces, keeping the MILP linear:
    carries the same load with fewer slots; a slow device is priced for
    the cold tail it actually serves).
 3. ``solve_load_aware``: the fixed-point loop — solve (uniform), map,
-   re-price, re-solve — keeping the iterate whose realized EXPERT-BUSY
-   MAKESPAN (``max_i g_i * load served by i``, priced under the concrete
-   mapping rather than the uniform model) is best. That makespan is the
-   quantity routing actually moves; the dense w/n placement is re-certified
-   by each inner solve. Each inner solve carries the normal mip-gap
-   certificate for its own linearized instance — the loop's selection
-   metric is reported alongside so the linearization is never mistaken for
-   an end-to-end optimality claim.
+   re-price, re-solve — keeping the iterate whose REALIZED end-to-end
+   objective (``realized_objective``: the full model objective with expert
+   busy priced at the loads the mapped experts actually carry, dense costs
+   and cycle term included) is best. Each inner solve carries the normal
+   mip-gap certificate for its own linearized instance — the realized
+   objective is reported alongside so the linearization is never mistaken
+   for an end-to-end optimality claim. (On installs without the JAX
+   backend the loop falls back to comparing the expert-busy makespan and
+   reports no realized number.)
 
 Both backends consume the same reweighted ``g`` coefficients (built once in
 ``build_moe_arrays``), so CPU/HiGHS and JAX agree on every linearized
@@ -130,8 +131,9 @@ def expert_makespan(
     concrete expert assignment (``E * load_share_i``); with uniform routing
     it equals ``y_i``, recovering the model's ``max g_i y_i`` term. This is
     the routing-sensitive slice of the objective — the dense (w, n) costs
-    do not depend on which expert ids a device hosts — and the fixed-point
-    loop selects its iterate by it.
+    do not depend on which expert ids a device hosts. ``solve_load_aware``
+    selects its iterate by the full ``realized_objective`` and uses this
+    slice only as the no-JAX fallback comparator.
     """
     g = np.asarray(list(g_per_unit), dtype=np.float64)
     E = float(sum(len(ids) for ids in mapping.expert_of_device))
@@ -247,6 +249,14 @@ def solve_load_aware(
             devs, model, moe=True, load_factors=factors, warm=prev,
             **solve_kwargs,
         )
+        if prev is not None and not result.certified:
+            # The warm tick certifies against the bound at the PREVIOUS
+            # iterate's duals — priced under different factors. A large
+            # factor swing can leave that bound too loose; re-solve cold
+            # (full ascent) instead of carrying an uncertified iterate.
+            result = halda_solve(
+                devs, model, moe=True, load_factors=factors, **solve_kwargs
+            )
         mapping = map_experts(result.y, g_base, loads)
         try:
             realized = realized_objective(

@@ -174,3 +174,46 @@ def test_realized_objective_prices_fixed_assignment(mixtral):
     assert np.allclose(uni.factors, 1.0)
     val_uni = realized_objective(devs, mixtral, result, uni, kv_bits="8bit")
     assert val_uni == pytest.approx(result.obj_value, rel=1e-6)
+
+
+def test_solve_load_aware_falls_back_cold_when_warm_uncertified(monkeypatch, mixtral):
+    """A warm iterate whose stale-dual bound misses the certificate must be
+    replaced by a cold re-solve, never carried uncertified."""
+    import warnings
+
+
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    E = mixtral.n_routed_experts
+
+    calls = []
+
+    def make_spy(real):
+        def spy(*args, **kwargs):
+            result = real(*args, **kwargs)
+            warm = kwargs.get("warm") is not None
+            calls.append(warm)
+            if warm:
+                result = result.model_copy(update={"certified": False})
+            return result
+        return spy
+
+    # solve_load_aware resolves halda_solve lazily via `from .api import
+    # halda_solve`, so patching the api module attribute intercepts it.
+    import distilp_tpu.solver.api as api_mod
+
+    monkeypatch.setattr(api_mod, "halda_solve", make_spy(api_mod.halda_solve))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        result, mapping, realized = solve_load_aware(
+            devs, mixtral, expert_loads=[5.0] + [1.0] * (E - 1), iters=2,
+            kv_bits="8bit", mip_gap=GAP, backend="jax",
+        )
+    # Pattern: cold, warm (forced uncertified), cold fallback.
+    assert calls == [False, True, False]
+    assert result.certified
+
+
+def test_solve_load_aware_rejects_managed_kwargs(mixtral):
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    with pytest.raises(TypeError, match="manages"):
+        solve_load_aware(devs, mixtral, expert_loads=None, moe=True)

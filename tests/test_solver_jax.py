@@ -102,3 +102,49 @@ def test_jax_backend_infeasible(profiles_dir):
     # k=20 -> W=4 < 6 devices: structurally infeasible; only candidate.
     with pytest.raises(RuntimeError, match="No feasible"):
         halda_solve(devs, model, k_candidates=[20], kv_bits="4bit", backend="jax")
+
+
+def test_qwen3_4b_4dev_full_sweep_both_backends():
+    """BASELINE.json config 2: Qwen3-4B over 4 heterogeneous devices, FULL
+    k-candidate sweep — analytic profile in, certified placement out, both
+    backends agreeing. (The other four baseline configs are covered by the
+    golden-fixture tests, the Mixtral/DeepSeek MoE tests, and bench.py.)"""
+    from distilp_tpu.profiler.api import profile_model
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = profile_model(
+        "tests/configs/qwen3_4b_8bit.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+    assert model.L == 36
+    devs = make_synthetic_fleet(4, seed=5)
+
+    gap = 1e-3
+    ref = halda_solve(devs, model, kv_bits="8bit", mip_gap=gap, backend="cpu")
+    got = halda_solve(devs, model, kv_bits="8bit", mip_gap=gap, backend="jax")
+    assert got.certified
+    assert abs(got.obj_value - ref.obj_value) <= 2 * gap * abs(ref.obj_value) + 1e-9
+    assert sum(got.w) * got.k == model.L
+    # Full sweep: the winning k is a proper factor of L=36.
+    assert got.k in (1, 2, 3, 4, 6, 9, 12, 18)
+
+
+def test_timings_breakdown_populated(profiles_dir):
+    """halda_solve(timings=...) must report the pack/upload/solve wall-clock
+    split the bench publishes."""
+    from distilp_tpu.common import load_model_profile
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(4, seed=3)
+    tm = {}
+    result = halda_solve(
+        devs, model, kv_bits="4bit", mip_gap=1e-3, backend="jax", timings=tm
+    )
+    assert result.certified
+    assert set(tm) == {"pack_ms", "upload_ms", "solve_ms"}
+    assert all(v >= 0 for v in tm.values())
+    assert tm["solve_ms"] > 0
