@@ -12,6 +12,9 @@ Prints ONE JSON line:
      "vs_baseline": <speedup>,
      "warm_tick_ms": <warm-start streaming re-solve ms>,
      "placements_per_sec": <1000 / warm_tick_ms>,
+     "pipelined_placements_per_sec": <submit/collect loop with one tick in
+                          flight: host prep + upload overlap the previous
+                          solve's execution + result transfer>,
      "moe_warm_tick_ms": <DeepSeek-V3 E=256 32-device streaming MoE
                           re-placement, certified, median ms>,
      "breakdown": {"pack_ms", "upload_ms", "solve_ms"}}
@@ -62,10 +65,27 @@ def main() -> int:
 
     # JAX backend: warm up (compile), then median-of-N wall clock.
     got = halda_solve(devs, model, mip_gap=MIP_GAP, kv_bits="4bit", backend="jax")
-    assert abs(got.obj_value - ref.obj_value) <= 2 * MIP_GAP * abs(ref.obj_value) + 1e-9, (
-        f"backend disagreement: jax={got.obj_value} cpu={ref.obj_value}"
+    agree = (
+        abs(got.obj_value - ref.obj_value)
+        <= 2 * MIP_GAP * abs(ref.obj_value) + 1e-9
     )
-    assert got.certified, f"north-star solve not certified (gap={got.gap})"
+    if not (agree and got.certified):
+        # Report the failure in the JSON rather than dying without a line.
+        print(
+            json.dumps(
+                {
+                    "metric": "halda_sweep_16dev_llama70b_wallclock",
+                    "value": None,
+                    "unit": "ms",
+                    "error": (
+                        f"north-star solve invalid: agree={agree} "
+                        f"certified={got.certified} gap={got.gap} "
+                        f"jax={got.obj_value} cpu={ref.obj_value}"
+                    ),
+                }
+            )
+        )
+        return 1
 
     times = []
     breakdown: dict = {}
@@ -94,26 +114,52 @@ def main() -> int:
         warm_times.append((time.perf_counter() - t0) * 1e3)
     warm_ms = statistics.median(warm_times)
 
+    # Pipelined streaming: one tick in flight while the next is prepared —
+    # host assembly + upload overlap the previous solve's execution and
+    # result transfer, so throughput beats 1/latency on RTT-bound links.
+    # The timer covers EVERY counted tick end to end (first submit
+    # included); an uncertified tick is reported, never asserted (the
+    # headline JSON line must survive).
+    planner.reset()
+    n_pipe = 2 * REPEATS
+    pipe_uncertified = 0
+    t0 = time.perf_counter()
+    planner.submit(devs, model)
+    for _ in range(n_pipe):
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.95, 1.05)))
+        planner.submit(devs, model)
+        if not planner.collect().certified:
+            pipe_uncertified += 1
+    if not planner.collect().certified:
+        pipe_uncertified += 1
+    pipe_s = time.perf_counter() - t0
+    pipelined_per_sec = (n_pipe + 1) / pipe_s
+
     # MoE real-time re-placement (BASELINE.json config 5): DeepSeek-V3,
     # E=256 routed experts co-assigned over a 32-device fleet. Warm ticks
-    # re-certify against the bound at the previous tick's multipliers.
-    moe_ms, moe_result = _moe_warm_tick(rng)
+    # re-certify against the bound at the previous tick's multipliers. A
+    # failure here must not cost the headline line: report it inline.
+    payload = {
+        "metric": "halda_sweep_16dev_llama70b_wallclock",
+        "value": round(jax_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / jax_ms, 3),
+        "warm_tick_ms": round(warm_ms, 3),
+        "placements_per_sec": round(1000.0 / warm_ms, 1),
+        "pipelined_placements_per_sec": round(pipelined_per_sec, 1),
+        "breakdown": breakdown,
+    }
+    if pipe_uncertified:
+        payload["pipelined_uncertified_ticks"] = pipe_uncertified
+    try:
+        moe_ms, moe_result = _moe_warm_tick(rng)
+        payload["moe_warm_tick_ms"] = round(moe_ms, 3)
+        payload["moe_certified"] = moe_result.certified
+    except Exception as e:  # pragma: no cover - defensive bench path
+        payload["moe_error"] = f"{type(e).__name__}: {e}"
 
-    print(
-        json.dumps(
-            {
-                "metric": "halda_sweep_16dev_llama70b_wallclock",
-                "value": round(jax_ms, 3),
-                "unit": "ms",
-                "vs_baseline": round(cpu_ms / jax_ms, 3),
-                "warm_tick_ms": round(warm_ms, 3),
-                "placements_per_sec": round(1000.0 / warm_ms, 1),
-                "moe_warm_tick_ms": round(moe_ms, 3),
-                "moe_certified": moe_result.certified,
-                "breakdown": breakdown,
-            }
-        )
-    )
+    print(json.dumps(payload))
     return 0
 
 

@@ -1,6 +1,6 @@
 """HALDA placement solver: CPU oracle + JAX/TPU batched backend."""
 
-from .api import halda_solve
+from .api import PendingHalda, halda_solve, halda_solve_async
 from .coeffs import (
     HaldaCoeffs,
     alpha_beta_xi,
@@ -25,6 +25,8 @@ from .streaming import StreamingReplanner
 
 __all__ = [
     "halda_solve",
+    "halda_solve_async",
+    "PendingHalda",
     "StreamingReplanner",
     "ExpertMapping",
     "expert_makespan",

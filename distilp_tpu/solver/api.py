@@ -24,6 +24,49 @@ from .result import HALDAResult, ILPResult
 Backend = str  # 'cpu' | 'jax'
 
 
+def _build_instance(
+    devs: Sequence[DeviceProfile],
+    model: ModelProfile,
+    k_candidates: Optional[Iterable[int]],
+    kv_bits: str,
+    moe: Optional[bool],
+    load_factors: Optional[Sequence[float]],
+):
+    """Shared validation + instance assembly of the sync and async paths:
+    (Ks, sets, coeffs, arrays). Any change here reaches both."""
+    use_moe = model_has_moe_components(model) if moe is None else bool(moe)
+    if use_moe and not model_has_moe_components(model):
+        raise ValueError(
+            "moe=True requires a profile with MoE component metrics "
+            "(bytes_per_expert, flops_per_active_expert_per_token, ...)"
+        )
+    if k_candidates:
+        Ks = sorted(set(int(k) for k in k_candidates))
+        bad = [k for k in Ks if k <= 0 or model.L % k != 0 or k == model.L]
+        if bad:
+            raise ValueError(
+                f"k candidates must be proper factors of L={model.L}; invalid: {bad}"
+            )
+    else:
+        Ks = valid_factors_of_L(model.L)
+
+    kv_factor = kv_bits_to_factor(kv_bits)
+    sets = assign_sets(devs)
+    if use_moe:
+        # Dense (w/n) costs come from the expert-free adjusted profile; the
+        # expert block (y) carries the routed-expert bytes and compute.
+        # load_factors re-prices each device's y-units at the realized load
+        # of a concrete expert mapping (see solver.routing).
+        coeffs = build_coeffs(devs, adjust_model(model), kv_factor, sets)
+        arrays = assemble(
+            coeffs, moe=build_moe_arrays(devs, model, load_factors=load_factors)
+        )
+    else:
+        coeffs = build_coeffs(devs, model, kv_factor, sets)
+        arrays = assemble(coeffs)
+    return Ks, sets, coeffs, arrays
+
+
 def halda_solve(
     devs: Sequence[DeviceProfile],
     model: ModelProfile,
@@ -73,36 +116,9 @@ def halda_solve(
     ``certified``/``gap`` reporting the optimality certificate; raises
     ``RuntimeError`` if no candidate k admits a feasible assignment.
     """
-    use_moe = model_has_moe_components(model) if moe is None else bool(moe)
-    if use_moe and not model_has_moe_components(model):
-        raise ValueError(
-            "moe=True requires a profile with MoE component metrics "
-            "(bytes_per_expert, flops_per_active_expert_per_token, ...)"
-        )
-    if k_candidates:
-        Ks = sorted(set(int(k) for k in k_candidates))
-        bad = [k for k in Ks if k <= 0 or model.L % k != 0 or k == model.L]
-        if bad:
-            raise ValueError(
-                f"k candidates must be proper factors of L={model.L}; invalid: {bad}"
-            )
-    else:
-        Ks = valid_factors_of_L(model.L)
-
-    kv_factor = kv_bits_to_factor(kv_bits)
-    sets = assign_sets(devs)
-    if use_moe:
-        # Dense (w/n) costs come from the expert-free adjusted profile; the
-        # expert block (y) carries the routed-expert bytes and compute.
-        # load_factors re-prices each device's y-units at the realized load
-        # of a concrete expert mapping (see solver.routing).
-        coeffs = build_coeffs(devs, adjust_model(model), kv_factor, sets)
-        arrays = assemble(
-            coeffs, moe=build_moe_arrays(devs, model, load_factors=load_factors)
-        )
-    else:
-        coeffs = build_coeffs(devs, model, kv_factor, sets)
-        arrays = assemble(coeffs)
+    Ks, sets, coeffs, arrays = _build_instance(
+        devs, model, k_candidates, kv_bits, moe, load_factors
+    )
 
     per_k_objs: List[Tuple[int, Optional[float]]] = []
     best: Optional[ILPResult] = None
@@ -180,3 +196,97 @@ def halda_solve(
         plot_k_curve(per_k_objs, k_star=result.k)
 
     return result
+
+
+class PendingHalda:
+    """An in-flight ``halda_solve`` (JAX backend): dispatched, not fetched.
+
+    ``collect()`` blocks on the device result and returns the HALDAResult.
+    Produced by ``halda_solve_async``; the point is overlap — the host can
+    build and dispatch the NEXT tick's instance while this one computes
+    and its result rides the (slow, on tunneled TPUs) link back.
+    """
+
+    def __init__(self, pending, Ks, sets, mip_gap):
+        self._pending = pending
+        self._Ks = Ks
+        self._sets = sets
+        self._mip_gap = mip_gap
+
+    def collect(self) -> HALDAResult:
+        from .backend_jax import collect_sweep
+
+        _, best = collect_sweep(self._pending)
+        if best is None:
+            raise RuntimeError("No feasible MILP found for any k.")
+        return HALDAResult(
+            w=list(best.w),
+            n=list(best.n),
+            k=best.k,
+            obj_value=best.obj_value,
+            sets={name: list(v) for name, v in self._sets.items()},
+            y=list(best.y) if best.y is not None else None,
+            certified=best.certified,
+            gap=best.gap,
+            duals=best.duals,
+        )
+
+
+def halda_solve_async(
+    devs: Sequence[DeviceProfile],
+    model: ModelProfile,
+    k_candidates: Optional[Iterable[int]] = None,
+    mip_gap: Optional[float] = 1e-4,
+    kv_bits: str = "8bit",
+    moe: Optional[bool] = None,
+    warm: Optional[HALDAResult] = None,
+    max_rounds: Optional[int] = None,
+    beam: Optional[int] = None,
+    ipm_iters: Optional[int] = None,
+    node_cap: Optional[int] = None,
+    load_factors: Optional[Sequence[float]] = None,
+) -> PendingHalda:
+    """Dispatch a HALDA solve and return without waiting for the result.
+
+    JAX backend only (the CPU oracle has no async substrate). Same
+    semantics as ``halda_solve`` otherwise; redeem with ``.collect()``.
+    Pipelining warm hints one tick behind (seed tick t+1 with tick t-1's
+    collected result) is sound: hints are re-priced exactly on-device, so
+    staleness only affects pruning speed, never correctness.
+    """
+    try:
+        from .backend_jax import PendingSweep, solve_sweep_jax
+    except ImportError as e:
+        raise NotImplementedError(
+            "The JAX backend is not available in this build "
+            f"(import failed: {e}); use halda_solve(backend='cpu')."
+        ) from e
+
+    Ks, sets, coeffs, arrays = _build_instance(
+        devs, model, k_candidates, kv_bits, moe, load_factors
+    )
+
+    warm_ilp = None
+    if warm is not None:
+        warm_ilp = ILPResult(
+            k=warm.k, w=warm.w, n=warm.n, y=warm.y,
+            obj_value=warm.obj_value, duals=warm.duals,
+        )
+    pending = solve_sweep_jax(
+        arrays,
+        [(k, model.L // k) for k in Ks],
+        mip_gap=mip_gap if mip_gap is not None else 1e-4,
+        coeffs=coeffs,
+        warm=warm_ilp,
+        max_rounds=max_rounds,
+        beam=beam,
+        ipm_iters=ipm_iters,
+        node_cap=node_cap,
+        collect=False,
+    )
+    if not isinstance(pending, PendingSweep):
+        # Plain (results, None) tuple: structurally infeasible sweep
+        # (no k admits W >= M). NB PendingSweep is itself a NamedTuple,
+        # so this must be a type check, not an isinstance(..., tuple).
+        raise RuntimeError("No feasible MILP found for any k.")
+    return PendingHalda(pending, Ks, sets, mip_gap)

@@ -1524,8 +1524,15 @@ def solve_sweep_jax(
     debug: bool = False,
     warm: Optional[ILPResult] = None,
     timings: Optional[dict] = None,
-) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
+    collect: bool = True,
+):
     """Solve the whole k-sweep on the accelerator.
+
+    ``collect=False`` returns a ``PendingSweep`` right after the dispatch
+    instead of blocking on the result fetch: the caller overlaps its own
+    work (typically the NEXT tick's coefficient build + upload) and redeems
+    the handle with ``collect_sweep``. A structurally infeasible sweep
+    (no k with W >= M) still returns the plain ``(results, None)`` tuple.
 
     ``timings`` (when a dict is passed) receives the wall-clock breakdown of
     the solve in milliseconds: ``pack_ms`` (host-side blob assembly),
@@ -1636,33 +1643,43 @@ def solve_sweep_jax(
         # would otherwise overlap — only pay it when someone asked.
         blob.block_until_ready()
     t2 = _time.perf_counter()
-    out = np.asarray(
-        jax.device_get(
-            _solve_packed(
-                blob,
-                M=M,
-                n_k=n_k,
-                m=sf.A.shape[1],
-                nf=sf.A.shape[2],
-                cap=cap,
-                ipm_iters=ipm_iters,
-                max_rounds=max_rounds,
-                beam=beam,
-                moe=sf.moe,
-                has_warm=warm_tuple is not None,
-                w_max=w_max,
-                e_max=e_max,
-                decomp_steps=decomp_steps,
-                has_duals=duals_tuple is not None,
-            )
-        )
+    out_dev = _solve_packed(
+        blob,
+        M=M,
+        n_k=n_k,
+        m=sf.A.shape[1],
+        nf=sf.A.shape[2],
+        cap=cap,
+        ipm_iters=ipm_iters,
+        max_rounds=max_rounds,
+        beam=beam,
+        moe=sf.moe,
+        has_warm=warm_tuple is not None,
+        w_max=w_max,
+        e_max=e_max,
+        decomp_steps=decomp_steps,
+        has_duals=duals_tuple is not None,
     )
-    t3 = _time.perf_counter()
+    pending = PendingSweep(
+        out=out_dev,
+        results=results,
+        feasible=feasible,
+        kWs=list(kWs),
+        M=M,
+        n_k=n_k,
+        moe=sf.moe,
+        w_max=w_max,
+        mip_gap=mip_gap,
+        debug=debug,
+    )
+    if collect is False:
+        # Async mode: the device is (or will be) computing; the caller
+        # overlaps its own work and calls collect_sweep later. jax's async
+        # dispatch means no host thread blocks here.
+        return pending
 
-    incumbent = float(out[0])
-    best_bound = float(out[1])
-    if debug:
-        print(f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f}")
+    results, best = collect_sweep(pending)
+    t3 = _time.perf_counter()
     if timings is not None or debug:
         tm = {
             "pack_ms": (t1 - t0) * 1e3,
@@ -1676,6 +1693,48 @@ def solve_sweep_jax(
                 f"    [jax] pack={tm['pack_ms']:.2f}ms "
                 f"upload={tm['upload_ms']:.2f}ms solve+fetch={tm['solve_ms']:.2f}ms"
             )
+    return results, best
+
+
+class PendingSweep(NamedTuple):
+    """An in-flight sweep: the un-fetched device result + decode context.
+
+    Produced by ``solve_sweep_jax(collect=False)``; redeemed by
+    ``collect_sweep``. The device program is already dispatched — holding a
+    PendingSweep costs nothing and lets the host overlap the next tick's
+    coefficient build and upload with this solve's execution and result
+    transfer (on a tunneled TPU the transfer IS the latency floor, so the
+    overlap is what pushes streaming throughput past 1/RTT).
+    """
+
+    out: jax.Array
+    results: List[Optional[ILPResult]]
+    feasible: List[Tuple[int, int]]
+    kWs: List[Tuple[int, int]]
+    M: int
+    n_k: int
+    moe: bool
+    w_max: int
+    mip_gap: float
+    debug: bool
+
+
+def collect_sweep(
+    pending: PendingSweep,
+) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
+    """Fetch + decode an in-flight sweep (the blocking half of the async
+    split). Same output contract as ``solve_sweep_jax``."""
+    out = np.asarray(jax.device_get(pending.out))
+    results = pending.results
+    feasible = pending.feasible
+    kWs = pending.kWs
+    M, n_k = pending.M, pending.n_k
+    mip_gap = pending.mip_gap
+
+    incumbent = float(out[0])
+    best_bound = float(out[1])
+    if pending.debug:
+        print(f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f}")
     if not np.isfinite(incumbent):
         return results, None
     achieved_gap = (
@@ -1709,7 +1768,7 @@ def solve_sweep_jax(
     # Root multipliers chosen by this solve (MoE only): persist on the
     # winning result so the next streaming tick warm-starts the ascent.
     out_duals = None
-    if sf.moe and w_max > 0:
+    if pending.moe and pending.w_max > 0:
         d0 = 4 + 3 * M + n_k
         lam_out = out[d0 : d0 + n_k]
         mu_out = out[d0 + n_k : d0 + 2 * n_k]
@@ -1727,7 +1786,7 @@ def solve_sweep_jax(
         if not np.isfinite(obj_j):
             continue
         if j == inc_k_idx:
-            y = inc_y if sf.moe else None
+            y = inc_y if pending.moe else None
             best = ILPResult(
                 k=k, w=inc_w, n=inc_n, y=y, obj_value=obj_j,
                 certified=certified, gap=achieved_gap, duals=out_duals,

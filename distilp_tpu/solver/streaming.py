@@ -48,6 +48,7 @@ class StreamingReplanner:
         self.last_mapping = None  # ExpertMapping of the last load-aware tick
         self._last_shape: Optional[tuple] = None
         self._load_factors = None  # realized per-device load multipliers
+        self._in_flight: list = []  # (PendingHalda, shape, devs, model, loads)
 
     def step(
         self,
@@ -131,8 +132,112 @@ class StreamingReplanner:
         self._last_shape = shape
         return result
 
+    def submit(
+        self,
+        devs: Sequence[DeviceProfile],
+        model: ModelProfile,
+        k_candidates: Optional[Sequence[int]] = None,
+    ):
+        """Pipelined tick, dispatch half: start a solve and return at once.
+
+        Pair with ``collect()``. Keeping ONE tick in flight while preparing
+        the next overlaps host-side instance assembly and the upload with
+        the previous solve's execution and result transfer — on a tunneled
+        TPU that transfer is the latency floor, so a submit/collect loop
+        sustains more placements/sec than back-to-back ``step()`` calls.
+
+        Warm seeding uses the most recently COLLECTED result (one tick
+        stale in a full pipeline). That is sound — warm hints are re-priced
+        exactly on-device, staleness only costs pruning speed — and the
+        same goes for the stored Lagrangian duals and load factors riding
+        on it. JAX backend only.
+        """
+        from .api import halda_solve_async
+        from .moe import model_has_moe_components
+
+        if self.backend != "jax":
+            raise RuntimeError("pipelined ticks need backend='jax'")
+        use_moe = (
+            model_has_moe_components(model) if self.moe is None else bool(self.moe)
+        )
+        shape = (len(devs), model.L, use_moe)
+        warm = self.last if shape == self._last_shape else None
+
+        loads = None
+        if use_moe and model.expert_loads is not None:
+            import numpy as np
+
+            from .routing import normalize_loads
+
+            loads = normalize_loads(model.expert_loads, model.n_routed_experts)
+            if np.allclose(loads, 1.0):
+                loads = None
+        factors = self._load_factors if loads is not None else None
+        if factors is not None and len(factors) != len(devs):
+            factors = None
+
+        pending = halda_solve_async(
+            devs,
+            model,
+            k_candidates=k_candidates,
+            mip_gap=self.mip_gap,
+            kv_bits=self.kv_bits,
+            moe=self.moe,
+            warm=warm,
+            load_factors=factors,
+        )
+        # Snapshot the fleet: streaming callers mutate profiles in place
+        # between ticks, and collect()'s fallback re-solve plus the MoE
+        # mapping must price THIS tick's state, not whatever the profiles
+        # have drifted to by redeem time.
+        devs_snap = [d.model_copy(deep=True) for d in devs]
+        self._in_flight.append(
+            (pending, shape, devs_snap, model, loads, k_candidates, factors,
+             warm)
+        )
+        return pending
+
+    def collect(self) -> HALDAResult:
+        """Pipelined tick, blocking half: redeem the oldest in-flight solve."""
+        if not self._in_flight:
+            raise RuntimeError("no in-flight tick; call submit() first")
+        (pending, shape, devs, model, loads, k_candidates, factors,
+         warm) = self._in_flight.pop(0)
+        result = pending.collect()
+        if warm is not None and warm.duals is not None and not result.certified:
+            # Same stale-dual fallback as step(): re-solve cold (same
+            # instance — k_candidates and load factors included) rather
+            # than return an uncertified placement. Synchronous: the
+            # pipeline hiccups, correctness does not. MoE-only, gated on
+            # the stale duals that caused the miss.
+            result = halda_solve(
+                devs,
+                model,
+                k_candidates=k_candidates,
+                mip_gap=self.mip_gap,
+                kv_bits=self.kv_bits,
+                backend=self.backend,
+                moe=self.moe,
+                load_factors=factors,
+            )
+        if loads is not None and result.y is not None:
+            from .moe import build_moe_arrays
+            from .routing import map_experts
+
+            g_base = build_moe_arrays(devs, model).g_raw
+            mapping = map_experts(result.y, g_base, loads)
+            self.last_mapping = mapping
+            self._load_factors = mapping.factors
+        else:
+            self.last_mapping = None
+            self._load_factors = None
+        self.last = result
+        self._last_shape = shape
+        return result
+
     def reset(self) -> None:
         self.last = None
         self.last_mapping = None
         self._last_shape = None
         self._load_factors = None
+        self._in_flight = []

@@ -1,0 +1,150 @@
+"""Seeded fuzz: the JAX backend must match the HiGHS oracle on randomized
+instances, dense and MoE.
+
+The parity tests elsewhere pin specific fixtures; this file sweeps the
+instance space — random fleet sizes/speeds/memories, perturbed model
+scalars, random kv precision — so a formulation drift between the two
+backends (a row the assembler adds that the rounding pricer does not
+mirror, a bound the decomposition prices differently) surfaces as a
+seeded, reproducible failure instead of a silent disagreement in the
+field. Deterministic seeds: no flakes, failures replay exactly.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+pytest.importorskip("scipy")
+
+from distilp_tpu.common import load_model_profile  # noqa: E402
+from distilp_tpu.profiler.api import profile_model  # noqa: E402
+from distilp_tpu.solver import halda_solve  # noqa: E402
+from distilp_tpu.utils import make_synthetic_fleet  # noqa: E402
+
+GAP = 1e-3
+
+
+def _perturb_fleet(devs, rng):
+    """Random multiplicative noise on the load-bearing fleet coefficients."""
+    for d in devs:
+        d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.3, 3.0)))
+        d.s_disk = max(1e6, d.s_disk * float(rng.uniform(0.3, 3.0)))
+        d.d_avail_ram = max(int(1e9), int(d.d_avail_ram * rng.uniform(0.5, 2.0)))
+        if d.d_avail_cuda is not None:
+            d.d_avail_cuda = max(
+                int(1e9), int(d.d_avail_cuda * rng.uniform(0.5, 2.0))
+            )
+        if d.d_avail_metal is not None:
+            d.d_avail_metal = max(
+                int(1e9), int(d.d_avail_metal * rng.uniform(0.5, 2.0))
+            )
+    return devs
+
+
+def _agree(ref, got, gap=GAP):
+    tol = 2 * gap * abs(ref.obj_value) + 1e-9
+    assert abs(got.obj_value - ref.obj_value) <= tol, (
+        f"backend disagreement: cpu={ref.obj_value} jax={got.obj_value} "
+        f"(cpu k={ref.k}, jax k={got.k})"
+    )
+
+
+@pytest.mark.parametrize("seed", [11, 23, 37, 59])
+def test_fuzz_dense_backends_agree(profiles_dir, seed):
+    rng = np.random.default_rng(seed)
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    M = int(rng.choice([3, 5, 8]))
+    devs = _perturb_fleet(make_synthetic_fleet(M, seed=seed), rng)
+    kv = str(rng.choice(["4bit", "8bit", "fp16"]))
+    ref = halda_solve(devs, model, mip_gap=GAP, kv_bits=kv, backend="cpu")
+    got = halda_solve(devs, model, mip_gap=GAP, kv_bits=kv, backend="jax")
+    _agree(ref, got)
+    assert sum(got.w) * got.k == model.L
+
+
+@pytest.mark.parametrize("seed", [7, 41])
+def test_fuzz_moe_backends_agree(seed):
+    rng = np.random.default_rng(seed)
+    model = profile_model(
+        "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+    M = int(rng.choice([3, 4, 5]))
+    devs = _perturb_fleet(
+        make_synthetic_fleet(M, seed=seed, pool_bytes=int(96e9)), rng
+    )
+    # Random expert-load factors exercise the weighted-g path end to end.
+    factors = [float(rng.uniform(0.2, 2.5)) for _ in range(M)]
+    ref = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="8bit", backend="cpu",
+        load_factors=factors,
+    )
+    got = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="8bit", backend="jax",
+        load_factors=factors,
+    )
+    _agree(ref, got)
+    assert sum(got.y) == model.n_routed_experts
+
+
+def test_fuzz_streaming_drift_stays_certified(profiles_dir):
+    """A long drift run: 8 warm ticks under compounding perturbation must
+    stay certified and keep matching a cold solve at the end."""
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    from distilp_tpu.solver import StreamingReplanner
+
+    rng = np.random.default_rng(5)
+    devs = make_synthetic_fleet(6, seed=5)
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="4bit", backend="jax")
+    planner.step(devs, model)
+    for _ in range(8):
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.8, 1.25)))
+        tick = planner.step(devs, model)
+        assert tick.certified
+    cold = halda_solve(
+        copy.deepcopy(devs), model, mip_gap=GAP, kv_bits="4bit", backend="jax"
+    )
+    _agree(cold, tick)
+
+
+def test_gpt_oss_mxfp4_moe_solve_agrees():
+    """GPT-OSS-20B (MXFP4, E=32, top-4): the third MoE family solves
+    certified with both backends agreeing — MXFP4 quantization parsing and
+    expert co-assignment compose."""
+    model = profile_model(
+        "tests/configs/gpt_oss_20b_mxfp4.json",
+        batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+    assert model.n_routed_experts == 32 and model.experts_per_token == 4
+    devs = make_synthetic_fleet(4, seed=13, pool_bytes=int(8e9))
+    ref = halda_solve(devs, model, mip_gap=GAP, kv_bits="8bit", backend="cpu")
+    got = halda_solve(devs, model, mip_gap=GAP, kv_bits="8bit", backend="jax")
+    _agree(ref, got)
+    assert got.certified
+    assert sum(got.y) == 32 and sum(got.w) * got.k == model.L
+
+
+def test_qwen3_moe_a3b_solve_agrees():
+    """Qwen3-30B-A3B (E=128, top-8): the fourth MoE family, wide expert
+    count with small experts — stresses the y-repair scan budget."""
+    model = profile_model(
+        "tests/configs/qwen3_30b_a3b_8bit.json",
+        batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+    assert model.n_routed_experts == 128
+    devs = make_synthetic_fleet(4, seed=17, pool_bytes=int(24e9))
+    ref = halda_solve(devs, model, mip_gap=GAP, kv_bits="8bit", backend="cpu")
+    got = halda_solve(devs, model, mip_gap=GAP, kv_bits="8bit", backend="jax")
+    _agree(ref, got)
+    assert got.certified
+    assert sum(got.y) == 128 and sum(got.w) * got.k == model.L

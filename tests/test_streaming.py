@@ -199,3 +199,36 @@ def test_moe_warm_tick_falls_back_to_cold_when_uncertified(monkeypatch):
     # certified cold one.
     assert calls == [True, False]
     assert tick.certified
+
+
+def test_pipelined_ticks_match_sequential(fleet_and_model):
+    """submit/collect with one tick in flight: every tick certified, warm
+    hints one tick stale, final placement matching a cold solve."""
+    devs, model = fleet_and_model
+    devs = [copy.deepcopy(d) for d in devs]
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="4bit", backend="jax")
+
+    import numpy as np
+
+    rng = np.random.default_rng(9)
+    planner.submit(devs, model)  # tick 0 in flight
+    results = []
+    for _ in range(4):
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.9, 1.1)))
+        planner.submit(devs, model)  # tick t+1 dispatched...
+        results.append(planner.collect())  # ...before tick t is redeemed
+    results.append(planner.collect())
+    assert all(r.certified for r in results)
+
+    cold = halda_solve(devs, model, kv_bits="4bit", mip_gap=GAP, backend="jax")
+    assert _close(results[-1].obj_value, cold.obj_value)
+
+
+def test_pipeline_guards():
+    planner = StreamingReplanner(backend="cpu")
+    with pytest.raises(RuntimeError, match="jax"):
+        planner.submit([], None)
+    planner2 = StreamingReplanner(backend="jax")
+    with pytest.raises(RuntimeError, match="in-flight"):
+        planner2.collect()
