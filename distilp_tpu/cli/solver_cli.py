@@ -51,6 +51,15 @@ def build_parser() -> argparse.ArgumentParser:
         "component metrics), on (require them), off (dense formulation)",
     )
     p.add_argument(
+        "--warm-from",
+        default=None,
+        help="warm-start from a solution JSON previously written by "
+        "--save-solution (jax backend): the stored assignment is re-priced "
+        "exactly under the current profiles and seeds the search; stored "
+        "Lagrangian duals make a MoE re-solve re-certify without the full "
+        "root ascent",
+    )
+    p.add_argument(
         "--expert-loads",
         default=None,
         help="load-weighted expert routing: a JSON file with one relative "
@@ -121,6 +130,36 @@ def main(argv=None) -> int:
             print(f"error: cannot parse --expert-loads: {e}", file=sys.stderr)
             return 2
 
+    warm = None
+    if args.warm_from:
+        from ..solver import HALDAResult
+
+        try:
+            saved = json.loads(Path(args.warm_from).read_text())
+            warm = HALDAResult(
+                k=saved["k"],
+                w=saved["w"],
+                n=saved["n"],
+                obj_value=saved["obj_value"],
+                sets=saved.get("sets", {}),
+                y=saved.get("y"),
+                duals=saved.get("duals"),
+            )
+        except (OSError, KeyError, TypeError, ValueError,
+                json.JSONDecodeError) as e:
+            print(f"error: cannot load --warm-from: {e}", file=sys.stderr)
+            return 2
+        if expert_loads is not None:
+            # solve_load_aware manages warm-starting across its own
+            # iterations; a user-supplied warm seed would be silently
+            # dropped there — reject the combination instead.
+            print(
+                "error: --warm-from cannot be combined with --expert-loads "
+                "(the load-aware loop manages its own warm starts)",
+                file=sys.stderr,
+            )
+            return 2
+
     mapping = None
     realized = None
     try:
@@ -155,6 +194,7 @@ def main(argv=None) -> int:
                 backend=args.backend,
                 time_limit=args.time_limit,
                 moe={"auto": None, "on": True, "off": False}[args.moe],
+                warm=warm,
                 max_rounds=args.max_rounds,
                 beam=args.beam,
                 ipm_iters=args.ipm_iters,
@@ -197,6 +237,10 @@ def main(argv=None) -> int:
         }
         if result.y is not None:
             payload["y"] = result.y
+        if result.duals is not None:
+            # Persist the Lagrangian root multipliers so --warm-from can
+            # re-certify a MoE re-solve without the full root ascent.
+            payload["duals"] = result.duals
         if mapping is not None:
             payload["expert_of_device"] = mapping.expert_of_device
             payload["expert_load_share"] = [float(s) for s in mapping.load_share]

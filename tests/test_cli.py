@@ -272,3 +272,85 @@ def test_solver_cli_expert_loads(tmp_path, capsys):
             "not,numbers",
         ]
     ) == 2
+
+
+def test_solver_cli_warm_from_round_trip(tmp_path, capsys):
+    """--save-solution then --warm-from: the saved assignment (and, for MoE,
+    the persisted duals) seed the re-solve; the answer matches."""
+    pytest.importorskip("jax")
+    from distilp_tpu.cli.solver_cli import main
+
+    sol = tmp_path / "solution.json"
+    rc = main(
+        [
+            "--profile",
+            str(PROFILES / "mixtral_8x7b"),
+            "--backend",
+            "jax",
+            "--kv-bits",
+            "8bit",
+            "--mip-gap",
+            "1e-3",
+            "--save-solution",
+            str(sol),
+        ]
+    )
+    assert rc == 0
+    saved = json.loads(sol.read_text())
+    assert "duals" in saved  # MoE solves persist their root multipliers
+
+    rc2 = main(
+        [
+            "--profile",
+            str(PROFILES / "mixtral_8x7b"),
+            "--backend",
+            "jax",
+            "--kv-bits",
+            "8bit",
+            "--mip-gap",
+            "1e-3",
+            "--warm-from",
+            str(sol),
+            "--save-solution",
+            str(tmp_path / "warm.json"),
+        ]
+    )
+    assert rc2 == 0
+    warm = json.loads((tmp_path / "warm.json").read_text())
+    assert warm["certified"]
+    assert warm["obj_value"] == pytest.approx(saved["obj_value"], rel=2e-3)
+
+    # A broken warm file errors cleanly instead of tracebacking.
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert main(
+        [
+            "--profile",
+            str(PROFILES / "mixtral_8x7b"),
+            "--warm-from",
+            str(bad),
+        ]
+    ) == 2
+
+
+def test_solver_cli_warm_from_conflicts_and_bad_types(tmp_path):
+    from distilp_tpu.cli.solver_cli import main
+
+    # Valid JSON of the wrong shape errors cleanly (no traceback).
+    arr = tmp_path / "arr.json"
+    arr.write_text("[5, 3, 1]")
+    assert main(
+        ["--profile", str(PROFILES / "mixtral_8x7b"), "--warm-from", str(arr)]
+    ) == 2
+    # --warm-from + --expert-loads is rejected (the load-aware loop manages
+    # its own warm starts; the seed would be silently dropped otherwise).
+    assert main(
+        [
+            "--profile",
+            str(PROFILES / "mixtral_8x7b"),
+            "--warm-from",
+            str(arr),
+            "--expert-loads",
+            "5,3,1,1,1,1,1,1",
+        ]
+    ) == 2
