@@ -44,11 +44,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated batch sizes for model profiling (default 1,2,4,8)",
     )
     p.add_argument("--not-head", action="store_true", help="mark device as non-head")
+    p.add_argument(
+        "--raw-out",
+        default=None,
+        help="device profiling only: also write the raw DeviceInfo JSON "
+        "(per-measurement timing spreads, HBM capacity provenance, "
+        "interconnect probe) that the solver-facing profile drops",
+    )
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.kind == "model" and args.raw_out:
+        print(
+            "error: --raw-out applies to device profiling only "
+            "(model profiling is analytic; there is no raw DeviceInfo)",
+            file=sys.stderr,
+        )
+        return 2
 
     if args.kind == "model":
         from ..profiler import profile_model
@@ -65,10 +80,15 @@ def main(argv=None) -> int:
     else:
         from ..profiler import profile_device
 
+        raw_info = [] if args.raw_out else None
         profile = profile_device(
-            args.repo, max_batch_exp=args.max_batch_exp, is_head=not args.not_head
+            args.repo, max_batch_exp=args.max_batch_exp,
+            is_head=not args.not_head, raw_info=raw_info,
         )
         out = Path(args.output or f"{profile.name or 'device'}.json")
+        if args.raw_out and raw_info:
+            Path(args.raw_out).write_text(raw_info[0].model_dump_json(indent=2))
+            print(f"Wrote raw DeviceInfo to {args.raw_out}", file=sys.stderr)
 
     out.write_text(profile.model_dump_json(indent=2))
     print(f"Wrote {args.kind} profile to {out}", file=sys.stderr)

@@ -134,16 +134,21 @@ def main(argv=None) -> int:
     if args.warm_from:
         from ..solver import HALDAResult
 
+        if args.backend != "jax":
+            # The CPU/HiGHS path has no warm-start hook; silently solving
+            # cold would contradict what the flag promises.
+            print(
+                "error: --warm-from needs --backend jax (the cpu backend "
+                "has no warm-start hook and would ignore the seed)",
+                file=sys.stderr,
+            )
+            return 2
         try:
-            saved = json.loads(Path(args.warm_from).read_text())
-            warm = HALDAResult(
-                k=saved["k"],
-                w=saved["w"],
-                n=saved["n"],
-                obj_value=saved["obj_value"],
-                sets=saved.get("sets", {}),
-                y=saved.get("y"),
-                duals=saved.get("duals"),
+            # model_validate: full type validation, extra keys (devices,
+            # expert_of_device, ...) ignored — reload stays in sync with
+            # whatever --save-solution writes.
+            warm = HALDAResult.model_validate(
+                json.loads(Path(args.warm_from).read_text())
             )
         except (OSError, KeyError, TypeError, ValueError,
                 json.JSONDecodeError) as e:

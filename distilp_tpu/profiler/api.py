@@ -40,10 +40,13 @@ def profile_device(
     source: ConfigSource,
     max_batch_exp: int = 6,
     is_head: bool = True,
+    raw_info=None,
 ) -> DeviceProfile:
     """Microbenchmark this host/accelerator for the given model's shapes
-    (reference api.py:54-82)."""
+    (reference api.py:54-82). ``raw_info``: see ``device.profile_device``."""
     from .device import profile_device as _profile_device
 
     cfg = load_config(source)
-    return _profile_device(cfg, max_batch_exp=max_batch_exp, is_head=is_head)
+    return _profile_device(
+        cfg, max_batch_exp=max_batch_exp, is_head=is_head, raw_info=raw_info
+    )

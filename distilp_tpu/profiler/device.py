@@ -720,10 +720,19 @@ def profile_device(
     config: HFConfig,
     max_batch_exp: int = 6,
     is_head: bool = True,
+    raw_info: Optional[List[DeviceInfo]] = None,
 ) -> DeviceProfile:
     """Microbenchmark this host and map to the solver's DeviceProfile
-    (reference :577-744)."""
+    (reference :577-744).
+
+    ``raw_info`` (a list, appended to) receives the raw ``DeviceInfo`` —
+    per-measurement timing spreads (``stats``), HBM capacity provenance,
+    interconnect probe — which the solver-facing DeviceProfile mapping
+    does not carry. The CLI's ``--raw-out`` persists it.
+    """
     di = profile(config, max_batch_exp)
+    if raw_info is not None:
+        raw_info.append(di)
     ret = DeviceProfile()
     ret.name = platform.node() or "device"
 

@@ -340,7 +340,8 @@ def test_solver_cli_warm_from_conflicts_and_bad_types(tmp_path):
     arr = tmp_path / "arr.json"
     arr.write_text("[5, 3, 1]")
     assert main(
-        ["--profile", str(PROFILES / "mixtral_8x7b"), "--warm-from", str(arr)]
+        ["--profile", str(PROFILES / "mixtral_8x7b"), "--backend", "jax",
+         "--warm-from", str(arr)]
     ) == 2
     # --warm-from + --expert-loads is rejected (the load-aware loop manages
     # its own warm starts; the seed would be silently dropped otherwise).
@@ -348,9 +349,67 @@ def test_solver_cli_warm_from_conflicts_and_bad_types(tmp_path):
         [
             "--profile",
             str(PROFILES / "mixtral_8x7b"),
+            "--backend",
+            "jax",
             "--warm-from",
             str(arr),
             "--expert-loads",
             "5,3,1,1,1,1,1,1",
         ]
     ) == 2
+    # The cpu backend has no warm-start hook: silently cold-solving would
+    # contradict the flag, so the combination is rejected.
+    assert main(
+        ["--profile", str(PROFILES / "mixtral_8x7b"), "--warm-from", str(arr)]
+    ) == 2
+    # --raw-out is device-profiling-only on the profiler CLI.
+    from distilp_tpu.cli.profiler_cli import main as pmain
+
+    assert pmain(
+        ["model", "-r", str(CONFIGS / "llama31_8b_4bit.json"),
+         "--raw-out", str(tmp_path / "nope.json")]
+    ) == 2
+
+
+def test_profiler_cli_raw_out_carries_stats(tmp_path):
+    """--raw-out persists the raw DeviceInfo with measurement spreads and
+    capacity provenance — the observability the DeviceProfile mapping drops."""
+    from distilp_tpu.cli.profiler_cli import main
+    from distilp_tpu.profiler import DeviceInfo
+
+    knobs = {
+        "DPERF_GEMM_WARMUP": "0",
+        "DPERF_GEMM_ITERS": "2",
+        "DPERF_MEM_MB": "4",
+        "DPERF_DISK_FILE_MB": "2",
+        "DPERF_DISK_CHUNK_MB": "1",
+    }
+    old = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    try:
+        raw = tmp_path / "raw.json"
+        rc = main(
+            [
+                "device",
+                "-r",
+                str(CONFIGS / "llama31_8b_4bit.json"),
+                "-o",
+                str(tmp_path / "dev.json"),
+                "--max-batch-exp",
+                "1",
+                "--raw-out",
+                str(raw),
+            ]
+        )
+        assert rc == 0
+        di = DeviceInfo.model_validate_json(raw.read_text())
+        # Measurement spreads were recorded with valid ordering.
+        assert di.stats, "raw DeviceInfo carries no measurement stats"
+        st = next(iter(di.stats.values()))
+        assert st.samples >= 1 and st.min <= st.p50 <= st.max
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
