@@ -150,8 +150,8 @@ def main(argv=None) -> int:
             warm = HALDAResult.model_validate(
                 json.loads(Path(args.warm_from).read_text())
             )
-        except (OSError, KeyError, TypeError, ValueError,
-                json.JSONDecodeError) as e:
+        except (OSError, TypeError, ValueError) as e:
+            # ValidationError and JSONDecodeError are ValueError subclasses.
             print(f"error: cannot load --warm-from: {e}", file=sys.stderr)
             return 2
         if expert_loads is not None:

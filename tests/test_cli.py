@@ -371,45 +371,37 @@ def test_solver_cli_warm_from_conflicts_and_bad_types(tmp_path):
     ) == 2
 
 
-def test_profiler_cli_raw_out_carries_stats(tmp_path):
+def test_profiler_cli_raw_out_carries_stats(tmp_path, monkeypatch):
     """--raw-out persists the raw DeviceInfo with measurement spreads and
     capacity provenance — the observability the DeviceProfile mapping drops."""
     from distilp_tpu.cli.profiler_cli import main
     from distilp_tpu.profiler import DeviceInfo
 
-    knobs = {
+    for k, v in {
         "DPERF_GEMM_WARMUP": "0",
         "DPERF_GEMM_ITERS": "2",
         "DPERF_MEM_MB": "4",
         "DPERF_DISK_FILE_MB": "2",
         "DPERF_DISK_CHUNK_MB": "1",
-    }
-    old = {k: os.environ.get(k) for k in knobs}
-    os.environ.update(knobs)
-    try:
-        raw = tmp_path / "raw.json"
-        rc = main(
-            [
-                "device",
-                "-r",
-                str(CONFIGS / "llama31_8b_4bit.json"),
-                "-o",
-                str(tmp_path / "dev.json"),
-                "--max-batch-exp",
-                "1",
-                "--raw-out",
-                str(raw),
-            ]
-        )
-        assert rc == 0
-        di = DeviceInfo.model_validate_json(raw.read_text())
-        # Measurement spreads were recorded with valid ordering.
-        assert di.stats, "raw DeviceInfo carries no measurement stats"
-        st = next(iter(di.stats.values()))
-        assert st.samples >= 1 and st.min <= st.p50 <= st.max
-    finally:
-        for k, v in old.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+    }.items():
+        monkeypatch.setenv(k, v)
+    raw = tmp_path / "raw.json"
+    rc = main(
+        [
+            "device",
+            "-r",
+            str(CONFIGS / "llama31_8b_4bit.json"),
+            "-o",
+            str(tmp_path / "dev.json"),
+            "--max-batch-exp",
+            "1",
+            "--raw-out",
+            str(raw),
+        ]
+    )
+    assert rc == 0
+    di = DeviceInfo.model_validate_json(raw.read_text())
+    # Measurement spreads were recorded with valid ordering.
+    assert di.stats, "raw DeviceInfo carries no measurement stats"
+    st = next(iter(di.stats.values()))
+    assert st.samples >= 1 and st.min <= st.p50 <= st.max

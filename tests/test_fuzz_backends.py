@@ -53,7 +53,7 @@ def _agree(ref, got, gap=GAP):
     )
 
 
-@pytest.mark.parametrize("seed", [11, 23, 37, 59])
+@pytest.mark.parametrize("seed", [11, 23, 37, 59, 71, 97])
 def test_fuzz_dense_backends_agree(profiles_dir, seed):
     rng = np.random.default_rng(seed)
     model = load_model_profile(
@@ -68,7 +68,7 @@ def test_fuzz_dense_backends_agree(profiles_dir, seed):
     assert sum(got.w) * got.k == model.L
 
 
-@pytest.mark.parametrize("seed", [7, 41])
+@pytest.mark.parametrize("seed", [7, 41, 53])
 def test_fuzz_moe_backends_agree(seed):
     rng = np.random.default_rng(seed)
     model = profile_model(
