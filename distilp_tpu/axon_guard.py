@@ -1,0 +1,84 @@
+"""Containment for the tunneled TPU PJRT plugin ("axon") wedging backend init.
+
+On this image a sitecustomize registers the axon PJRT plugin in every
+interpreter. When the TPU tunnel is down, ANY JAX backend initialization
+wedges the process forever — ``JAX_PLATFORMS=cpu`` alone does not help,
+because the plugin factory latches before user code runs. The only reliable
+guard is to unregister the factory before the first backend initializes.
+
+This module is the single shared implementation of that guard (used by
+``tests/conftest.py``, ``bench.py`` and ``__graft_entry__.py``); it touches a
+private JAX API (``xla_bridge._backend_factories``) in exactly one place so a
+JAX upgrade needs one fix, not three.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+import warnings
+from collections.abc import Callable, Iterator
+
+
+def force_cpu_platform() -> bool:
+    """Pin the CPU platform and unregister the axon plugin factory.
+
+    Must run before the first JAX backend initializes (importing jax is fine
+    — the sitecustomize already did that; *initializing a backend* is the
+    wedge). Returns True if the factory was popped (or was absent), False if
+    backends were already initialized or the private API moved — in both
+    False cases a warning explains the residual hang risk.
+    """
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        warnings.warn(
+            "force_cpu_platform() called after JAX backends initialized; "
+            "platform cannot be changed now",
+            stacklevel=2,
+        )
+        return False
+    # NOT redundant with a JAX_PLATFORMS=cpu env var: the sitecustomize
+    # imported jax first, so jax.config already latched the env value.
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        xla_bridge._backend_factories.pop("axon", None)
+    except AttributeError:
+        warnings.warn(
+            "jax.xla_bridge._backend_factories is gone; the axon PJRT plugin "
+            "cannot be unregistered and this process may hang at backend "
+            "init if the TPU tunnel is down",
+            stacklevel=2,
+        )
+        return False
+    return True
+
+
+@contextlib.contextmanager
+def backend_init_watchdog(
+    timeout_s: float, on_timeout: Callable[[], None]
+) -> Iterator[None]:
+    """Best-effort SIGALRM watchdog around a first JAX-backend contact.
+
+    A probe subprocess can report a live tunnel that drops before the parent
+    initializes its own backend (TOCTOU); this arms an interval timer so the
+    parent can still emit structured output instead of hanging silently.
+    Best-effort because a wedge that never releases the GIL also never lets
+    the Python signal handler run — but the tunnel's gRPC waits do release
+    it. ``on_timeout`` should report and ``os._exit``; if it returns, the
+    wedged call resumes.
+
+    Main thread only (SIGALRM); nesting is not supported.
+    """
+
+    def _handler(signum, frame):  # noqa: ARG001 - signal handler signature
+        on_timeout()
+
+    old = signal.signal(signal.SIGALRM, _handler)
+    signal.setitimer(signal.ITIMER_REAL, timeout_s)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)

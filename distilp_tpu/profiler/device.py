@@ -28,6 +28,7 @@ probe populates fields that actually exist on the schema (reference :53).
 from __future__ import annotations
 
 import gc
+import math
 import os
 import platform
 import statistics as stats
@@ -150,6 +151,25 @@ def bench(
             file=sys.stderr,
         )
     return net if st.valid else float("nan")
+
+
+def _rate(nbytes: float, seconds: float) -> float:
+    """bytes/sec with sub-noise measurements mapped to 0.0, never NaN.
+
+    ``bench()`` returns NaN for a sub-noise net time. The direct-division
+    call sites all pass ``baseline=0`` today — an implicit invariant that
+    makes NaN unreachable there; this helper makes the contract explicit so
+    adding a baseline at one of those sites writes "no measured bandwidth"
+    (0.0) into the profile instead of silently persisting NaN into JSON.
+    """
+    if math.isnan(seconds) or seconds <= 0.0:
+        return 0.0
+    return nbytes / seconds
+
+
+def _ms(seconds: float) -> float:
+    """Milliseconds with sub-noise (NaN) measurements mapped to 0.0."""
+    return 0.0 if math.isnan(seconds) else 1000.0 * seconds
 
 
 def _fetch_baseline(backend: str) -> float:
@@ -361,12 +381,14 @@ def get_sysmem_info(di: DeviceInfo) -> None:
     nbytes = n * 4
 
     read = jax.jit(jnp.max)  # runs on the CPU: A is CPU-resident
-    di.memory.cpu_read_cold_bw = nbytes / bench(
-        lambda: read(A), 0, 1, label="mem.cpu_read_cold", sink=di.stats
+    di.memory.cpu_read_cold_bw = _rate(
+        nbytes,
+        bench(lambda: read(A), 0, 1, label="mem.cpu_read_cold", sink=di.stats),
     )
     warm_read = jax.jit(jnp.sum)  # scalar output: bench() fetches it to sync
-    di.memory.cpu_read_warm_bw = nbytes / bench(
-        lambda: warm_read(A), 5, 10, label="mem.cpu_read_warm", sink=di.stats
+    di.memory.cpu_read_warm_bw = _rate(
+        nbytes,
+        bench(lambda: warm_read(A), 5, 10, label="mem.cpu_read_warm", sink=di.stats),
     )
 
     # No input to anchor placement: pin the fill's output to the CPU device.
@@ -374,17 +396,19 @@ def get_sysmem_info(di: DeviceInfo) -> None:
         lambda: jnp.full((n,), 23.4, dtype=jnp.float32),
         out_shardings=jax.sharding.SingleDeviceSharding(cpu),
     )
-    di.memory.cpu_write_cold_bw = nbytes / bench(
-        fill, 0, 1, label="mem.cpu_write_cold", sink=di.stats
+    di.memory.cpu_write_cold_bw = _rate(
+        nbytes, bench(fill, 0, 1, label="mem.cpu_write_cold", sink=di.stats)
     )
-    di.memory.cpu_write_warm_bw = nbytes / bench(
-        fill, 5, 10, label="mem.cpu_write_warm", sink=di.stats
+    di.memory.cpu_write_warm_bw = _rate(
+        nbytes, bench(fill, 5, 10, label="mem.cpu_write_warm", sink=di.stats)
     )
 
     host_buf = np.random.randn(n // 8).astype(np.float32)
-    di.memory.memcpy_delay = 1000 * bench(
-        lambda: jax.device_put(host_buf, cpu), 1, 5,
-        label="mem.memcpy", sink=di.stats,
+    di.memory.memcpy_delay = _ms(
+        bench(
+            lambda: jax.device_put(host_buf, cpu), 1, 5,
+            label="mem.memcpy", sink=di.stats,
+        )
     )
     del A, host_buf
     gc.collect()
@@ -544,18 +568,25 @@ def bench_host_accel_transfers(di: DeviceInfo, n_embd: int) -> None:
     try:
         host = np.ones((n,), dtype=np.float32)
         nbytes = n * 4
-        di.gpu.memory.read_bw = nbytes / bench(
-            lambda: jax.device_put(host, dev), 1, 5,
-            label="xfer.host_to_accel", sink=di.stats,
+        di.gpu.memory.read_bw = _rate(
+            nbytes,
+            bench(
+                lambda: jax.device_put(host, dev), 1, 5,
+                label="xfer.host_to_accel", sink=di.stats,
+            ),
         )  # host -> device
         resident = jax.device_put(host, dev)
-        di.gpu.memory.write_bw = nbytes / bench(
-            lambda: np.asarray(resident), 1, 5,
-            label="xfer.accel_to_host", sink=di.stats,
+        di.gpu.memory.write_bw = _rate(
+            nbytes,
+            bench(
+                lambda: np.asarray(resident), 1, 5,
+                label="xfer.accel_to_host", sink=di.stats,
+            ),
         )  # device -> host
-        di.gpu.memory.read_write_bw = 2.0 / (
-            1.0 / di.gpu.memory.read_bw + 1.0 / di.gpu.memory.write_bw
-        )
+        if di.gpu.memory.read_bw > 0 and di.gpu.memory.write_bw > 0:
+            di.gpu.memory.read_write_bw = 2.0 / (
+                1.0 / di.gpu.memory.read_bw + 1.0 / di.gpu.memory.write_bw
+            )
         del host, resident
         gc.collect()
     except Exception:
@@ -759,7 +790,13 @@ def profile_device(
 
     batch_keys = [f"b_{2**n}" for n in range(max_batch_exp)]
     ret.scpu = _quant_table(di.cpu.benchmarks, batch_keys)
-    ret.T_cpu = di.memory.cpu_read_warm_bw
+    # T_cpu divides the solver's memory terms (coeffs.py: bprime / T_cpu) and
+    # must stay positive; a sub-noise warm-read measurement is now 0.0 (see
+    # _rate), so fall back to the cold-read probe, then to a deliberately
+    # pessimistic 1 GB/s floor rather than persist a divide-by-zero.
+    ret.T_cpu = (
+        di.memory.cpu_read_warm_bw or di.memory.cpu_read_cold_bw or 1e9
+    )
 
     if di.gpu.name:
         sgpu = _quant_table(di.gpu.benchmarks, batch_keys)

@@ -207,11 +207,9 @@ class PendingHalda:
     and its result rides the (slow, on tunneled TPUs) link back.
     """
 
-    def __init__(self, pending, Ks, sets, mip_gap):
+    def __init__(self, pending, sets):
         self._pending = pending
-        self._Ks = Ks
         self._sets = sets
-        self._mip_gap = mip_gap
 
     def collect(self) -> HALDAResult:
         from .backend_jax import collect_sweep
@@ -289,4 +287,4 @@ def halda_solve_async(
         # (no k admits W >= M). NB PendingSweep is itself a NamedTuple,
         # so this must be a type check, not an isinstance(..., tuple).
         raise RuntimeError("No feasible MILP found for any k.")
-    return PendingHalda(pending, Ks, sets, mip_gap)
+    return PendingHalda(pending, sets)

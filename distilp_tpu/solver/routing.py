@@ -209,9 +209,10 @@ def solve_load_aware(
     this is exactly one ``halda_solve`` plus a trivial mapping.
 
     ``realized`` is ``None`` on installs without the JAX backend (the exact
-    pricer lives there); iterates are then compared on the expert-busy
-    makespan instead — a different metric in different units, which is why
-    it is NOT returned in the realized slot.
+    pricer lives there) and for solves that explicitly request a non-JAX
+    ``backend=``; iterates are then compared on the expert-busy makespan
+    instead — a different metric in different units, which is why it is NOT
+    returned in the realized slot.
     """
     from ..common import kv_bits_to_factor
     from .api import halda_solve
@@ -258,18 +259,28 @@ def solve_load_aware(
                 devs, model, moe=True, load_factors=factors, **solve_kwargs
             )
         mapping = map_experts(result.y, g_base, loads)
-        try:
-            realized = realized_objective(
-                devs, model, result, mapping, kv_bits=kv_bits,
-                coeffs=dense_coeffs,
-            )
-            metric = realized
-        except ImportError:
-            # No JAX in this environment (pure-CPU backend install): select
-            # on the expert-makespan slice, the routing-sensitive part, and
-            # report no realized objective rather than a lookalike number.
+        if solve_kwargs.get("backend", "cpu") != "jax":  # halda_solve default
+            # The exact end-to-end pricer lives in the JAX backend. When the
+            # caller explicitly requested a non-JAX backend, honor it — on a
+            # machine whose JAX targets a wedged remote TPU, an unsolicited
+            # jax touch here could hang an otherwise-CPU solve. Select on
+            # the expert-makespan slice instead.
             realized = None
             metric = expert_makespan(g_base, mapping)
+        else:
+            try:
+                realized = realized_objective(
+                    devs, model, result, mapping, kv_bits=kv_bits,
+                    coeffs=dense_coeffs,
+                )
+                metric = realized
+            except ImportError:
+                # No JAX in this environment (pure-CPU backend install):
+                # select on the expert-makespan slice, the routing-sensitive
+                # part, and report no realized objective rather than a
+                # lookalike number.
+                realized = None
+                metric = expert_makespan(g_base, mapping)
         if best is None or metric < best[3]:
             best = (result, mapping, realized, metric)
         if uniform:

@@ -186,14 +186,16 @@ class StreamingReplanner:
             warm=warm,
             load_factors=factors,
         )
-        # Snapshot the fleet: streaming callers mutate profiles in place
-        # between ticks, and collect()'s fallback re-solve plus the MoE
-        # mapping must price THIS tick's state, not whatever the profiles
-        # have drifted to by redeem time.
+        # Snapshot the fleet AND the model: streaming callers mutate both in
+        # place between ticks (t_comm drifts, expert_loads refresh), and
+        # collect()'s fallback re-solve plus the MoE mapping must price THIS
+        # tick's state, not whatever the profiles have drifted to by redeem
+        # time.
         devs_snap = [d.model_copy(deep=True) for d in devs]
+        model_snap = model.model_copy(deep=True)
         self._in_flight.append(
-            (pending, shape, devs_snap, model, loads, k_candidates, factors,
-             warm)
+            (pending, shape, devs_snap, model_snap, loads, k_candidates,
+             factors, warm)
         )
         return pending
 

@@ -19,26 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
-
-from jax._src import xla_bridge  # noqa: E402
-
-if not xla_bridge.backends_are_initialized():
-    # NOT redundant with the env var above: the sitecustomize imported jax
-    # before this file ran, so jax.config already latched JAX_PLATFORMS=axon.
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        xla_bridge._backend_factories.pop("axon", None)
-    except AttributeError:
-        import warnings
-
-        warnings.warn(
-            "jax.xla_bridge._backend_factories is gone; the axon PJRT plugin "
-            "cannot be unregistered and tests may hang if the TPU tunnel is down"
-        )
-
 REPO_ROOT = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO_ROOT))
+
+from distilp_tpu.axon_guard import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()
 
 PROFILES = REPO_ROOT / "tests" / "profiles"
 
