@@ -55,6 +55,21 @@ def force_cpu_platform() -> bool:
     return True
 
 
+def force_cpu_if_env_requested() -> bool:
+    """Apply :func:`force_cpu_platform` when ``JAX_PLATFORMS=cpu`` is set.
+
+    CLI entry points call this before their first backend-touching import:
+    honoring the env var is what users expect, and on hosts with a tunneled
+    TPU plugin the env var ALONE does not stop the plugin factory from
+    wedging a dead tunnel at init. Returns True if the guard ran.
+    """
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return force_cpu_platform()
+    return False
+
+
 @contextlib.contextmanager
 def backend_init_watchdog(
     timeout_s: float, on_timeout: Callable[[], None]

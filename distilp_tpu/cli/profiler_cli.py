@@ -57,6 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    from ..axon_guard import force_cpu_if_env_requested
+
+    force_cpu_if_env_requested()
+
     if args.kind == "model" and args.raw_out:
         print(
             "error: --raw-out applies to device profiling only "

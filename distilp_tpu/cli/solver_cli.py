@@ -92,6 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    from ..axon_guard import force_cpu_if_env_requested
+
+    force_cpu_if_env_requested()
+
     from ..common import load_from_profile_folder
     from ..solver import halda_solve
 

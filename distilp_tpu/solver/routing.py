@@ -209,10 +209,12 @@ def solve_load_aware(
     this is exactly one ``halda_solve`` plus a trivial mapping.
 
     ``realized`` is ``None`` on installs without the JAX backend (the exact
-    pricer lives there) and for solves that explicitly request a non-JAX
-    ``backend=``; iterates are then compared on the expert-busy makespan
+    pricer lives there) and whenever the solves run on a non-JAX backend —
+    whether requested via ``backend=`` or by ``halda_solve``'s ``'cpu'``
+    default; iterates are then compared on the expert-busy makespan
     instead — a different metric in different units, which is why it is NOT
-    returned in the realized slot.
+    returned in the realized slot. Pass ``backend='jax'`` for end-to-end
+    selection.
     """
     from ..common import kv_bits_to_factor
     from .api import halda_solve
@@ -260,11 +262,13 @@ def solve_load_aware(
             )
         mapping = map_experts(result.y, g_base, loads)
         if solve_kwargs.get("backend", "cpu") != "jax":  # halda_solve default
-            # The exact end-to-end pricer lives in the JAX backend. When the
-            # caller explicitly requested a non-JAX backend, honor it — on a
-            # machine whose JAX targets a wedged remote TPU, an unsolicited
-            # jax touch here could hang an otherwise-CPU solve. Select on
-            # the expert-makespan slice instead.
+            # The exact end-to-end pricer lives in the JAX backend. The gate
+            # is the EFFECTIVE backend (an absent kwarg defaults halda_solve
+            # to 'cpu'): a caller whose solves run on CPU must not have this
+            # comparator be the one code path that touches JAX — on a
+            # machine whose JAX targets a wedged remote TPU it could hang an
+            # otherwise-CPU solve. Select on the expert-makespan slice
+            # instead; pass backend='jax' to get end-to-end selection.
             realized = None
             metric = expert_makespan(g_base, mapping)
         else:

@@ -145,6 +145,93 @@ def test_timings_breakdown_populated(profiles_dir):
         devs, model, kv_bits="4bit", mip_gap=1e-3, backend="jax", timings=tm
     )
     assert result.certified
-    assert set(tm) == {"pack_ms", "upload_ms", "solve_ms"}
+    assert set(tm) == {"pack_ms", "upload_ms", "solve_ms", "static_hit"}
     assert all(v >= 0 for v in tm.values())
     assert tm["solve_ms"] > 0
+    assert tm["static_hit"] in (0.0, 1.0)
+
+
+def test_static_cache_survives_t_comm_drift(profiles_dir):
+    """The drift-invariant half of the packed instance must stay cached
+    on-device across streaming t_comm drift — that cache hit is what makes
+    warm ticks upload a few KB instead of the whole instance. A changed
+    fleet shape must miss (correctness over reuse)."""
+    import numpy as np
+
+    from distilp_tpu.common import load_model_profile
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.solver.backend_jax import clear_static_cache
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(4, seed=3)
+    clear_static_cache()
+
+    tm = {}
+    cold = halda_solve(
+        devs, model, kv_bits="4bit", mip_gap=1e-3, backend="jax", timings=tm
+    )
+    assert cold.certified
+    assert tm["static_hit"] == 0.0  # first contact uploads
+
+    rng = np.random.default_rng(5)
+    for _ in range(3):
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.9, 1.1)))
+        tm = {}
+        drifted = halda_solve(
+            devs, model, kv_bits="4bit", mip_gap=1e-3, backend="jax",
+            timings=tm, warm=cold,
+        )
+        assert drifted.certified
+        assert tm["static_hit"] == 1.0, "t_comm drift must not evict the static blob"
+
+    # Different fleet shape: the cached blob must NOT be reused.
+    other = make_synthetic_fleet(5, seed=9)
+    tm = {}
+    res = halda_solve(
+        other, model, kv_bits="4bit", mip_gap=1e-3, backend="jax", timings=tm
+    )
+    assert res.certified
+    assert tm["static_hit"] == 0.0
+
+
+def test_static_cache_survives_drift_moe(profiles_dir):
+    """Same drift-invariance on the MoE family: t_comm drift moves g_raw
+    (the all-to-all term) and the busy constants, all of which ship in the
+    dynamic blob — the per-k A family is rebuilt in-trace from the cached
+    base, so the static blob must keep hitting."""
+    import numpy as np
+
+    from distilp_tpu.profiler.api import profile_model
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.solver.backend_jax import clear_static_cache
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = profile_model(
+        str(profiles_dir.parent / "configs" / "mixtral_8x7b.json"),
+        batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    clear_static_cache()
+
+    tm = {}
+    cold = halda_solve(
+        devs, model, kv_bits="8bit", mip_gap=1e-3, backend="jax", timings=tm
+    )
+    assert cold.certified
+    assert tm["static_hit"] == 0.0
+
+    rng = np.random.default_rng(13)
+    for d in devs:
+        d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.9, 1.1)))
+    tm = {}
+    drifted = halda_solve(
+        devs, model, kv_bits="8bit", mip_gap=1e-3, backend="jax",
+        timings=tm, warm=cold,
+    )
+    assert drifted.certified
+    assert tm["static_hit"] == 1.0, "MoE t_comm drift must not evict the static blob"
