@@ -17,7 +17,11 @@ Prints ONE JSON line:
                           solve's execution + result transfer>,
      "moe_warm_tick_ms": <DeepSeek-V3 E=256 32-device streaming MoE
                           re-placement, certified, median ms>,
-     "breakdown": {"pack_ms", "upload_ms", "solve_ms"}}
+     "tiny_put_ms": <median 16-byte device_put: the tunnel's per-operation
+                          wire cost, the wall-clock floor of any
+                          synchronous tick — recorded so captures taken
+                          under different tunnel conditions compare>,
+     "breakdown": {"pack_ms", "upload_ms", "solve_ms", "static_hit"}}
 
 All headline numbers are medians of REPEATS runs (best-of flattered the
 result; the median is what a user sees). The extra keys report the
@@ -189,6 +193,22 @@ def main() -> int:
     first_contact_s = max(60.0, _env_num("DPERF_BENCH_FIRST_CONTACT_TIMEOUT", 900))
     with backend_init_watchdog(first_contact_s, _abort_wedged):
         got = halda_solve(devs, model, mip_gap=MIP_GAP, kv_bits="4bit", backend="jax")
+
+    # Wire-condition diagnostic: the tunnel's per-operation cost varies run
+    # to run and IS the wall-clock floor for a synchronous tick, so record
+    # it next to every capture (a 16-byte put isolates fixed overhead from
+    # bandwidth). Watchdogged like the first contact: a tunnel that drops
+    # mid-bench must still cost only this diagnostic, never the JSON line.
+    import jax.numpy as jnp
+
+    tiny = np.ones(4, np.float32)
+    put_times = []
+    with backend_init_watchdog(first_contact_s, _abort_wedged):
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jnp.asarray(tiny).block_until_ready()
+            put_times.append((time.perf_counter() - t0) * 1e3)
+    tiny_put_ms = statistics.median(put_times)
     agree = (
         abs(got.obj_value - ref.obj_value)
         <= 2 * MIP_GAP * abs(ref.obj_value) + 1e-9
@@ -274,6 +294,7 @@ def main() -> int:
         "warm_tick_ms": round(warm_ms, 3),
         "placements_per_sec": round(1000.0 / warm_ms, 1),
         "pipelined_placements_per_sec": round(pipelined_per_sec, 1),
+        "tiny_put_ms": round(tiny_put_ms, 3),
         "breakdown": breakdown,
     }
     if platform == "cpu(fallback)":

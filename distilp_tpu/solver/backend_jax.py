@@ -1442,6 +1442,10 @@ def _solve_packed(
     hi_k = take_s(n_k * nf).reshape(n_k, nf)
     smin_k = take_s(n_k * m_ub).reshape(n_k, m_ub)
     int_mask = take_s(nf) > 0.5
+    assert off == static_blob.shape[0], (
+        f"_pack_static/_solve_packed layout drift: "
+        f"consumed {off} of {static_blob.shape[0]}"
+    )
 
     offd = 0
 

@@ -215,6 +215,14 @@ def solve_load_aware(
     instead — a different metric in different units, which is why it is NOT
     returned in the realized slot. Pass ``backend='jax'`` for end-to-end
     selection.
+
+    ``iters=2`` is a measured default, not a guess: on the skewed-Mixtral
+    study instance (two hot experts carrying half the load over a 4-device
+    fleet) the single re-pricing of iterate 2 improves the realized
+    objective by ~0.11% and reshapes the expert split, while iterate 3
+    reproduces iterate 2 exactly — the fixed point converges in one
+    re-pricing (pinned by ``tests/test_routing.py::
+    test_fixed_point_iters_study``).
     """
     from ..common import kv_bits_to_factor
     from .api import halda_solve

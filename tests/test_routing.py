@@ -217,3 +217,35 @@ def test_solve_load_aware_rejects_managed_kwargs(mixtral):
     devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
     with pytest.raises(TypeError, match="manages"):
         solve_load_aware(devs, mixtral, expert_loads=None, moe=True)
+
+
+def test_fixed_point_iters_study(mixtral):
+    """Characterize the fixed-point depth: best-of-N selection over the
+    realized end-to-end objective must be monotone non-worsening in N, and
+    the study pins WHERE the improvement lands so the ``iters=2`` default
+    is a measured choice, not a guess (one re-pricing captures the skew;
+    see solve_load_aware's docstring note)."""
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    E = mixtral.n_routed_experts
+    raw = [4.0, 4.0] + [1.0] * (E - 2)  # two hot experts, half the load
+    realized_at = {}
+    for iters in (1, 2, 3):
+        result, mapping, realized = solve_load_aware(
+            devs, mixtral, expert_loads=raw, iters=iters,
+            kv_bits="8bit", mip_gap=GAP, backend="jax",
+        )
+        assert result.certified
+        assert np.isfinite(realized)
+        realized_at[iters] = realized
+    # The iterate sequence is deterministic, so best-of-N can only improve.
+    assert realized_at[2] <= realized_at[1] + 1e-12
+    assert realized_at[3] <= realized_at[2] + 1e-12
+    # The default (iters=2) must capture the bulk of whatever the deeper
+    # fixed point finds: iterate 3 may polish, but not by more than the
+    # solve's own certification tolerance band.
+    tol = 2 * GAP * abs(realized_at[2])
+    assert realized_at[2] - realized_at[3] <= tol, (
+        f"iters=3 improved the realized objective by "
+        f"{realized_at[2] - realized_at[3]:.6g} (> {tol:.3g}); "
+        f"the iters=2 default is leaving real objective on the table"
+    )
