@@ -86,6 +86,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--node-cap", type=int, default=None,
         help="frontier capacity; overflow floors the certificate (jax backend)",
     )
+    p.add_argument(
+        "--batch-size", type=int, default=1,
+        help="price dense compute at the profiles' b_N throughput column "
+        "(default 1 = reference parity; the model profile must carry the "
+        "column: profile with batch_sizes=[N, ...])",
+    )
     return p
 
 
@@ -190,6 +196,7 @@ def main(argv=None) -> int:
                 beam=args.beam,
                 ipm_iters=args.ipm_iters,
                 node_cap=args.node_cap,
+                batch_size=args.batch_size,
             )
         else:
             result = halda_solve(
@@ -208,6 +215,7 @@ def main(argv=None) -> int:
                 beam=args.beam,
                 ipm_iters=args.ipm_iters,
                 node_cap=args.node_cap,
+                batch_size=args.batch_size,
             )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

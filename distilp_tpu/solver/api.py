@@ -31,6 +31,7 @@ def _build_instance(
     kv_bits: str,
     moe: Optional[bool],
     load_factors: Optional[Sequence[float]],
+    batch_size: int = 1,
 ):
     """Shared validation + instance assembly of the sync and async paths:
     (Ks, sets, coeffs, arrays). Any change here reaches both."""
@@ -39,6 +40,14 @@ def _build_instance(
         raise ValueError(
             "moe=True requires a profile with MoE component metrics "
             "(bytes_per_expert, flops_per_active_expert_per_token, ...)"
+        )
+    if use_moe and batch_size != 1:
+        raise ValueError(
+            "batch_size pricing is dense-only: the MoE expert busy model "
+            "prices per-active-expert-per-token compute at batch 1, so a "
+            "batch-N dense half would silently mix batches in one "
+            "objective. Pass moe=False to price a MoE profile's dense "
+            "slice at batch N, or keep batch_size=1."
         )
     if k_candidates:
         Ks = sorted(set(int(k) for k in k_candidates))
@@ -57,12 +66,14 @@ def _build_instance(
         # expert block (y) carries the routed-expert bytes and compute.
         # load_factors re-prices each device's y-units at the realized load
         # of a concrete expert mapping (see solver.routing).
-        coeffs = build_coeffs(devs, adjust_model(model), kv_factor, sets)
+        coeffs = build_coeffs(
+            devs, adjust_model(model), kv_factor, sets, batch_size
+        )
         arrays = assemble(
             coeffs, moe=build_moe_arrays(devs, model, load_factors=load_factors)
         )
     else:
-        coeffs = build_coeffs(devs, model, kv_factor, sets)
+        coeffs = build_coeffs(devs, model, kv_factor, sets, batch_size)
         arrays = assemble(coeffs)
     return Ks, sets, coeffs, arrays
 
@@ -85,8 +96,17 @@ def halda_solve(
     node_cap: Optional[int] = None,
     timings: Optional[dict] = None,
     load_factors: Optional[Sequence[float]] = None,
+    batch_size: int = 1,
 ) -> HALDAResult:
     """Pick the best (k, w, n[, y]) placement over all candidate segment counts.
+
+    ``batch_size`` (opt-in, default 1 = reference parity) prices dense
+    compute at the profiles' ``b_N`` throughput columns — prefill-heavy
+    deployments place against their real batch instead of the decode-style
+    batch-1 lookup. Requires the model profile to carry the column
+    (``profile_model(batch_sizes=[N, ...])``). Dense formulation only: the
+    MoE expert busy model prices per-token at batch 1, so MoE solves reject
+    ``batch_size != 1`` rather than mix batches in one objective.
 
     ``moe=None`` (default) enables expert+layer co-assignment automatically
     when the profile carries MoE component metrics; ``moe=False`` forces the
@@ -117,7 +137,7 @@ def halda_solve(
     ``RuntimeError`` if no candidate k admits a feasible assignment.
     """
     Ks, sets, coeffs, arrays = _build_instance(
-        devs, model, k_candidates, kv_bits, moe, load_factors
+        devs, model, k_candidates, kv_bits, moe, load_factors, batch_size
     )
 
     per_k_objs: List[Tuple[int, Optional[float]]] = []
@@ -243,6 +263,7 @@ def halda_solve_async(
     ipm_iters: Optional[int] = None,
     node_cap: Optional[int] = None,
     load_factors: Optional[Sequence[float]] = None,
+    batch_size: int = 1,
 ) -> PendingHalda:
     """Dispatch a HALDA solve and return without waiting for the result.
 
@@ -261,7 +282,7 @@ def halda_solve_async(
         ) from e
 
     Ks, sets, coeffs, arrays = _build_instance(
-        devs, model, k_candidates, kv_bits, moe, load_factors
+        devs, model, k_candidates, kv_bits, moe, load_factors, batch_size
     )
 
     warm_ilp = None

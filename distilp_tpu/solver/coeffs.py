@@ -92,7 +92,8 @@ def flops_over_flops_per_s(
 
 
 def alpha_beta_xi(
-    dev: DeviceProfile, model: ModelProfile, kv_factor: float = 1.0
+    dev: DeviceProfile, model: ModelProfile, kv_factor: float = 1.0,
+    batch_size: int = 1,
 ) -> tuple[float, float, float]:
     """Per-layer latency coefficients for one device.
 
@@ -100,15 +101,23 @@ def alpha_beta_xi(
     beta  = accelerator minus CPU delta (negative when the GPU is faster); 0
             without an accelerator table.
     xi    = host<->accelerator round-trip, charged only on split-memory devices.
+
+    ``batch_size`` selects the ``b_N`` column of both the model's FLOPs
+    tables and the device's throughput tables (default 1 — reference
+    parity, which hard-wires ``b_1``; SURVEY §8 quirk 10).
     """
     bprime = b_prime(model, kv_bits_k=kv_factor)
-    comp_cpu = flops_over_flops_per_s(model.f_q, dev.scpu, model.Q)
+    comp_cpu = flops_over_flops_per_s(
+        model.f_q, dev.scpu, model.Q, batch_size=batch_size
+    )
     alpha = comp_cpu + dev.t_kvcpy_cpu + bprime / dev.T_cpu
 
     gpu_table = dev.gpu_table()
     gpu_T = dev.gpu_T()
     if gpu_table is not None and gpu_T is not None:
-        comp_gpu = flops_over_flops_per_s(model.f_q, gpu_table, model.Q)
+        comp_gpu = flops_over_flops_per_s(
+            model.f_q, gpu_table, model.Q, batch_size=batch_size
+        )
         beta = (
             (comp_gpu - comp_cpu)
             + (dev.t_kvcpy_gpu - dev.t_kvcpy_cpu)
@@ -210,12 +219,15 @@ def kappa_constant(
     devs: Sequence[DeviceProfile],
     model: ModelProfile,
     sets: Dict[str, List[int]],
+    batch_size: int = 1,
 ) -> float:
     """Constant objective terms: head-device I/O-layer costs + tail RAM deficits."""
     head_idx = next((i for i, d in enumerate(devs) if d.is_head), 0)
     head = devs[head_idx]
 
-    head_compute = flops_over_flops_per_s(model.f_out, head.scpu, model.Q)
+    head_compute = flops_over_flops_per_s(
+        model.f_out, head.scpu, model.Q, batch_size=batch_size
+    )
     head_load_regs = (model.b_in / model.V + model.b_out) / head.T_cpu
     head_disk_in = model.b_in / (model.V * head.s_disk)
     head_disk_out = model.b_out / head.s_disk
@@ -233,9 +245,29 @@ def build_coeffs(
     model: ModelProfile,
     kv_factor: float,
     sets: Optional[Dict[str, List[int]]] = None,
+    batch_size: int = 1,
 ) -> HaldaCoeffs:
-    """Assemble the full coefficient struct for one (devices, model) instance."""
+    """Assemble the full coefficient struct for one (devices, model) instance.
+
+    ``batch_size`` (opt-in, default 1 = reference parity) prices the dense
+    compute at the model's and devices' ``b_N`` throughput columns, for
+    prefill-heavy deployments whose real batch is not 1. The model profile
+    must carry the requested column (profile with ``batch_sizes=[..., N]``).
+    """
     M = len(devs)
+    if batch_size != 1:
+        # Validate BOTH FLOPs tables the batch column is read from: a
+        # missing key silently prices that compute term at 0.0
+        # (flops_over_flops_per_s), which must never happen on an
+        # explicitly requested batch.
+        for fname, fdict in (("f_q", model.f_q), ("f_out", model.f_out)):
+            if f"b_{batch_size}" not in fdict:
+                raise ValueError(
+                    f"batch_size={batch_size} requested but the model "
+                    f"profile's {fname} has no 'b_{batch_size}' FLOPs column "
+                    f"(has: {sorted(fdict)}); re-profile the model with "
+                    f"batch_sizes=[{batch_size}, ...]"
+                )
     if sets is None:
         sets = assign_sets(devs)
     bprime = float(b_prime(model, kv_bits_k=kv_factor))
@@ -260,7 +292,7 @@ def build_coeffs(
             set_of[i] = int(name[1])
 
     for i, d in enumerate(devs):
-        alpha, beta, xi_i = alpha_beta_xi(d, model, kv_factor)
+        alpha, beta, xi_i = alpha_beta_xi(d, model, kv_factor, batch_size)
         sid = set_of.get(i, 3)
         set_id[i] = sid
         # The set partition zeroes the GPU delta for set-1 devices (no Metal on
@@ -326,6 +358,6 @@ def build_coeffs(
         cuda_rhs=cuda_rhs,
         metal_row=metal_row,
         metal_rhs=metal_rhs,
-        kappa=kappa_constant(devs, model, sets),
+        kappa=kappa_constant(devs, model, sets, batch_size),
         sets={k: list(v) for k, v in sets.items()},
     )

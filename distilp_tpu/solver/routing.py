@@ -235,6 +235,14 @@ def solve_load_aware(
                 f"solve_load_aware manages {managed!r} itself; pass it "
                 f"through halda_solve directly if you need manual control"
             )
+    if solve_kwargs.get("batch_size", 1) != 1:
+        # Every solve here is moe=True, where halda_solve rejects batch
+        # pricing (the expert busy model is per-token batch-1); fail with
+        # routing context instead of letting the first solve raise.
+        raise ValueError(
+            "solve_load_aware is MoE-only and batch_size pricing is "
+            "dense-only; the load-aware loop always prices at batch 1"
+        )
 
     loads = normalize_loads(
         expert_loads if expert_loads is not None else model.expert_loads,

@@ -235,3 +235,79 @@ def test_static_cache_survives_drift_moe(profiles_dir):
     )
     assert drifted.certified
     assert tm["static_hit"] == 1.0, "MoE t_comm drift must not evict the static blob"
+
+
+def test_batch_size_pricing_opt_in(profiles_dir):
+    """Opt-in batch pricing: batch_size=N prices dense compute at the b_N
+    columns of both the model FLOPs and device throughput tables. The
+    default stays b_1 (reference parity; golden-objective tests pin it);
+    a requested column the model profile lacks is a clear error, never a
+    silent zero-compute price."""
+    import pytest
+
+    from distilp_tpu.profiler.api import profile_model
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = profile_model(
+        str(profiles_dir.parent / "configs" / "qwen3_14b_8bit.json"),
+        batch_sizes=[1, 2],
+        sequence_length=128,
+    ).to_model_profile()
+    assert "b_2" in model.f_q
+    devs = make_synthetic_fleet(3, seed=21)
+
+    ref1 = halda_solve(devs, model, kv_bits="8bit", mip_gap=1e-3, backend="cpu")
+    ref2 = halda_solve(
+        devs, model, kv_bits="8bit", mip_gap=1e-3, backend="cpu", batch_size=2
+    )
+    got2 = halda_solve(
+        devs, model, kv_bits="8bit", mip_gap=1e-3, backend="jax", batch_size=2
+    )
+    # Backends agree on the SAME batch-2-priced instance.
+    tol = 2e-3 * abs(ref2.obj_value) + 1e-9
+    assert abs(got2.obj_value - ref2.obj_value) <= tol
+    # Batch-2 FLOPs are ~2x batch-1 while throughput grows only ~2%, so the
+    # compute-priced objective must move (strictly larger here).
+    assert ref2.obj_value > ref1.obj_value
+
+    # A column the model was never profiled at is an explicit error.
+    with pytest.raises(ValueError, match="b_4"):
+        halda_solve(devs, model, kv_bits="8bit", backend="cpu", batch_size=4)
+
+    # f_out is validated too (a partial hand-edited profile must not price
+    # the head's output layer at a silent 0.0).
+    partial = model.model_copy(deep=True)
+    partial.f_out = {"b_1": partial.f_out["b_1"]}
+    with pytest.raises(ValueError, match="f_out"):
+        halda_solve(devs, partial, kv_bits="8bit", backend="cpu", batch_size=2)
+
+
+def test_batch_size_rejected_for_moe(profiles_dir):
+    """Batch pricing is dense-only: the MoE expert busy model is per-token
+    batch-1, so a batch-N MoE solve must raise instead of silently mixing
+    batches in one objective — and solve_load_aware (MoE-only) likewise."""
+    import pytest
+
+    from distilp_tpu.profiler.api import profile_model
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.solver.routing import solve_load_aware
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = profile_model(
+        str(profiles_dir.parent / "configs" / "mixtral_8x7b.json"),
+        batch_sizes=[1, 2],
+        sequence_length=128,
+    ).to_model_profile()
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    with pytest.raises(ValueError, match="dense-only"):
+        halda_solve(devs, model, kv_bits="8bit", backend="cpu", batch_size=2)
+    with pytest.raises(ValueError, match="dense-only"):
+        solve_load_aware(
+            devs, model, expert_loads=None, backend="cpu", batch_size=2
+        )
+    # The dense slice of a MoE profile may still be priced at batch N.
+    res = halda_solve(
+        devs, model, kv_bits="8bit", backend="cpu", moe=False, batch_size=2
+    )
+    assert res.obj_value is not None
