@@ -1,6 +1,11 @@
 """HALDA placement solver: CPU oracle + JAX/TPU batched backend."""
 
-from .api import PendingHalda, halda_solve, halda_solve_async
+from .api import (
+    PendingHalda,
+    halda_solve,
+    halda_solve_async,
+    halda_solve_scenarios,
+)
 from .coeffs import (
     HaldaCoeffs,
     alpha_beta_xi,
@@ -26,6 +31,7 @@ from .streaming import StreamingReplanner
 __all__ = [
     "halda_solve",
     "halda_solve_async",
+    "halda_solve_scenarios",
     "PendingHalda",
     "StreamingReplanner",
     "ExpertMapping",

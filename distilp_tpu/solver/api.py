@@ -309,3 +309,108 @@ def halda_solve_async(
         # so this must be a type check, not an isinstance(..., tuple).
         raise RuntimeError("No feasible MILP found for any k.")
     return PendingHalda(pending, sets)
+
+
+def halda_solve_scenarios(
+    scenarios: Sequence[Sequence[DeviceProfile]],
+    model: ModelProfile,
+    k_candidates: Optional[Iterable[int]] = None,
+    mip_gap: Optional[float] = 1e-4,
+    kv_bits: str = "8bit",
+    moe: Optional[bool] = None,
+    warms: Optional[Sequence[Optional[HALDAResult]]] = None,
+    max_rounds: Optional[int] = None,
+    beam: Optional[int] = None,
+    ipm_iters: Optional[int] = None,
+    node_cap: Optional[int] = None,
+    load_factors_list: Optional[Sequence[Optional[Sequence[float]]]] = None,
+    timings: Optional[dict] = None,
+    batch_size: int = 1,
+) -> List[HALDAResult]:
+    """Solve S what-if variants of one fleet in a single device dispatch.
+
+    Each scenario is the SAME fleet under different profile drift — e.g.
+    candidate t_comm futures from a link forecast, or per-device expert
+    load factors for alternative routing regimes. The instances share
+    their device-resident static half, so the whole batch costs one
+    upload + one dispatch + one fetch: on a tunneled TPU this prices S
+    placements at roughly one placement's wire time (JAX backend only).
+
+    Scenarios that drift OUTSIDE the profile class (device speeds,
+    memory capacities, fleet size, model shape) change the static half
+    and raise ValueError — solve those independently.
+
+    ``warms``/``load_factors_list``: optional per-scenario seeds and MoE
+    load factors (one entry each per scenario). Warm hints engage only
+    when every scenario provides one. Raises ``RuntimeError`` if any
+    scenario admits no feasible placement.
+    """
+    try:
+        from .backend_jax import solve_sweep_scenarios
+    except ImportError as e:
+        raise NotImplementedError(
+            "The JAX backend is not available in this build "
+            f"(import failed: {e}); scenario batching needs it."
+        ) from e
+
+    S = len(scenarios)
+    if S == 0:
+        return []
+    if load_factors_list is not None and len(load_factors_list) != S:
+        raise ValueError("load_factors_list must have one entry per scenario")
+    if warms is not None and len(warms) != S:
+        raise ValueError("warms must have one entry per scenario")
+
+    built = [
+        _build_instance(
+            devs, model, k_candidates, kv_bits, moe,
+            load_factors_list[i] if load_factors_list is not None else None,
+            batch_size,
+        )
+        for i, devs in enumerate(scenarios)
+    ]
+    Ks = built[0][0]
+
+    warm_ilps: Optional[List[Optional[ILPResult]]] = None
+    if warms is not None:
+        warm_ilps = [
+            ILPResult(
+                k=w.k, w=w.w, n=w.n, y=w.y, obj_value=w.obj_value,
+                duals=w.duals,
+            )
+            if w is not None
+            else None
+            for w in warms
+        ]
+
+    outs = solve_sweep_scenarios(
+        [arrays for _, _, _, arrays in built],
+        [(k, model.L // k) for k in Ks],
+        [coeffs for _, _, coeffs, _ in built],
+        mip_gap=mip_gap if mip_gap is not None else 1e-4,
+        warms=warm_ilps,
+        max_rounds=max_rounds,
+        beam=beam,
+        ipm_iters=ipm_iters,
+        node_cap=node_cap,
+        timings=timings,
+    )
+
+    results: List[HALDAResult] = []
+    for i, (_, best) in enumerate(outs):
+        if best is None:
+            raise RuntimeError(f"No feasible MILP found for scenario {i}.")
+        results.append(
+            HALDAResult(
+                w=list(best.w),
+                n=list(best.n),
+                k=best.k,
+                obj_value=best.obj_value,
+                sets={name: list(v) for name, v in built[i][1].items()},
+                y=list(best.y) if best.y is not None else None,
+                certified=best.certified,
+                gap=best.gap,
+                duals=best.duals,
+            )
+        )
+    return results

@@ -94,6 +94,26 @@ def default_search_params(moe: bool, n_k: int) -> Tuple[int, int, int]:
     return max(64, 2 * n_k), 8, 14
 
 
+def _resolve_search_params(
+    moe: bool,
+    n_k: int,
+    node_cap: Optional[int],
+    beam: Optional[int],
+    ipm_iters: Optional[int],
+    max_rounds: Optional[int],
+) -> Tuple[int, int, int, int]:
+    """(cap, beam, ipm_iters, max_rounds): caller overrides applied over the
+    problem-class defaults — the one resolution rule for every solve path
+    (single-dispatch, async, scenario-batched)."""
+    d_cap, d_beam, d_iters = default_search_params(moe, n_k)
+    return (
+        max(node_cap, n_k) if node_cap is not None else d_cap,
+        beam if beam is not None else d_beam,
+        ipm_iters if ipm_iters is not None else d_iters,
+        max_rounds if max_rounds is not None else MAX_ROUNDS,
+    )
+
+
 class RoundingData(NamedTuple):
     """Exact per-device MILP data for the integer rounding heuristic.
 
@@ -1384,14 +1404,13 @@ _RD_VEC_FIELDS = (
 )
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
-        "has_warm", "w_max", "e_max", "decomp_steps", "has_duals",
-    ),
+_PACKED_STATIC_ARGS = (
+    "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
+    "has_warm", "w_max", "e_max", "decomp_steps", "has_duals",
 )
-def _solve_packed(
+
+
+def _solve_packed_impl(
     static_blob: jax.Array,
     dyn_blob: jax.Array,
     M: int,
@@ -1610,6 +1629,45 @@ def _solve_packed(
     return jnp.concatenate(parts)
 
 
+# The jitted single-instance entry (one sweep per dispatch) and its
+# scenario-batched sibling: S dynamic blobs against ONE shared static blob,
+# vmapped into a single dispatch. On a tunneled TPU every operation bills a
+# fixed wire cost, so S what-if placements per dispatch multiply
+# placements/sec by ~S — the TPU-idiomatic answer to planning under
+# uncertainty (candidate t_comm futures, load scenarios) that a host MILP
+# loop would serialize.
+_solve_packed = jax.jit(_solve_packed_impl, static_argnames=_PACKED_STATIC_ARGS)
+
+
+@partial(jax.jit, static_argnames=_PACKED_STATIC_ARGS)
+def _solve_scenarios_packed(
+    static_blob: jax.Array,
+    dyn_blobs: jax.Array,  # (S, dyn_len)
+    M: int,
+    n_k: int,
+    m: int,
+    nf: int,
+    cap: int,
+    ipm_iters: int = IPM_ITERS,
+    max_rounds: int = MAX_ROUNDS,
+    beam: Optional[int] = BEAM,
+    moe: bool = False,
+    has_warm: bool = False,
+    w_max: int = 0,
+    e_max: int = 0,
+    decomp_steps: int = 0,
+    has_duals: bool = False,
+) -> jax.Array:
+    return jax.vmap(
+        lambda dyn: _solve_packed_impl(
+            static_blob, dyn, M=M, n_k=n_k, m=m, nf=nf, cap=cap,
+            ipm_iters=ipm_iters, max_rounds=max_rounds, beam=beam, moe=moe,
+            has_warm=has_warm, w_max=w_max, e_max=e_max,
+            decomp_steps=decomp_steps, has_duals=has_duals,
+        )
+    )(dyn_blobs)
+
+
 def _best_bound(state: SearchState) -> jax.Array:
     live = jnp.min(jnp.where(state.active, state.node_bound, jnp.inf))
     return jnp.minimum(live, state.dropped_bound)
@@ -1677,6 +1735,57 @@ def _solve_fused(
     )
 
 
+def _warm_and_duals(
+    sf: StandardForm,
+    arrays: MilpArrays,
+    warm: Optional[ILPResult],
+    feasible: Sequence[Tuple[int, int]],
+):
+    """(warm_tuple, duals_tuple) for one sweep — the host-side preparation
+    of a previous solve's assignment and Lagrangian multipliers, shared by
+    the single-dispatch and scenario-batched paths."""
+    M = sf.M
+    n_k = len(sf.ks)
+    warm_tuple = None
+    if warm is not None and warm.w is not None and len(warm.w) == M:
+        k_index = {k: j for j, (k, _) in enumerate(feasible)}
+        if warm.k in k_index:
+            if sf.moe:
+                E = arrays.moe.E
+                if warm.y is not None and sum(warm.y) == E:
+                    warm_y = warm.y
+                else:
+                    # Hint lacks a usable expert split (dense->MoE tick):
+                    # spread evenly HOST-side — the in-trace repair scan only
+                    # covers deficits up to ~M, far less than E can be.
+                    warm_y = [E // M + (1 if i < E % M else 0) for i in range(M)]
+            else:
+                warm_y = [0] * M
+            warm_tuple = (k_index[warm.k], warm.w, warm.n, warm_y)
+
+    # Stored root multipliers from the previous tick, when their shape still
+    # matches this sweep (same k grid, same fleet size).
+    duals_tuple = None
+    if warm is not None and warm.duals is not None and sf.moe:
+        try:
+            lam = np.asarray(warm.duals["lam"], np.float64)
+            mu = np.asarray(warm.duals["mu"], np.float64)
+            tau = np.asarray(warm.duals["tau"], np.float64)
+        except (KeyError, TypeError, ValueError):
+            lam = mu = tau = None
+        if (
+            lam is not None
+            and lam.shape == (n_k,)
+            and mu.shape == (n_k,)
+            and tau.shape == (n_k, M)
+            and np.all(np.isfinite(lam))
+            and np.all(np.isfinite(mu))
+            and np.all(np.isfinite(tau))
+        ):
+            duals_tuple = (lam, mu, tau)
+    return warm_tuple, duals_tuple
+
+
 def solve_sweep_jax(
     arrays: MilpArrays,
     kWs: Sequence[Tuple[int, int]],
@@ -1733,48 +1842,10 @@ def solve_sweep_jax(
 
     sf = build_standard_form(arrays, coeffs, feasible)
     n_k = len(sf.ks)
-    d_cap, d_beam, d_iters = default_search_params(sf.moe, n_k)
-    cap = max(node_cap, n_k) if node_cap is not None else d_cap
-    beam = beam if beam is not None else d_beam
-    ipm_iters = ipm_iters if ipm_iters is not None else d_iters
-    max_rounds = max_rounds if max_rounds is not None else MAX_ROUNDS
-    warm_tuple = None
-    if warm is not None and warm.w is not None and len(warm.w) == M:
-        k_index = {k: j for j, (k, _) in enumerate(feasible)}
-        if warm.k in k_index:
-            if sf.moe:
-                E = arrays.moe.E
-                if warm.y is not None and sum(warm.y) == E:
-                    warm_y = warm.y
-                else:
-                    # Hint lacks a usable expert split (dense->MoE tick):
-                    # spread evenly HOST-side — the in-trace repair scan only
-                    # covers deficits up to ~M, far less than E can be.
-                    warm_y = [E // M + (1 if i < E % M else 0) for i in range(M)]
-            else:
-                warm_y = [0] * M
-            warm_tuple = (k_index[warm.k], warm.w, warm.n, warm_y)
-
-    # Stored root multipliers from the previous tick, when their shape still
-    # matches this sweep (same k grid, same fleet size).
-    duals_tuple = None
-    if warm is not None and warm.duals is not None and sf.moe:
-        try:
-            lam = np.asarray(warm.duals["lam"], np.float64)
-            mu = np.asarray(warm.duals["mu"], np.float64)
-            tau = np.asarray(warm.duals["tau"], np.float64)
-        except (KeyError, TypeError, ValueError):
-            lam = mu = tau = None
-        if (
-            lam is not None
-            and lam.shape == (n_k,)
-            and mu.shape == (n_k,)
-            and tau.shape == (n_k, M)
-            and np.all(np.isfinite(lam))
-            and np.all(np.isfinite(mu))
-            and np.all(np.isfinite(tau))
-        ):
-            duals_tuple = (lam, mu, tau)
+    cap, beam, ipm_iters, max_rounds = _resolve_search_params(
+        sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds
+    )
+    warm_tuple, duals_tuple = _warm_and_duals(sf, arrays, warm, feasible)
 
     # Root decomposition bounds are what certify wide-expert MoE instances
     # (the LP root gap there is structural); dense sweeps certify from the
@@ -1900,15 +1971,30 @@ def collect_sweep(
     """Fetch + decode an in-flight sweep (the blocking half of the async
     split). Same output contract as ``solve_sweep_jax``."""
     out = np.asarray(jax.device_get(pending.out))
-    results = pending.results
-    feasible = pending.feasible
-    kWs = pending.kWs
-    M, n_k = pending.M, pending.n_k
-    mip_gap = pending.mip_gap
+    return _decode_sweep_out(
+        out, pending.results, pending.feasible, pending.kWs, pending.M,
+        pending.n_k, pending.moe, pending.w_max, pending.mip_gap,
+        pending.debug,
+    )
 
+
+def _decode_sweep_out(
+    out: np.ndarray,
+    results: List[Optional[ILPResult]],
+    feasible: List[Tuple[int, int]],
+    kWs: List[Tuple[int, int]],
+    M: int,
+    n_k: int,
+    moe: bool,
+    w_max: int,
+    mip_gap: float,
+    debug: bool,
+) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
+    """Decode one fetched ``_solve_packed`` output vector (shared by the
+    single-dispatch, async, and scenario-batched paths)."""
     incumbent = float(out[0])
     best_bound = float(out[1])
-    if pending.debug:
+    if debug:
         print(f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f}")
     if not np.isfinite(incumbent):
         return results, None
@@ -1943,7 +2029,7 @@ def collect_sweep(
     # Root multipliers chosen by this solve (MoE only): persist on the
     # winning result so the next streaming tick warm-starts the ascent.
     out_duals = None
-    if pending.moe and pending.w_max > 0:
+    if moe and w_max > 0:
         d0 = 4 + 3 * M + n_k
         lam_out = out[d0 : d0 + n_k]
         mu_out = out[d0 + n_k : d0 + 2 * n_k]
@@ -1961,7 +2047,7 @@ def collect_sweep(
         if not np.isfinite(obj_j):
             continue
         if j == inc_k_idx:
-            y = inc_y if pending.moe else None
+            y = inc_y if moe else None
             best = ILPResult(
                 k=k, w=inc_w, n=inc_n, y=y, obj_value=obj_j,
                 certified=certified, gap=achieved_gap, duals=out_duals,
@@ -1975,3 +2061,152 @@ def collect_sweep(
                 k=k, obj_value=obj_j, certified=False
             )
     return results, best
+
+
+def solve_sweep_scenarios(
+    arrays_list: Sequence[MilpArrays],
+    kWs: Sequence[Tuple[int, int]],
+    coeffs_list: Sequence[HaldaCoeffs],
+    mip_gap: float = 1e-4,
+    warms: Optional[Sequence[Optional[ILPResult]]] = None,
+    ipm_iters: Optional[int] = None,
+    max_rounds: Optional[int] = None,
+    beam: Optional[int] = None,
+    node_cap: Optional[int] = None,
+    timings: Optional[dict] = None,
+) -> List[Tuple[List[Optional[ILPResult]], Optional[ILPResult]]]:
+    """Solve S what-if scenarios of ONE fleet in a single device dispatch.
+
+    Scenarios are profile-drift variants of the same instance — candidate
+    t_comm futures, load redistributions, busy-constant shifts — exactly
+    the variation class whose packed STATIC half (base A, structural
+    objective, boxes, slack minima) is byte-identical. The S dynamic blobs
+    stack into one upload, ``_solve_scenarios_packed`` vmaps the fused
+    B&B program over them, and one fetch returns every placement: on a
+    tunneled TPU, where each operation bills a fixed wire cost, this prices
+    S placements at ~one placement's wire time (a host MILP loop would
+    serialize S full solves).
+
+    Scenarios whose static half DIFFERS (device speed/memory/topology
+    changes — anything touching A or the boxes) raise ValueError: solve
+    those as separate ``solve_sweep_jax`` calls.
+
+    ``warms`` (optional, one entry per scenario) seeds each scenario's
+    incumbent independently; warm hints and MoE duals engage only when
+    EVERY scenario carries a usable one (the static jit layout is shared),
+    else all run cold.
+
+    Returns one ``(per_k_results, best)`` pair per scenario, same contract
+    as ``solve_sweep_jax``.
+    """
+    S = len(arrays_list)
+    if S == 0:
+        return []
+    if len(coeffs_list) != S or (warms is not None and len(warms) != S):
+        raise ValueError("arrays_list/coeffs_list/warms lengths must match")
+    M = arrays_list[0].layout.M
+
+    feasible = [(k, W) for (k, W) in kWs if W >= M]
+    if not feasible:
+        return [([None] * len(kWs), None) for _ in range(S)]
+
+    sfs = [
+        build_standard_form(a, c, feasible)
+        for a, c in zip(arrays_list, coeffs_list)
+    ]
+    static0 = _pack_static(sfs[0])
+    for i, sf_i in enumerate(sfs[1:], start=1):
+        if not np.array_equal(_pack_static(sf_i), static0):
+            raise ValueError(
+                f"scenario {i} differs from scenario 0 outside the "
+                f"profile-drift class (its static half changed: device "
+                f"speeds, memory capacities, or fleet/model shape); "
+                f"solve it as a separate sweep"
+            )
+
+    sf = sfs[0]
+    n_k = len(sf.ks)
+    cap, beam, ipm_iters, max_rounds = _resolve_search_params(
+        sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds
+    )
+
+    pairs = [
+        _warm_and_duals(
+            sf_i, a_i, warms[i] if warms is not None else None, feasible
+        )
+        for i, (sf_i, a_i) in enumerate(zip(sfs, arrays_list))
+    ]
+    # The jit layout (has_warm/has_duals statics) is shared across the vmap
+    # axis: engage each slot only when every scenario can fill it.
+    use_warm = all(w is not None for w, _ in pairs)
+    use_duals = all(d is not None for _, d in pairs)
+    if sf.moe:
+        w_max = max(W for _, W in feasible)
+        e_max = int(arrays_list[0].moe.E)
+        decomp_steps = DECOMP_STEPS_WARM if use_duals else DECOMP_STEPS_COLD
+    else:
+        w_max = e_max = decomp_steps = 0
+
+    import time as _time
+
+    t0 = _time.perf_counter()
+    dyn_stack = np.stack(
+        [
+            _pack_dynamic(
+                sf_i,
+                _rounding_arrays_np(c_i, a_i.moe),
+                mip_gap,
+                pairs[i][0] if use_warm else None,
+                duals=pairs[i][1] if use_duals else None,
+            )
+            for i, (sf_i, a_i, c_i) in enumerate(
+                zip(sfs, arrays_list, coeffs_list)
+            )
+        ]
+    )
+    t1 = _time.perf_counter()
+    static_dev, static_uploaded = _static_to_device(static0)
+    dyn = jnp.asarray(dyn_stack)
+    if timings is not None:
+        if static_uploaded:
+            static_dev.block_until_ready()
+        dyn.block_until_ready()
+    t2 = _time.perf_counter()
+    out_dev = _solve_scenarios_packed(
+        static_dev,
+        dyn,
+        M=M,
+        n_k=n_k,
+        m=sf.A.shape[1],
+        nf=sf.A.shape[2],
+        cap=cap,
+        ipm_iters=ipm_iters,
+        max_rounds=max_rounds,
+        beam=beam,
+        moe=sf.moe,
+        has_warm=use_warm,
+        w_max=w_max,
+        e_max=e_max,
+        decomp_steps=decomp_steps,
+        has_duals=use_duals,
+    )
+    out_np = np.asarray(jax.device_get(out_dev))
+    t3 = _time.perf_counter()
+    if timings is not None:
+        timings.update(
+            {
+                "pack_ms": (t1 - t0) * 1e3,
+                "upload_ms": (t2 - t1) * 1e3,
+                "solve_ms": (t3 - t2) * 1e3,
+                "static_hit": 0.0 if static_uploaded else 1.0,
+                "scenarios": float(S),
+            }
+        )
+
+    return [
+        _decode_sweep_out(
+            out_np[i], [None] * len(kWs), feasible, list(kWs), M, n_k,
+            sf.moe, w_max, mip_gap, False,
+        )
+        for i in range(S)
+    ]

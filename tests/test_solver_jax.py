@@ -311,3 +311,92 @@ def test_batch_size_rejected_for_moe(profiles_dir):
         devs, model, kv_bits="8bit", backend="cpu", moe=False, batch_size=2
     )
     assert res.obj_value is not None
+
+
+def test_scenario_batched_solves_match_individual(profiles_dir):
+    """S what-if drifts of one fleet solved in ONE dispatch must each match
+    their individually solved counterpart within the certification band,
+    and a scenario outside the profile-drift class (a device speed change,
+    which moves the static half) must be rejected."""
+    import numpy as np
+    import pytest
+
+    from distilp_tpu.common import load_model_profile
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.solver.api import halda_solve_scenarios
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    rng = np.random.default_rng(31)
+    gap = 1e-3
+
+    scenarios = []
+    for _ in range(4):
+        devs = make_synthetic_fleet(5, seed=31)  # same fleet...
+        for d in devs:  # ...under scenario-specific t_comm drift
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.5, 2.0)))
+        scenarios.append(devs)
+
+    tm = {}
+    batched = halda_solve_scenarios(
+        scenarios, model, kv_bits="4bit", mip_gap=gap, timings=tm
+    )
+    assert len(batched) == 4
+    assert tm["scenarios"] == 4.0
+    for devs, res in zip(scenarios, batched):
+        assert res.certified
+        solo = halda_solve(
+            devs, model, kv_bits="4bit", mip_gap=gap, backend="jax"
+        )
+        tol = 2 * gap * abs(solo.obj_value) + 1e-9
+        assert abs(res.obj_value - solo.obj_value) <= tol
+        assert sum(res.w) * res.k == model.L
+
+    # Drift outside the profile class: scale a device's CPU table (changes
+    # alpha -> the A matrix -> the static half).
+    bad = [d.model_copy(deep=True) for d in scenarios[0]]
+    for q in bad[0].scpu:
+        bad[0].scpu[q] = {col: v * 2.0 for col, v in bad[0].scpu[q].items()}
+    with pytest.raises(ValueError, match="static half"):
+        halda_solve_scenarios(
+            [scenarios[0], bad], model, kv_bits="4bit", mip_gap=gap
+        )
+
+
+def test_scenario_batched_moe_load_factors(profiles_dir):
+    """MoE scenario batching: alternative expert-load regimes of one fleet
+    (load_factors_list) ride the dynamic blob, so they batch into one
+    dispatch too — each certified and matching its individual solve."""
+    from distilp_tpu.profiler.api import profile_model
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.solver.api import halda_solve_scenarios
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = profile_model(
+        str(profiles_dir.parent / "configs" / "mixtral_8x7b.json"),
+        batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+    gap = 1e-3
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    regimes = [
+        None,  # uniform
+        [1.5, 0.8, 1.0, 0.9],  # hot device 0
+        [0.7, 0.7, 1.6, 1.2],  # load shifted to the slow half
+    ]
+    batched = halda_solve_scenarios(
+        [devs, devs, devs], model, kv_bits="8bit", mip_gap=gap,
+        load_factors_list=regimes,
+    )
+    assert len(batched) == 3
+    for factors, res in zip(regimes, batched):
+        assert res.certified
+        assert sum(res.y) == model.n_routed_experts
+        solo = halda_solve(
+            devs, model, kv_bits="8bit", mip_gap=gap, backend="jax",
+            load_factors=factors,
+        )
+        tol = 2 * gap * abs(solo.obj_value) + 1e-9
+        assert abs(res.obj_value - solo.obj_value) <= tol
