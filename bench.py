@@ -298,18 +298,27 @@ def main() -> int:
         for d in snap:
             d.t_comm = max(0.0, d.t_comm * float(rng_s.uniform(0.5, 2.0)))
         scenario_fleets.append(snap)
-    halda_solve_scenarios(  # compile the batched layout
-        scenario_fleets, model, kv_bits="4bit", mip_gap=MIP_GAP
-    )
-    sc_times = []
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        sc_results = halda_solve_scenarios(
+    # A failure here (e.g. a drift excursion crossing a row-scale boundary,
+    # which makes the batch refuse to share one dispatch) must cost only
+    # this metric, never the headline JSON line.
+    sc_ms = None
+    sc_uncertified = 0
+    sc_error = None
+    try:
+        halda_solve_scenarios(  # compile the batched layout
             scenario_fleets, model, kv_bits="4bit", mip_gap=MIP_GAP
         )
-        sc_times.append((time.perf_counter() - t0) * 1e3)
-    sc_ms = statistics.median(sc_times)
-    sc_uncertified = sum(1 for r in sc_results if not r.certified)
+        sc_times = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            sc_results = halda_solve_scenarios(
+                scenario_fleets, model, kv_bits="4bit", mip_gap=MIP_GAP
+            )
+            sc_times.append((time.perf_counter() - t0) * 1e3)
+        sc_ms = statistics.median(sc_times)
+        sc_uncertified = sum(1 for r in sc_results if not r.certified)
+    except Exception as e:  # pragma: no cover - defensive bench path
+        sc_error = f"{type(e).__name__}: {e}"
 
     # MoE real-time re-placement (BASELINE.json config 5): DeepSeek-V3,
     # E=256 routed experts co-assigned over a 32-device fleet. Warm ticks
@@ -324,12 +333,16 @@ def main() -> int:
         "warm_tick_ms": round(warm_ms, 3),
         "placements_per_sec": round(1000.0 / warm_ms, 1),
         "pipelined_placements_per_sec": round(pipelined_per_sec, 1),
-        "scenario_batch_placements_per_sec": round(S * 1000.0 / sc_ms, 1),
+        "scenario_batch_placements_per_sec": (
+            round(S * 1000.0 / sc_ms, 1) if sc_ms else None
+        ),
         "tiny_put_ms": round(tiny_put_ms, 3),
         "breakdown": breakdown,
     }
     if sc_uncertified:
         payload["scenario_uncertified"] = sc_uncertified
+    if sc_error:
+        payload["scenario_error"] = sc_error
     if platform == "cpu(fallback)":
         payload["tpu_error"] = tpu_error or "tpu backend unavailable"
     if pipe_uncertified:

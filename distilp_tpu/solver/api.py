@@ -24,6 +24,33 @@ from .result import HALDAResult, ILPResult
 Backend = str  # 'cpu' | 'jax'
 
 
+def _warm_to_ilp(warm: Optional[HALDAResult]) -> Optional[ILPResult]:
+    """A previous solve's result as the backend's warm-hint type — the ONE
+    conversion every JAX solve path (sync, async, scenario) uses."""
+    if warm is None:
+        return None
+    return ILPResult(
+        k=warm.k, w=warm.w, n=warm.n, y=warm.y,
+        obj_value=warm.obj_value, duals=warm.duals,
+    )
+
+
+def _best_to_result(best: ILPResult, sets) -> HALDAResult:
+    """Wrap a backend optimum into the public result type (shared by every
+    solve path, so a new result field threads through exactly once)."""
+    return HALDAResult(
+        w=list(best.w),
+        n=list(best.n),
+        k=best.k,
+        obj_value=best.obj_value,
+        sets={name: list(v) for name, v in sets.items()},
+        y=list(best.y) if best.y is not None else None,
+        certified=best.certified,
+        gap=best.gap,
+        duals=best.duals,
+    )
+
+
 def _build_instance(
     devs: Sequence[DeviceProfile],
     model: ModelProfile,
@@ -152,19 +179,13 @@ def halda_solve(
                 f"(import failed: {e}); use backend='cpu'."
             ) from e
 
-        warm_ilp = None
-        if warm is not None:
-            warm_ilp = ILPResult(
-                k=warm.k, w=warm.w, n=warm.n, y=warm.y,
-                obj_value=warm.obj_value, duals=warm.duals,
-            )
         results, best = solve_sweep_jax(
             arrays,
             [(k, model.L // k) for k in Ks],
             mip_gap=mip_gap if mip_gap is not None else 1e-4,
             coeffs=coeffs,
             debug=debug,
-            warm=warm_ilp,
+            warm=_warm_to_ilp(warm),
             max_rounds=max_rounds,
             beam=beam,
             ipm_iters=ipm_iters,
@@ -198,17 +219,7 @@ def halda_solve(
     if best is None:
         raise RuntimeError("No feasible MILP found for any k.")
 
-    result = HALDAResult(
-        w=list(best.w),
-        n=list(best.n),
-        k=best.k,
-        obj_value=best.obj_value,
-        sets={name: list(v) for name, v in sets.items()},
-        y=list(best.y) if best.y is not None else None,
-        certified=best.certified,
-        gap=best.gap,
-        duals=best.duals,
-    )
+    result = _best_to_result(best, sets)
 
     if plot:
         from .plotter import plot_k_curve
@@ -237,17 +248,7 @@ class PendingHalda:
         _, best = collect_sweep(self._pending)
         if best is None:
             raise RuntimeError("No feasible MILP found for any k.")
-        return HALDAResult(
-            w=list(best.w),
-            n=list(best.n),
-            k=best.k,
-            obj_value=best.obj_value,
-            sets={name: list(v) for name, v in self._sets.items()},
-            y=list(best.y) if best.y is not None else None,
-            certified=best.certified,
-            gap=best.gap,
-            duals=best.duals,
-        )
+        return _best_to_result(best, self._sets)
 
 
 def halda_solve_async(
@@ -285,18 +286,12 @@ def halda_solve_async(
         devs, model, k_candidates, kv_bits, moe, load_factors, batch_size
     )
 
-    warm_ilp = None
-    if warm is not None:
-        warm_ilp = ILPResult(
-            k=warm.k, w=warm.w, n=warm.n, y=warm.y,
-            obj_value=warm.obj_value, duals=warm.duals,
-        )
     pending = solve_sweep_jax(
         arrays,
         [(k, model.L // k) for k in Ks],
         mip_gap=mip_gap if mip_gap is not None else 1e-4,
         coeffs=coeffs,
-        warm=warm_ilp,
+        warm=_warm_to_ilp(warm),
         max_rounds=max_rounds,
         beam=beam,
         ipm_iters=ipm_iters,
@@ -373,15 +368,7 @@ def halda_solve_scenarios(
 
     warm_ilps: Optional[List[Optional[ILPResult]]] = None
     if warms is not None:
-        warm_ilps = [
-            ILPResult(
-                k=w.k, w=w.w, n=w.n, y=w.y, obj_value=w.obj_value,
-                duals=w.duals,
-            )
-            if w is not None
-            else None
-            for w in warms
-        ]
+        warm_ilps = [_warm_to_ilp(w) for w in warms]
 
     outs = solve_sweep_scenarios(
         [arrays for _, _, _, arrays in built],
@@ -400,17 +387,5 @@ def halda_solve_scenarios(
     for i, (_, best) in enumerate(outs):
         if best is None:
             raise RuntimeError(f"No feasible MILP found for scenario {i}.")
-        results.append(
-            HALDAResult(
-                w=list(best.w),
-                n=list(best.n),
-                k=best.k,
-                obj_value=best.obj_value,
-                sets={name: list(v) for name, v in built[i][1].items()},
-                y=list(best.y) if best.y is not None else None,
-                certified=best.certified,
-                gap=best.gap,
-                duals=best.duals,
-            )
-        )
+        results.append(_best_to_result(best, built[i][1]))
     return results

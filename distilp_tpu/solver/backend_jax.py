@@ -2118,10 +2118,13 @@ def solve_sweep_scenarios(
     for i, sf_i in enumerate(sfs[1:], start=1):
         if not np.array_equal(_pack_static(sf_i), static0):
             raise ValueError(
-                f"scenario {i} differs from scenario 0 outside the "
-                f"profile-drift class (its static half changed: device "
-                f"speeds, memory capacities, or fleet/model shape); "
-                f"solve it as a separate sweep"
+                f"scenario {i}'s packed static half differs from scenario "
+                f"0's, so they cannot share one batched dispatch. Causes: "
+                f"device speed/memory/fleet/model changes (out-of-class "
+                f"drift), or a t_comm/load excursion large enough to move "
+                f"a row's scaling (rare: the drifting RHS entries are "
+                f"normally well under the row's |C|=1 coefficient). Solve "
+                f"the scenarios as separate sweeps instead"
             )
 
     sf = sfs[0]

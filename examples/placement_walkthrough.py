@@ -20,6 +20,11 @@ sys.path.insert(0, str(REPO))
 def main() -> int:
     import numpy as np
 
+    from distilp_tpu.axon_guard import force_cpu_if_env_requested
+
+    force_cpu_if_env_requested()  # JAX_PLATFORMS=cpu must not wedge on a
+    #                               dead tunneled-TPU plugin (see axon_guard)
+
     from distilp_tpu.profiler.api import profile_model
     from distilp_tpu.solver import (
         StreamingReplanner,
@@ -104,6 +109,28 @@ def main() -> int:
     print(f"[5] load-aware: y={routed.y} realized objective={realized:.4f}")
     for d, ids, share in zip(devs, mapping.expert_of_device, mapping.load_share):
         print(f"    {d.name:28s} experts={ids} ({share * 100:4.1f}% of load)")
+
+    # ------------------------------------------------------------------
+    # 6. Scenario batching: what-if t_comm futures of the SAME fleet solve
+    #    in ONE device dispatch (shared static half, vmapped search) —
+    #    S placements for ~one placement's wire time on a tunneled chip.
+    # ------------------------------------------------------------------
+    from distilp_tpu.solver import halda_solve_scenarios
+
+    futures = []
+    for scale in (1.0, 2.0, 0.5):  # now / link degrades / link improves
+        snap = [d.model_copy(deep=True) for d in devs]
+        for d in snap:
+            d.t_comm = max(0.0, d.t_comm * scale)
+        futures.append(snap)
+    what_ifs = halda_solve_scenarios(
+        futures, model, kv_bits="8bit", mip_gap=1e-3
+    )
+    for label, r in zip(("now", "2x t_comm", "0.5x t_comm"), what_ifs):
+        print(
+            f"[6] scenario {label:>10s}: k={r.k} obj={r.obj_value:.4f} "
+            f"certified={r.certified}"
+        )
     return 0
 
 
