@@ -46,6 +46,10 @@ class MoESpec:
     routed_layout: MoERoutedLayout = "switch_glu"
     moe_intermediate_key: Optional[str] = "moe_intermediate_size"
     shared_experts_key: Optional[str] = None  # deepseek: "n_shared_experts"
+    # Families whose shared expert is structural rather than configured:
+    # qwen2_moe always has exactly one (the HF config publishes only its
+    # size, shared_expert_intermediate_size, never a count).
+    implicit_shared: int = 0
     layer_freq_key: Optional[str] = None  # qwen3_moe: decoder_sparse_step
     mlp_only_layers_key: Optional[str] = None
     first_k_dense_key: Optional[str] = None
@@ -88,7 +92,7 @@ ARCHS: Dict[str, ArchSpec] = {
         "qwen2_moe",
         False,
         False,
-        moe=MoESpec(experts_key="num_experts"),
+        moe=MoESpec(experts_key="num_experts", implicit_shared=1),
     ),
     "qwen3": ArchSpec("qwen3", True, True),
     "qwen3_moe": ArchSpec(
@@ -264,7 +268,7 @@ class HFConfig:
         moe = self.spec.moe
         if moe is not None and moe.shared_experts_key is not None:
             return int(self._get(moe.shared_experts_key, 0))
-        return 0
+        return moe.implicit_shared if moe is not None else 0
 
     def first_k_dense_replace(self) -> int:
         moe = self.spec.moe

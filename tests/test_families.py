@@ -1,0 +1,83 @@
+"""Analytic-walker coverage for every registered architecture family.
+
+The registry advertises 12 families (profiler/hfconfig.py ARCHS); the
+model-specific suites cover llama/mistral/qwen3/qwen3_moe/gpt_oss/
+deepseek_v3 via the reference's golden values. This file closes the other
+six — gemma2, phi3 (fused gate_up), glm4 (fused + configured head_dim),
+olmo3, qwen2, qwen2_moe (implicit shared expert) — with self-golden pins
+generated from published architecture configs and sanity-checked against
+parameter-count arithmetic (bytes/layer x L ~ params x bytes/weight).
+A regression that moves any per-layer byte or FLOP count fails exactly.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from distilp_tpu.profiler.api import profile_model
+
+# (config, L, b[1] bytes, f_q[b_1] decode FLOPs, quant, routed experts)
+FAMILY_GOLDEN = [
+    ("gemma2_9b", 42, 385351680.0, 387186688.0, "BF16", 0),
+    ("phi3_mini", 32, 226492416.0, 228065280.0, "BF16", 0),
+    ("glm4_9b", 40, 207134720.0, 409993216.0, "Q8_0", 0),
+    ("olmo3_7b", 32, 404750336.0, 406847488.0, "BF16", 0),
+    ("qwen2_7b_8bit", 28, 236687360.0, 467927040.0, "Q8_0", 0),
+    ("qwen15_moe_a27b", 24, 1140850688.0, 173260800.0, "BF16", 60),
+]
+
+
+@pytest.mark.parametrize("cfg,L,b1,fq1,quant,E", FAMILY_GOLDEN)
+def test_family_profiles_pinned(cfg, L, b1, fq1, quant, E):
+    split = profile_model(
+        f"tests/configs/{cfg}.json", batch_sizes=[1], sequence_length=128
+    )
+    model = split.to_model_profile()
+    assert model.L == L
+    assert split.b[1] == b1
+    assert model.f_q["b_1"] == fq1
+    assert str(model.Q) == quant
+    assert model.n_routed_experts == E
+    assert len(split.b) == L + 1  # index 0 = embedding pseudo-layer (b=0)
+    assert all(x > 0 for x in split.b[1:])
+    # Both phases present with positive decode FLOPs on every layer.
+    for phase in ("prefill", "decode"):
+        assert all(x > 0 for x in split.f_q[phase]["b_1"][1:])
+
+
+def test_qwen2_moe_shared_expert_modeled():
+    """Qwen2-MoE's single structural shared expert (config publishes only
+    shared_expert_intermediate_size, never a count) must be priced: 3 GLU
+    projections x hidden x shared-intermediate at the weight dtype."""
+    split = profile_model(
+        "tests/configs/qwen15_moe_a27b.json", batch_sizes=[1],
+        sequence_length=128,
+    )
+    m = split.to_model_profile()
+    assert m.n_shared_experts == 1
+    k0 = sorted(split.bytes_per_expert)[0]
+    assert split.bytes_shared_experts[k0] == 3 * 2048 * 5632 * 2
+    assert split.bytes_per_expert[k0] == 3 * 2048 * 1408 * 2
+    assert m.experts_per_token == 4
+
+
+@pytest.mark.parametrize("cfg", ["glm4_9b", "qwen15_moe_a27b"])
+def test_family_solves_end_to_end(cfg):
+    """The two structurally novel families (fused-projection dense; MoE with
+    implicit shared expert) must flow through the full placement stack on
+    both backends, not just the profiler."""
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = profile_model(
+        f"tests/configs/{cfg}.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+    devs = make_synthetic_fleet(4, seed=3, pool_bytes=int(48e9))
+    gap = 1e-3
+    ref = halda_solve(devs, model, kv_bits="8bit", mip_gap=gap, backend="cpu")
+    got = halda_solve(devs, model, kv_bits="8bit", mip_gap=gap, backend="jax")
+    assert got.certified
+    assert abs(got.obj_value - ref.obj_value) <= 2 * gap * abs(ref.obj_value) + 1e-9
+    assert sum(got.w) * got.k == model.L
+    if model.n_routed_experts:
+        assert sum(got.y) == model.n_routed_experts

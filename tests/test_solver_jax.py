@@ -400,3 +400,48 @@ def test_scenario_batched_moe_load_factors(profiles_dir):
         )
         tol = 2 * gap * abs(solo.obj_value) + 1e-9
         assert abs(res.obj_value - solo.obj_value) <= tol
+
+
+def test_scenario_batched_warm_seeds(profiles_dir):
+    """Scenario batching with per-scenario warm seeds: the has_warm layout
+    engages only when EVERY scenario carries a hint (all-or-none, since
+    the vmapped jit layout is shared) and each warm result still matches
+    its cold counterpart within the certification band."""
+    import numpy as np
+
+    from distilp_tpu.common import load_model_profile
+    from distilp_tpu.solver.api import halda_solve_scenarios
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    rng = np.random.default_rng(43)
+    gap = 1e-3
+    scenarios = []
+    for _ in range(3):
+        devs = make_synthetic_fleet(4, seed=43)
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.6, 1.7)))
+        scenarios.append(devs)
+
+    cold = halda_solve_scenarios(scenarios, model, kv_bits="4bit", mip_gap=gap)
+    # Re-solve the same scenarios warm-seeded by their own cold results.
+    warm = halda_solve_scenarios(
+        scenarios, model, kv_bits="4bit", mip_gap=gap, warms=cold
+    )
+    for c, w in zip(cold, warm):
+        assert w.certified
+        tol = 2 * gap * abs(c.obj_value) + 1e-9
+        assert abs(w.obj_value - c.obj_value) <= tol
+
+    # Mixed warms (one None) degrade the whole batch to cold — still
+    # correct, same objectives.
+    mixed = halda_solve_scenarios(
+        scenarios, model, kv_bits="4bit", mip_gap=gap,
+        warms=[cold[0], None, cold[2]],
+    )
+    for c, m in zip(cold, mixed):
+        assert m.certified
+        tol = 2 * gap * abs(c.obj_value) + 1e-9
+        assert abs(m.obj_value - c.obj_value) <= tol
