@@ -4,6 +4,7 @@ from .api import (
     PendingHalda,
     halda_solve,
     halda_solve_async,
+    halda_solve_per_k,
     halda_solve_scenarios,
 )
 from .coeffs import (
@@ -31,6 +32,7 @@ from .streaming import StreamingReplanner
 __all__ = [
     "halda_solve",
     "halda_solve_async",
+    "halda_solve_per_k",
     "halda_solve_scenarios",
     "PendingHalda",
     "StreamingReplanner",

@@ -389,3 +389,62 @@ def halda_solve_scenarios(
             raise RuntimeError(f"No feasible MILP found for scenario {i}.")
         results.append(_best_to_result(best, built[i][1]))
     return results
+
+
+def halda_solve_per_k(
+    devs: Sequence[DeviceProfile],
+    model: ModelProfile,
+    k_candidates: Optional[Iterable[int]] = None,
+    mip_gap: Optional[float] = 1e-4,
+    kv_bits: str = "8bit",
+    moe: Optional[bool] = None,
+    max_rounds: Optional[int] = None,
+    beam: Optional[int] = None,
+    ipm_iters: Optional[int] = None,
+    node_cap: Optional[int] = None,
+    load_factors: Optional[Sequence[float]] = None,
+    batch_size: int = 1,
+) -> List[HALDAResult]:
+    """Certified optimum for EVERY feasible k, in one device dispatch.
+
+    ``halda_solve`` answers "what is THE best placement" — losing segment
+    counts prune early against the global incumbent and report objectives
+    only. This answers the reference CLI's original contract (one solved
+    MILP per k, /root/reference/src/distilp/solver/halda_p_solver.py:
+    392-412): each k runs under per-k pruning until its own mip-gap
+    certificate closes, and comes back as a full ``HALDAResult`` with its
+    assignment, certificate, and gap. Use it to inspect the k-curve with
+    real placements (capacity planning: "what would k=8 cost me?").
+
+    Structurally infeasible k's (fewer layers per segment than devices) and
+    k's proven infeasible by the search are omitted from the returned list.
+    JAX backend only.
+    """
+    try:
+        from .backend_jax import solve_sweep_jax
+    except ImportError as e:
+        raise NotImplementedError(
+            "The JAX backend is not available in this build "
+            f"(import failed: {e}); per-k optima need it (the CPU backend "
+            "can loop solve_fixed_k_cpu directly)."
+        ) from e
+
+    Ks, sets, coeffs, arrays = _build_instance(
+        devs, model, k_candidates, kv_bits, moe, load_factors, batch_size
+    )
+    results, _ = solve_sweep_jax(
+        arrays,
+        [(k, model.L // k) for k in Ks],
+        mip_gap=mip_gap if mip_gap is not None else 1e-4,
+        coeffs=coeffs,
+        max_rounds=max_rounds,
+        beam=beam,
+        ipm_iters=ipm_iters,
+        node_cap=node_cap,
+        per_k_optima=True,
+    )
+    return [
+        _best_to_result(res, sets)
+        for res in results
+        if res is not None and res.w is not None
+    ]

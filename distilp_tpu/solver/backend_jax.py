@@ -935,7 +935,15 @@ class SearchState(NamedTuple):
     inc_y: jax.Array  # (M,) float64 expert counts (zeros in dense mode)
     inc_kidx: jax.Array  # () int32
     dropped_bound: jax.Array  # () float64 min bound among overflow-dropped nodes
-    per_k_best: jax.Array  # (n_k,) float64 best incumbent per k (reporting only)
+    per_k_best: jax.Array  # (n_k,) float64 best incumbent per k
+    # Per-k incumbent assignments + per-k overflow bound. Reporting-only in
+    # the default (global-incumbent) sweep; load-bearing in per-k mode
+    # (``_bnb_round(per_k=True)``), where each k prunes only against its
+    # own incumbent and certifies its own optimum.
+    per_k_w: jax.Array  # (n_k, M) float64
+    per_k_n: jax.Array  # (n_k, M) float64
+    per_k_y: jax.Array  # (n_k, M) float64
+    per_k_dropped: jax.Array  # (n_k,) float64
 
 
 class SweepData(NamedTuple):
@@ -992,6 +1000,10 @@ def _root_state(lo_k, hi_k, M: int, cap: int) -> SearchState:
         inc_kidx=jnp.asarray(0, jnp.int32),
         dropped_bound=jnp.asarray(jnp.inf, BDTYPE),
         per_k_best=jnp.full(n_k, jnp.inf, BDTYPE),
+        per_k_w=jnp.zeros((n_k, M), BDTYPE),
+        per_k_n=jnp.zeros((n_k, M), BDTYPE),
+        per_k_y=jnp.zeros((n_k, M), BDTYPE),
+        per_k_dropped=jnp.full(n_k, jnp.inf, BDTYPE),
     )
 
 
@@ -1013,6 +1025,7 @@ def _bnb_round(
     ipm_iters: int = IPM_ITERS,
     beam: Optional[int] = None,
     moe: bool = False,
+    per_k: bool = False,
 ) -> SearchState:
     """One batched branch-and-bound round over the frontier (pure function;
     traced inside the fused solve loop or jitted standalone by callers).
@@ -1024,6 +1037,16 @@ def _bnb_round(
     Measured frontiers stay tiny (<=4 active on the 16-device north star), so
     a small beam removes ~90% of the round's FLOPs without weakening the
     certificate — an unprocessed node keeps its valid parent bound.
+
+    ``per_k`` (static) switches the pruning regime: by default every node
+    prunes against the single global incumbent (fastest route to THE
+    optimum — losing k's die early, their entries are reporting-only). In
+    per-k mode a node prunes only against ITS k's incumbent and the per-k
+    incumbent assignments/overflow bounds are maintained, so the sweep
+    terminates with a certified optimum for EVERY feasible k — the
+    reference's per-k-MILP output contract
+    (/root/reference/src/distilp/solver/halda_p_solver.py:392-412) in one
+    dispatch.
     """
     A, int_mask, ks, Ws, rd = data.A, data.int_mask, data.ks, data.Ws, data.rd
     obj_const = data.obj_const
@@ -1064,21 +1087,43 @@ def _bnb_round(
     inc_y = jnp.where(better, y_int[best_i], state.inc_y)
     inc_kidx = jnp.where(better, kidx_p[best_i], state.inc_kidx)
 
-    # Per-k reporting incumbents
-    per_k_best = state.per_k_best
-    per_k_best = jnp.minimum(
-        per_k_best,
-        jnp.full_like(per_k_best, jnp.inf).at[kidx_p].min(obj_full),
-    )
+    # Per-k incumbent objectives (always: the sweep reports them); the
+    # assignment vectors only in per-k mode — they are dead weight in the
+    # global regime and XLA cannot eliminate loop-carried state.
+    n_k = state.per_k_best.shape[0]
+    round_best_k = jnp.full(n_k, jnp.inf, BDTYPE).at[kidx_p].min(obj_full)
+    per_k_best = jnp.minimum(state.per_k_best, round_best_k)
+    if per_k:
+        improved_k = round_best_k < state.per_k_best
+        k_mask = kidx_p[:, None] == jnp.arange(n_k)[None, :]  # (B, n_k)
+        r_star = jnp.argmin(
+            jnp.where(k_mask, obj_full[:, None], jnp.inf), axis=0
+        )  # (n_k,) row that achieved each k's round best
+        per_k_w = jnp.where(improved_k[:, None], w_int[r_star], state.per_k_w)
+        per_k_n = jnp.where(improved_k[:, None], n_int[r_star], state.per_k_n)
+        per_k_y = jnp.where(improved_k[:, None], y_int[r_star], state.per_k_y)
+    else:
+        per_k_w, per_k_n, per_k_y = state.per_k_w, state.per_k_n, state.per_k_y
 
     # Prune: a node survives only if its bound can still beat the
     # incumbent by more than the requested relative gap. (With no
     # incumbent yet the threshold must stay +inf, not inf-inf=NaN.)
-    threshold = jnp.where(
-        jnp.isfinite(incumbent),
-        incumbent - mip_gap * jnp.abs(incumbent),
+    # Per-k mode: the comparator is the node's OWN k's incumbent — a
+    # losing k must still close its own gap, so the global optimum may
+    # not prune it.
+    threshold_k = jnp.where(
+        jnp.isfinite(per_k_best),
+        per_k_best - mip_gap * jnp.abs(per_k_best),
         jnp.inf,
     )
+    if per_k:
+        threshold = threshold_k[kidx_p]  # (B,) per-node
+    else:
+        threshold = jnp.where(
+            jnp.isfinite(incumbent),
+            incumbent - mip_gap * jnp.abs(incumbent),
+            jnp.inf,
+        )
     survive = active_p & (bound < threshold)
 
     # Reduced-cost box tightening. The Lagrangian bound prices a unit move of
@@ -1150,7 +1195,10 @@ def _bnb_round(
     # Unprocessed rows pass through once, with their parent bound still
     # subject to this round's (possibly improved) pruning threshold.
     rest_bound = state.node_bound[B:]
-    rest_active = state.active[B:] & (rest_bound < threshold)
+    rest_threshold = (
+        threshold_k[state.node_kidx[B:]] if per_k else threshold
+    )
+    rest_active = state.active[B:] & (rest_bound < rest_threshold)
 
     child_lo = jnp.concatenate([lo_p, lo_b, state.node_lo[B:]], axis=0)
     child_hi = jnp.concatenate([hi_a, hi_p, state.node_hi[B:]], axis=0)
@@ -1163,8 +1211,20 @@ def _bnb_round(
     order = jnp.argsort(sort_key)
     keep = order[:cap]
     spill = order[cap:]
-    spill_bound = jnp.min(jnp.where(child_active[spill], child_bound[spill], jnp.inf))
-    dropped_bound = jnp.minimum(state.dropped_bound, spill_bound)
+    spill_live = jnp.where(child_active[spill], child_bound[spill], jnp.inf)
+    dropped_bound = jnp.minimum(state.dropped_bound, jnp.min(spill_live))
+    # Per-k overflow accounting: a spilled node floors ITS k's certificate,
+    # not every k's (the global dropped_bound stays the conservative floor
+    # for the global certificate). Per-k mode only — dead state otherwise.
+    if per_k:
+        per_k_dropped = jnp.minimum(
+            state.per_k_dropped,
+            jnp.full(n_k, jnp.inf, BDTYPE)
+            .at[child_kidx[spill]]
+            .min(spill_live),
+        )
+    else:
+        per_k_dropped = state.per_k_dropped
 
     return SearchState(
         node_lo=child_lo[keep],
@@ -1179,6 +1239,10 @@ def _bnb_round(
         inc_kidx=inc_kidx,
         dropped_bound=dropped_bound,
         per_k_best=per_k_best,
+        per_k_w=per_k_w,
+        per_k_n=per_k_n,
+        per_k_y=per_k_y,
+        per_k_dropped=per_k_dropped,
     )
 
 
@@ -1236,6 +1300,8 @@ def _seed_root_bounds(
     lag_obj = lag_obj + obj_const
     jbest = jnp.argmin(lag_obj)
     lag_better = lag_obj[jbest] < state.incumbent
+    lag_obj_clean = jnp.where(jnp.isfinite(lag_obj), lag_obj, jnp.inf)
+    seeded_k = lag_obj_clean < state.per_k_best
     state = state._replace(
         incumbent=jnp.where(lag_better, lag_obj[jbest], state.incumbent),
         inc_w=jnp.where(lag_better, lag_w[jbest], state.inc_w),
@@ -1244,9 +1310,10 @@ def _seed_root_bounds(
         inc_kidx=jnp.where(
             lag_better, jbest.astype(jnp.int32), state.inc_kidx
         ),
-        per_k_best=jnp.minimum(
-            state.per_k_best, jnp.where(jnp.isfinite(lag_obj), lag_obj, jnp.inf)
-        ),
+        per_k_best=jnp.minimum(state.per_k_best, lag_obj_clean),
+        per_k_w=jnp.where(seeded_k[:, None], lag_w, state.per_k_w),
+        per_k_n=jnp.where(seeded_k[:, None], lag_n, state.per_k_n),
+        per_k_y=jnp.where(seeded_k[:, None], lag_y, state.per_k_y),
     )
     return state, duals
 
@@ -1406,7 +1473,7 @@ _RD_VEC_FIELDS = (
 
 _PACKED_STATIC_ARGS = (
     "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
-    "has_warm", "w_max", "e_max", "decomp_steps", "has_duals",
+    "has_warm", "w_max", "e_max", "decomp_steps", "has_duals", "per_k",
 )
 
 
@@ -1427,6 +1494,7 @@ def _solve_packed_impl(
     e_max: int = 0,
     decomp_steps: int = 0,
     has_duals: bool = False,
+    per_k: bool = False,
 ) -> jax.Array:
     """One-dispatch sweep: unpack the two blobs (``_pack_static`` stays
     device-resident across streaming ticks; ``_pack_dynamic`` is the per-tick
@@ -1441,6 +1509,11 @@ def _solve_packed_impl(
     chosen Lagrangian multipliers are appended as
     ``[lam (n_k), mu (n_k), tau (n_k*M)]`` so the caller can persist them and
     warm-start the next streaming tick's ascent (``has_duals``).
+
+    ``per_k`` appends the per-k certified output —
+    ``[per_k_w (n_k*M), per_k_n (n_k*M), per_k_y (n_k*M),
+    per_k_bound (n_k)]`` — and switches the search to per-k pruning (every
+    feasible k terminates with its own optimum and certificate).
     """
     lay = VarLayout(M, moe)
     N = lay.n_vars
@@ -1584,14 +1657,25 @@ def _solve_packed_impl(
         # state (the Lagrangian primal may be strictly better on a MoE tick;
         # a stale-infeasible hint prices to +inf and changes nothing).
         seeded = jnp.isfinite(warm_obj) & (warm_obj < state.incumbent)
+        warm_obj_clean = jnp.where(jnp.isfinite(warm_obj), warm_obj, jnp.inf)
+        seeded_k = warm_obj_clean < state.per_k_best[warm_kidx]
         state = state._replace(
             incumbent=jnp.where(seeded, warm_obj, state.incumbent),
             inc_w=jnp.where(seeded, w_rep, state.inc_w),
             inc_n=jnp.where(seeded, n_rep, state.inc_n),
             inc_y=jnp.where(seeded, y_rep, state.inc_y),
             inc_kidx=jnp.where(seeded, warm_kidx, state.inc_kidx),
-            per_k_best=state.per_k_best.at[warm_kidx].min(
-                jnp.where(jnp.isfinite(warm_obj), warm_obj, jnp.inf)
+            per_k_best=state.per_k_best.at[warm_kidx].min(warm_obj_clean),
+            # Keep the per-k assignment vectors consistent with every
+            # per_k_best improvement (the per-k decode trusts them).
+            per_k_w=state.per_k_w.at[warm_kidx].set(
+                jnp.where(seeded_k, w_rep, state.per_k_w[warm_kidx])
+            ),
+            per_k_n=state.per_k_n.at[warm_kidx].set(
+                jnp.where(seeded_k, n_rep, state.per_k_n[warm_kidx])
+            ),
+            per_k_y=state.per_k_y.at[warm_kidx].set(
+                jnp.where(seeded_k, y_rep, state.per_k_y[warm_kidx])
             ),
         )
 
@@ -1603,6 +1687,7 @@ def _solve_packed_impl(
         max_rounds=max_rounds,
         beam=beam,
         moe=moe,
+        per_k=per_k,
     )
 
     parts = [
@@ -1625,6 +1710,13 @@ def _solve_packed_impl(
             lam.astype(BDTYPE).ravel(),
             mu.astype(BDTYPE).ravel(),
             tau.astype(BDTYPE).ravel(),
+        ]
+    if per_k:
+        parts += [
+            state.per_k_w.ravel(),
+            state.per_k_n.ravel(),
+            state.per_k_y.ravel(),
+            _per_k_bound(state),
         ]
     return jnp.concatenate(parts)
 
@@ -1657,13 +1749,14 @@ def _solve_scenarios_packed(
     e_max: int = 0,
     decomp_steps: int = 0,
     has_duals: bool = False,
+    per_k: bool = False,
 ) -> jax.Array:
     return jax.vmap(
         lambda dyn: _solve_packed_impl(
             static_blob, dyn, M=M, n_k=n_k, m=m, nf=nf, cap=cap,
             ipm_iters=ipm_iters, max_rounds=max_rounds, beam=beam, moe=moe,
             has_warm=has_warm, w_max=w_max, e_max=e_max,
-            decomp_steps=decomp_steps, has_duals=has_duals,
+            decomp_steps=decomp_steps, has_duals=has_duals, per_k=per_k,
         )
     )(dyn_blobs)
 
@@ -1678,6 +1771,29 @@ def _certified(state: SearchState, mip_gap) -> jax.Array:
     return jnp.isfinite(inc) & (inc - _best_bound(state) <= mip_gap * jnp.abs(inc))
 
 
+def _per_k_bound(state: SearchState) -> jax.Array:
+    """(n_k,) proven lower bound per k: min over that k's live nodes and its
+    own overflow floor."""
+    n_k = state.per_k_best.shape[0]
+    live = (
+        jnp.full(n_k, jnp.inf, BDTYPE)
+        .at[state.node_kidx]
+        .min(jnp.where(state.active, state.node_bound, jnp.inf))
+    )
+    return jnp.minimum(live, state.per_k_dropped)
+
+
+def _certified_per_k(state: SearchState, mip_gap) -> jax.Array:
+    """True when EVERY k is settled: its own gap closed, or its subtree
+    exhausted (bound +inf: nothing live, nothing dropped — the incumbent,
+    or infeasibility, is exact)."""
+    bound_k = _per_k_bound(state)
+    inc_k = state.per_k_best
+    done = jnp.isfinite(inc_k) & (inc_k - bound_k <= mip_gap * jnp.abs(inc_k))
+    exhausted = jnp.isposinf(bound_k)  # NOT -inf (unexplored roots)
+    return jnp.all(done | exhausted)
+
+
 def _run_bnb_loop(
     data: SweepData,
     state: SearchState,
@@ -1686,24 +1802,29 @@ def _run_bnb_loop(
     max_rounds: int = MAX_ROUNDS,
     beam: Optional[int] = None,
     moe: bool = False,
+    per_k: bool = False,
 ) -> SearchState:
     """``lax.while_loop`` over B&B rounds with the mip-gap test on-device.
     The single shared definition of the search loop (traced by both the
-    packed single-dispatch path and the mesh-sharded path)."""
+    packed single-dispatch path and the mesh-sharded path). ``per_k``
+    switches both the pruning regime and the termination test (every k
+    settled vs the global gap closed)."""
 
     def cond(carry):
         state, i = carry
-        return (
-            (i < max_rounds)
-            & jnp.any(state.active)
-            & ~_certified(state, mip_gap)
+        settled = (
+            _certified_per_k(state, mip_gap)
+            if per_k
+            else _certified(state, mip_gap)
         )
+        return (i < max_rounds) & jnp.any(state.active) & ~settled
 
     def body(carry):
         state, i = carry
         return (
             _bnb_round(
-                data, state, mip_gap, ipm_iters=ipm_iters, beam=beam, moe=moe
+                data, state, mip_gap, ipm_iters=ipm_iters, beam=beam,
+                moe=moe, per_k=per_k,
             ),
             i + 1,
         )
@@ -1712,7 +1833,9 @@ def _run_bnb_loop(
     return state
 
 
-@partial(jax.jit, static_argnames=("ipm_iters", "max_rounds", "beam", "moe"))
+@partial(
+    jax.jit, static_argnames=("ipm_iters", "max_rounds", "beam", "moe", "per_k")
+)
 def _solve_fused(
     data: SweepData,
     state: SearchState,
@@ -1721,6 +1844,7 @@ def _solve_fused(
     max_rounds: int = MAX_ROUNDS,
     beam: Optional[int] = None,
     moe: bool = False,
+    per_k: bool = False,
 ) -> SearchState:
     """The full branch-and-bound sweep as one device program; the host does
     one dispatch and one fetch per HALDA solve."""
@@ -1732,6 +1856,7 @@ def _solve_fused(
         max_rounds=max_rounds,
         beam=beam,
         moe=moe,
+        per_k=per_k,
     )
 
 
@@ -1799,8 +1924,16 @@ def solve_sweep_jax(
     warm: Optional[ILPResult] = None,
     timings: Optional[dict] = None,
     collect: bool = True,
+    per_k_optima: bool = False,
 ):
     """Solve the whole k-sweep on the accelerator.
+
+    ``per_k_optima=True`` switches the search to per-k pruning: every
+    feasible k terminates with its OWN certified optimum and full integer
+    assignment (the reference's per-k-MILP output contract), instead of the
+    default regime where losing k's prune early against the global
+    incumbent and report objectives only. Costs more rounds (each k closes
+    its own gap) but still one dispatch.
 
     ``collect=False`` returns a ``PendingSweep`` right after the dispatch
     instead of blocking on the result fetch: the caller overlaps its own
@@ -1903,6 +2036,7 @@ def solve_sweep_jax(
         e_max=e_max,
         decomp_steps=decomp_steps,
         has_duals=duals_tuple is not None,
+        per_k=per_k_optima,
     )
     pending = PendingSweep(
         out=out_dev,
@@ -1915,6 +2049,7 @@ def solve_sweep_jax(
         w_max=w_max,
         mip_gap=mip_gap,
         debug=debug,
+        per_k=per_k_optima,
     )
     if collect is False:
         # Async mode: the device is (or will be) computing; the caller
@@ -1963,6 +2098,7 @@ class PendingSweep(NamedTuple):
     w_max: int
     mip_gap: float
     debug: bool
+    per_k: bool = False
 
 
 def collect_sweep(
@@ -1974,7 +2110,7 @@ def collect_sweep(
     return _decode_sweep_out(
         out, pending.results, pending.feasible, pending.kWs, pending.M,
         pending.n_k, pending.moe, pending.w_max, pending.mip_gap,
-        pending.debug,
+        pending.debug, per_k=pending.per_k,
     )
 
 
@@ -1989,6 +2125,7 @@ def _decode_sweep_out(
     w_max: int,
     mip_gap: float,
     debug: bool,
+    per_k: bool = False,
 ) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
     """Decode one fetched ``_solve_packed`` output vector (shared by the
     single-dispatch, async, and scenario-batched paths)."""
@@ -2040,13 +2177,56 @@ def _decode_sweep_out(
             "tau": tau_out.tolist(),
         }
 
+    # Per-k mode: the tail carries full per-k assignments + per-k bounds,
+    # right after the (optional) duals block.
+    pk_w = pk_n = pk_y = pk_bound = None
+    if per_k:
+        p0 = 4 + 3 * M + n_k
+        if moe and w_max > 0:
+            p0 += 2 * n_k + n_k * M  # duals block
+        pk_w = out[p0 : p0 + n_k * M].reshape(n_k, M)
+        pk_n = out[p0 + n_k * M : p0 + 2 * n_k * M].reshape(n_k, M)
+        pk_y = out[p0 + 2 * n_k * M : p0 + 3 * n_k * M].reshape(n_k, M)
+        pk_bound = out[p0 + 3 * n_k * M : p0 + 3 * n_k * M + n_k]
+
     best: Optional[ILPResult] = None
     pos_of = {kW: i for i, kW in enumerate(kWs)}
     for j, (k, W) in enumerate(feasible):
         obj_j = float(per_k_best[j])
         if not np.isfinite(obj_j):
             continue
-        if j == inc_k_idx:
+        if per_k:
+            # Full certified entry for EVERY k (per-k pruning regime).
+            # bound == +inf means the subtree was EXHAUSTED (incumbent
+            # exact); bound == -inf means it was never explored (round
+            # budget ran out before this k's roots were processed) — that
+            # entry must NOT claim a certificate.
+            bound_j = float(pk_bound[j])
+            if np.isposinf(bound_j):
+                cert_j, gap_j = True, 0.0
+            elif not np.isfinite(bound_j):
+                cert_j, gap_j = False, None
+            else:
+                gap_j = (
+                    max(0.0, (obj_j - bound_j) / abs(obj_j))
+                    if obj_j != 0.0
+                    else max(0.0, obj_j - bound_j)
+                )
+                cert_j = obj_j - bound_j <= mip_gap * abs(obj_j) + 1e-12
+            entry = ILPResult(
+                k=k,
+                w=[int(round(x)) for x in pk_w[j]],
+                n=[int(round(x)) for x in pk_n[j]],
+                y=[int(round(x)) for x in pk_y[j]] if moe else None,
+                obj_value=obj_j,
+                certified=cert_j,
+                gap=gap_j,
+                duals=out_duals if j == inc_k_idx else None,
+            )
+            results[pos_of[(k, W)]] = entry
+            if j == inc_k_idx:
+                best = entry
+        elif j == inc_k_idx:
             y = inc_y if moe else None
             best = ILPResult(
                 k=k, w=inc_w, n=inc_n, y=y, obj_value=obj_j,
@@ -2059,6 +2239,25 @@ def _decode_sweep_out(
             # with the assignment explicitly absent (w=n=None, uncertified).
             results[pos_of[(k, W)]] = ILPResult(
                 k=k, obj_value=obj_j, certified=False
+            )
+
+    if per_k:
+        # The global warning above only covers the winner; per-k mode
+        # promises a certificate PER k, so name the ones that missed.
+        missed = [
+            r.k for r in results
+            if r is not None and r.w is not None and not r.certified
+        ]
+        if missed:
+            import warnings
+
+            warnings.warn(
+                f"HALDA per-k sweep: mip-gap certificate NOT met for "
+                f"k={missed} (round budget exhausted before those k's "
+                f"closed their own gap); raise max_rounds. Their entries "
+                f"carry certified=False.",
+                RuntimeWarning,
+                stacklevel=2,
             )
     return results, best
 

@@ -70,11 +70,12 @@ def test_max_rounds_converts_warning_into_certificate(profiles_dir):
 
 
 def test_per_k_reporting_entries_have_no_assignment(profiles_dir):
-    """Non-winning k's in the sweep output carry only a best-found objective:
-    w/n are None and certified is False, so no caller can mistake them for
-    solved placements (the reference returns certified per-k optima —
-    /root/reference/src/distilp/solver/halda_p_solver.py:392-412 — which one
-    batched sweep deliberately does not re-derive)."""
+    """Non-winning k's in the DEFAULT sweep output carry only a best-found
+    objective: w/n are None and certified is False, so no caller can mistake
+    them for solved placements. The reference's certified-per-k contract
+    (/root/reference/src/distilp/solver/halda_p_solver.py:392-412) is the
+    opt-in ``halda_solve_per_k`` / ``per_k_optima=True`` mode (pinned by
+    test_per_k_optima_match_cpu_oracle)."""
     from distilp_tpu.common import kv_bits_to_factor
     from distilp_tpu.solver.assemble import assemble
     from distilp_tpu.solver.backend_jax import solve_sweep_jax
@@ -445,3 +446,73 @@ def test_scenario_batched_warm_seeds(profiles_dir):
         assert m.certified
         tol = 2 * gap * abs(c.obj_value) + 1e-9
         assert abs(m.obj_value - c.obj_value) <= tol
+
+
+def test_per_k_optima_match_cpu_oracle(profiles_dir):
+    """halda_solve_per_k must return a CERTIFIED optimum with a full
+    assignment for EVERY feasible k — the reference's per-k-MILP output
+    contract — each matching the HiGHS oracle's fixed-k solve within the
+    certification band."""
+    from distilp_tpu.common import kv_bits_to_factor, load_from_profile_folder
+    from distilp_tpu.solver.api import halda_solve_per_k
+    from distilp_tpu.solver.assemble import assemble
+    from distilp_tpu.solver.backend_cpu import solve_fixed_k_cpu
+    from distilp_tpu.solver.coeffs import assign_sets, build_coeffs
+
+    devs, model = load_from_profile_folder(profiles_dir / "hermes_70b")
+    gap = 1e-4
+    per_k = halda_solve_per_k(devs, model, mip_gap=gap, kv_bits="4bit")
+    assert len(per_k) >= 8  # every feasible k came back with an assignment
+
+    coeffs = build_coeffs(
+        devs, model, kv_bits_to_factor("4bit"), assign_sets(devs)
+    )
+    arrays = assemble(coeffs)
+    for r in per_k:
+        assert r.certified and r.gap is not None and r.gap <= gap
+        assert sum(r.w) * r.k == model.L
+        assert all(0 <= n <= w for w, n in zip(r.w, r.n))
+        oracle = solve_fixed_k_cpu(arrays, r.k, model.L // r.k, mip_gap=gap)
+        tol = 2 * gap * abs(oracle.obj_value) + 1e-9
+        assert abs(r.obj_value - oracle.obj_value) <= tol, (
+            f"k={r.k}: per-k {r.obj_value} vs oracle {oracle.obj_value}"
+        )
+
+
+def test_per_k_optima_multi_device(profiles_dir):
+    """Per-k mode on a heterogeneous fleet: losing k's must NOT be pruned
+    by the global winner — each closes its own certificate."""
+    from distilp_tpu.common import load_model_profile
+    from distilp_tpu.solver.api import halda_solve_per_k
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(5, seed=11)
+    gap = 1e-3
+    per_k = halda_solve_per_k(devs, model, mip_gap=gap, kv_bits="4bit")
+    assert len(per_k) >= 2
+    objs = [r.obj_value for r in per_k]
+    best = halda_solve(devs, model, mip_gap=gap, kv_bits="4bit", backend="jax")
+    assert min(objs) <= best.obj_value + 2 * gap * abs(best.obj_value)
+    for r in per_k:
+        assert r.certified
+        assert sum(r.w) * r.k == model.L
+
+
+def test_per_k_truncated_budget_never_fabricates_certificates(profiles_dir):
+    """A per-k sweep cut off at one round must not claim certificates for
+    k's that never closed (or never explored) their own gap — it warns and
+    marks them certified=False; an unexplored k reports gap=None."""
+    from distilp_tpu.common import load_from_profile_folder
+    from distilp_tpu.solver.api import halda_solve_per_k
+
+    devs, model = load_from_profile_folder(profiles_dir / "hermes_70b")
+    with pytest.warns(RuntimeWarning):
+        per_k = halda_solve_per_k(
+            devs, model, mip_gap=1e-9, kv_bits="4bit", max_rounds=1
+        )
+    assert any(not r.certified for r in per_k)
+    for r in per_k:
+        if not r.certified:
+            assert r.gap is None or r.gap > 1e-9
