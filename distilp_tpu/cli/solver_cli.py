@@ -92,6 +92,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(default 1 = reference parity; the model profile must carry the "
         "column: profile with batch_sizes=[N, ...])",
     )
+    p.add_argument(
+        "--per-k", action="store_true",
+        help="solve EVERY feasible segment count to its own certificate "
+        "and print the full k-curve with assignments (jax backend; "
+        "default: report only the winner, losing k's as objectives)",
+    )
     return p
 
 
@@ -175,6 +181,52 @@ def main(argv=None) -> int:
             )
             return 2
 
+    if args.per_k:
+        if args.backend != "jax" or expert_loads is not None or warm is not None:
+            print(
+                "error: --per-k needs --backend jax and cannot combine "
+                "with --expert-loads or --warm-from",
+                file=sys.stderr,
+            )
+            return 2
+        from ..solver import halda_solve_per_k
+
+        try:
+            per_k = halda_solve_per_k(
+                devices,
+                model,
+                k_candidates=k_candidates,
+                mip_gap=args.mip_gap,
+                kv_bits=args.kv_bits,
+                moe={"auto": None, "on": True, "off": False}[args.moe],
+                max_rounds=args.max_rounds,
+                beam=args.beam,
+                ipm_iters=args.ipm_iters,
+                node_cap=args.node_cap,
+                batch_size=args.batch_size,
+                debug=args.debug,
+                plot=args.plot,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if not per_k:
+            print("error: no feasible placement for any k", file=sys.stderr)
+            return 1
+        print(f"{'k':>5s} {'objective':>14s} {'certified':>9s}  assignment")
+        for r in sorted(per_k, key=lambda r: r.k):
+            w_txt = ",".join(str(w) for w in r.w)
+            y_txt = f" y=[{','.join(str(y) for y in r.y)}]" if r.y else ""
+            print(
+                f"{r.k:5d} {r.obj_value:14.6f} {str(r.certified):>9s}  "
+                f"w=[{w_txt}]{y_txt}"
+            )
+        winner = min(per_k, key=lambda r: r.obj_value)
+        print(f"Best: k={winner.k} (objective {winner.obj_value:.6f})")
+        if args.save_solution:
+            _write_solution(args.save_solution, winner, devices)
+        return 0
+
     mapping = None
     realized = None
     try:
@@ -242,30 +294,37 @@ def main(argv=None) -> int:
             )
 
     if args.save_solution:
-        payload = {
-            "k": result.k,
-            "w": result.w,
-            "n": result.n,
-            "obj_value": result.obj_value,
-            "sets": result.sets,
-            "devices": [d.name for d in devices],
-            "certified": result.certified,
-            "gap": result.gap,
-        }
-        if result.y is not None:
-            payload["y"] = result.y
-        if result.duals is not None:
-            # Persist the Lagrangian root multipliers so --warm-from can
-            # re-certify a MoE re-solve without the full root ascent.
-            payload["duals"] = result.duals
-        if mapping is not None:
-            payload["expert_of_device"] = mapping.expert_of_device
-            payload["expert_load_share"] = [float(s) for s in mapping.load_share]
-            if realized is not None:
-                payload["realized_objective"] = realized
-        Path(args.save_solution).write_text(json.dumps(payload, indent=2))
-        print(f"Saved solution to {args.save_solution}")
+        _write_solution(
+            args.save_solution, result, devices, mapping=mapping,
+            realized=realized,
+        )
     return 0
+
+
+def _write_solution(path, result, devices, mapping=None, realized=None):
+    payload = {
+        "k": result.k,
+        "w": result.w,
+        "n": result.n,
+        "obj_value": result.obj_value,
+        "sets": result.sets,
+        "devices": [d.name for d in devices],
+        "certified": result.certified,
+        "gap": result.gap,
+    }
+    if result.y is not None:
+        payload["y"] = result.y
+    if result.duals is not None:
+        # Persist the Lagrangian root multipliers so --warm-from can
+        # re-certify a MoE re-solve without the full root ascent.
+        payload["duals"] = result.duals
+    if mapping is not None:
+        payload["expert_of_device"] = mapping.expert_of_device
+        payload["expert_load_share"] = [float(s) for s in mapping.load_share]
+        if realized is not None:
+            payload["realized_objective"] = realized
+    Path(path).write_text(json.dumps(payload, indent=2))
+    print(f"Saved solution to {path}")
 
 
 if __name__ == "__main__":

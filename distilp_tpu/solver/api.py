@@ -404,6 +404,8 @@ def halda_solve_per_k(
     node_cap: Optional[int] = None,
     load_factors: Optional[Sequence[float]] = None,
     batch_size: int = 1,
+    debug: bool = False,
+    plot: bool = False,
 ) -> List[HALDAResult]:
     """Certified optimum for EVERY feasible k, in one device dispatch.
 
@@ -441,10 +443,19 @@ def halda_solve_per_k(
         beam=beam,
         ipm_iters=ipm_iters,
         node_cap=node_cap,
+        debug=debug,
         per_k_optima=True,
     )
-    return [
+    out = [
         _best_to_result(res, sets)
         for res in results
         if res is not None and res.w is not None
     ]
+    if plot and out:
+        from .plotter import plot_k_curve
+
+        plot_k_curve(
+            [(r.k, r.obj_value) for r in out],
+            k_star=min(out, key=lambda r: r.obj_value).k,
+        )
+    return out

@@ -2197,13 +2197,15 @@ def _decode_sweep_out(
             continue
         if per_k:
             # Full certified entry for EVERY k (per-k pruning regime).
-            # bound == +inf means the subtree was EXHAUSTED (incumbent
-            # exact); bound == -inf means it was never explored (round
-            # budget ran out before this k's roots were processed) — that
-            # entry must NOT claim a certificate.
+            # bound == +inf means every node was exhausted or pruned at the
+            # mip-gap threshold — certified, but the surviving guarantee is
+            # <= mip_gap, so report THAT, not a fabricated 0.0 (threshold
+            # pruning kills nodes whose subtree can improve by up to
+            # mip_gap*|incumbent|). bound == -inf means the subtree was
+            # never explored (round budget ran out first) — no certificate.
             bound_j = float(pk_bound[j])
             if np.isposinf(bound_j):
-                cert_j, gap_j = True, 0.0
+                cert_j, gap_j = True, mip_gap
             elif not np.isfinite(bound_j):
                 cert_j, gap_j = False, None
             else:
@@ -2243,19 +2245,37 @@ def _decode_sweep_out(
 
     if per_k:
         # The global warning above only covers the winner; per-k mode
-        # promises a certificate PER k, so name the ones that missed.
+        # promises a certificate PER k, so name the ones that missed —
+        # including k's the round budget never reached at all (no
+        # incumbent, bound still -inf): silence there would make them
+        # indistinguishable from proven-infeasible k's.
         missed = [
             r.k for r in results
             if r is not None and r.w is not None and not r.certified
         ]
-        if missed:
+        unexplored = [
+            k
+            for j, (k, W) in enumerate(feasible)
+            if not np.isfinite(float(per_k_best[j]))
+            and not np.isposinf(float(pk_bound[j]))
+        ]
+        if missed or unexplored:
             import warnings
 
+            parts = []
+            if missed:
+                parts.append(
+                    f"certificate NOT met for k={missed} (budget exhausted "
+                    f"before those k's closed their own gap)"
+                )
+            if unexplored:
+                parts.append(
+                    f"k={unexplored} never explored (no incumbent found "
+                    f"before the round budget ran out; these are OMITTED, "
+                    f"not infeasible)"
+                )
             warnings.warn(
-                f"HALDA per-k sweep: mip-gap certificate NOT met for "
-                f"k={missed} (round budget exhausted before those k's "
-                f"closed their own gap); raise max_rounds. Their entries "
-                f"carry certified=False.",
+                f"HALDA per-k sweep: {'; '.join(parts)}; raise max_rounds.",
                 RuntimeWarning,
                 stacklevel=2,
             )

@@ -405,3 +405,35 @@ def test_profiler_cli_raw_out_carries_stats(tmp_path, monkeypatch):
     assert di.stats, "raw DeviceInfo carries no measurement stats"
     st = next(iter(di.stats.values()))
     assert st.samples >= 1 and st.min <= st.p50 <= st.max
+
+
+def test_solver_cli_per_k(tmp_path, capsys):
+    """--per-k prints a certified entry for every feasible k and saves the
+    winner; invalid combinations are rejected before any solve."""
+    from distilp_tpu.cli.solver_cli import main
+
+    sol = tmp_path / "sol.json"
+    rc = main(
+        [
+            "--profile",
+            str(PROFILES / "hermes_70b"),
+            "--backend",
+            "jax",
+            "--mip-gap",
+            "1e-4",
+            "--per-k",
+            "--save-solution",
+            str(sol),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("True") == 9  # all 9 feasible k's certified
+    assert "Best: k=40" in out
+    saved = json.loads(sol.read_text())
+    assert saved["k"] == 40 and saved["certified"] is True
+
+    rc = main(
+        ["--profile", str(PROFILES / "hermes_70b"), "--backend", "cpu", "--per-k"]
+    )
+    assert rc == 2  # needs the jax backend
