@@ -76,6 +76,7 @@ def solve_sweep_sharded(
     max_rounds: int = 48,
     beam: Optional[int] = None,
     node_cap: Optional[int] = None,
+    per_k: bool = False,
 ):
     """Run the fused B&B sweep with the frontier sharded across ``mesh``.
 
@@ -89,6 +90,11 @@ def solve_sweep_sharded(
     of the mesh size so every device solves the same number of frontier rows
     (GSPMD shards the IPM batch along the node axis), and the cap to a
     multiple likewise.
+
+    ``per_k`` switches to the per-k pruning regime (every feasible k closes
+    its own certificate; read the per-k assignments off the returned
+    state's ``per_k_w/n/y`` and bounds via ``backend_jax._per_k_bound``) —
+    the sharded counterpart of ``halda_solve_per_k``.
     """
     import jax.numpy as jnp
 
@@ -154,5 +160,6 @@ def solve_sweep_sharded(
             max_rounds=max_rounds,
             beam=beam,
             moe=sf.moe,
+            per_k=per_k,
         )
     return state, sf

@@ -2134,6 +2134,25 @@ def _decode_sweep_out(
     if debug:
         print(f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f}")
     if not np.isfinite(incumbent):
+        if per_k:
+            # No k found an incumbent. Distinguish budget starvation
+            # (some bound still below +inf: subtrees remain) from proven
+            # infeasibility (every subtree exhausted) — silence here would
+            # make max_rounds=small look like "infeasible for every k".
+            p0 = 4 + 3 * M + n_k
+            if moe and w_max > 0:
+                p0 += 2 * n_k + n_k * M
+            pk_bound0 = out[p0 + 3 * n_k * M : p0 + 3 * n_k * M + n_k]
+            if not np.all(np.isposinf(pk_bound0)):
+                import warnings
+
+                warnings.warn(
+                    "HALDA per-k sweep: NO k found an incumbent within the "
+                    "round budget (all entries omitted — budget starvation, "
+                    "not proven infeasibility); raise max_rounds.",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
         return results, None
     achieved_gap = (
         (incumbent - best_bound) / abs(incumbent) if incumbent != 0.0

@@ -131,6 +131,19 @@ def main() -> int:
             f"[6] scenario {label:>10s}: k={r.k} obj={r.obj_value:.4f} "
             f"certified={r.certified}"
         )
+
+    # ------------------------------------------------------------------
+    # 7. The full k-curve: every feasible segment count solved to its own
+    #    certificate in one dispatch (capacity planning: what would a
+    #    different pipeline depth cost?).
+    # ------------------------------------------------------------------
+    from distilp_tpu.solver import halda_solve_per_k
+
+    for r in halda_solve_per_k(devs, model, kv_bits="8bit", mip_gap=1e-3):
+        print(
+            f"[7] k={r.k}: obj={r.obj_value:.4f} certified={r.certified} "
+            f"y={r.y}"
+        )
     return 0
 
 

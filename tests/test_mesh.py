@@ -172,3 +172,53 @@ def test_sharded_frontier_hlo_is_partitioned(profiles_dir):
         sh = by_name[name]
         shape = getattr(state, name).shape
         assert sh.shard_shape(shape) == shape, f"{name} should be replicated"
+
+
+def test_sharded_per_k_certifies_every_k(profiles_dir):
+    """The per-k pruning regime must work under GSPMD too: a sharded sweep
+    with per_k=True closes every feasible k's own certificate, matching the
+    single-chip per-k solve."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distilp_tpu.common import kv_bits_to_factor, load_from_profile_folder
+    from distilp_tpu.parallel import make_mesh, solve_sweep_sharded
+    from distilp_tpu.solver.api import halda_solve_per_k
+    from distilp_tpu.solver.assemble import assemble
+    from distilp_tpu.solver.backend_jax import _per_k_bound
+    from distilp_tpu.solver.coeffs import (
+        assign_sets,
+        build_coeffs,
+        valid_factors_of_L,
+    )
+
+    devs, model = load_from_profile_folder(profiles_dir / "hermes_70b")
+    coeffs = build_coeffs(
+        devs, model, kv_bits_to_factor("4bit"), assign_sets(devs)
+    )
+    arrays = assemble(coeffs)
+    kWs = [(k, model.L // k) for k in valid_factors_of_L(model.L)]
+    gap = 1e-4
+
+    mesh = make_mesh(8)
+    state, sf = solve_sweep_sharded(
+        arrays, kWs, coeffs, mesh, mip_gap=gap, per_k=True
+    )
+    inc_k = np.asarray(state.per_k_best)
+    bound_k = np.asarray(_per_k_bound(state))
+    w_k = np.asarray(state.per_k_w)
+
+    solo = {r.k: r for r in halda_solve_per_k(devs, model, mip_gap=gap,
+                                              kv_bits="4bit")}
+    assert len(solo) == len(sf.ks)
+    for j, k in enumerate(sf.ks):
+        assert np.isfinite(inc_k[j]), f"k={k} found no incumbent sharded"
+        certified = (
+            np.isposinf(bound_k[j])
+            or inc_k[j] - bound_k[j] <= gap * abs(inc_k[j]) + 1e-12
+        )
+        assert certified, f"k={k} missed its certificate on the mesh"
+        tol = 2 * gap * abs(solo[k].obj_value) + 1e-9
+        assert abs(inc_k[j] - solo[k].obj_value) <= tol
+        assert int(sum(w_k[j])) * k == model.L
+    assert jnp.isfinite(state.incumbent)
