@@ -105,8 +105,8 @@ def solve_sweep_sharded(
         _seed_root_bounds,
         _solve_fused,
         _sweep_data,
+        _resolve_search_params,
         build_standard_form,
-        default_search_params,
         rounding_data,
     )
 
@@ -116,13 +116,18 @@ def solve_sweep_sharded(
         raise RuntimeError("No feasible MILP found for any k.")
 
     sf = build_standard_form(arrays, coeffs, feasible)
-    d_cap, d_beam, d_iters = default_search_params(sf.moe, len(sf.ks))
-    cap = pad_cap_to_mesh(
-        max(node_cap if node_cap is not None else d_cap, 2 * len(sf.ks)), mesh
+    # The shared resolution rule (incl. the per-k cap/beam scaling — a
+    # frontier sized for one winner spills under per-k pressure and a
+    # spilled node floors its k's certificate), then mesh-align: cap and
+    # beam round up to a multiple of the mesh size so every device solves
+    # the same number of frontier rows.
+    cap, d_beam, d_iters, _ = _resolve_search_params(
+        sf.moe, len(sf.ks), node_cap, beam, ipm_iters, max_rounds,
+        per_k=per_k,
     )
-    beam = beam if beam is not None else d_beam
-    beam = min(pad_cap_to_mesh(beam, mesh), cap)
-    ipm_iters = ipm_iters if ipm_iters is not None else d_iters
+    cap = pad_cap_to_mesh(max(cap, 2 * len(sf.ks)), mesh)
+    beam = min(pad_cap_to_mesh(d_beam, mesh), cap)
+    ipm_iters = d_iters
 
     rd = rounding_data(coeffs, arrays.moe)
     data = _sweep_data(sf, rd)

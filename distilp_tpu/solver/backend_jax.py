@@ -101,11 +101,21 @@ def _resolve_search_params(
     beam: Optional[int],
     ipm_iters: Optional[int],
     max_rounds: Optional[int],
+    per_k: bool = False,
 ) -> Tuple[int, int, int, int]:
     """(cap, beam, ipm_iters, max_rounds): caller overrides applied over the
     problem-class defaults — the one resolution rule for every solve path
-    (single-dispatch, async, scenario-batched)."""
+    (single-dispatch, async, scenario-batched).
+
+    Per-k mode keeps EVERY k's subtree alive to its own certificate, so the
+    frontier carries ~n_k concurrent searches: capacity and beam scale with
+    n_k (a frontier sized for one winner spills, and a spilled node floors
+    its k's certificate forever).
+    """
     d_cap, d_beam, d_iters = default_search_params(moe, n_k)
+    if per_k:
+        d_cap = max(d_cap, 32 * n_k)
+        d_beam = max(d_beam, 4 * n_k)
     return (
         max(node_cap, n_k) if node_cap is not None else d_cap,
         beam if beam is not None else d_beam,
@@ -1209,6 +1219,32 @@ def _bnb_round(
     # Compact best-bound-first back into the full capacity; track what falls off.
     sort_key = jnp.where(child_active, child_bound, jnp.inf)
     order = jnp.argsort(sort_key)
+    if per_k:
+        # K-FAIR compaction: under capacity pressure the global best-first
+        # order lets one k's deep subtree crowd every other k out, and a
+        # spilled node permanently floors its k's certificate. Re-rank so
+        # each k keeps its best nodes first (primary key: within-k rank,
+        # tie-broken by global bound order) — capacity is shared
+        # round-robin by quality instead of winner-take-all.
+        kidx_sorted = child_kidx[order]
+        active_sorted = child_active[order]
+        total = order.shape[0]
+        onehot = (
+            kidx_sorted[:, None] == jnp.arange(n_k, dtype=kidx_sorted.dtype)
+        ) & active_sorted[:, None]
+        rank_in_k = (
+            jnp.take_along_axis(
+                jnp.cumsum(onehot.astype(jnp.int32), axis=0),
+                jnp.clip(kidx_sorted, 0, n_k - 1)[:, None],
+                axis=1,
+            )[:, 0]
+            - 1
+        )
+        fair_key = (
+            jnp.where(active_sorted, rank_in_k, total) * (total + 1)
+            + jnp.arange(total)
+        )
+        order = order[jnp.argsort(fair_key)]
     keep = order[:cap]
     spill = order[cap:]
     spill_live = jnp.where(child_active[spill], child_bound[spill], jnp.inf)
@@ -1976,7 +2012,8 @@ def solve_sweep_jax(
     sf = build_standard_form(arrays, coeffs, feasible)
     n_k = len(sf.ks)
     cap, beam, ipm_iters, max_rounds = _resolve_search_params(
-        sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds
+        sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds,
+        per_k=per_k_optima,
     )
     warm_tuple, duals_tuple = _warm_and_duals(sf, arrays, warm, feasible)
 

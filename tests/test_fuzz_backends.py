@@ -201,3 +201,54 @@ def test_fuzz_warm_matches_cold_after_drift_moe(seed):
     if warm.certified:  # stale duals may miss the certificate; that is the
         _agree(cold, warm)  # documented fallback trigger, not a parity bug
     assert sum(warm.y) == model.n_routed_experts
+
+
+@pytest.mark.parametrize("seed", [13, 67])
+def test_fuzz_per_k_winner_matches_default_sweep(profiles_dir, seed):
+    """The per-k pruning regime must land on the same winner as the default
+    global-incumbent sweep (both certified to the same gap), and every
+    per-k entry must dominate the default sweep's reporting objective for
+    that k (the reporting entry is only a best-found upper bound — a per-k
+    certified optimum settling ABOVE it would be a bound bug). Seed 67
+    regression-pins the k-fair compaction: global best-first spilled a
+    crowded k's nodes and froze its certificate."""
+    from distilp_tpu.common import kv_bits_to_factor
+    from distilp_tpu.solver.api import halda_solve_per_k
+    from distilp_tpu.solver.assemble import assemble
+    from distilp_tpu.solver.backend_jax import solve_sweep_jax
+    from distilp_tpu.solver.coeffs import (
+        assign_sets,
+        build_coeffs,
+        valid_factors_of_L,
+    )
+
+    rng = np.random.default_rng(seed)
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    M = int(rng.choice([4, 6]))
+    devs = _perturb_fleet(make_synthetic_fleet(M, seed=seed), rng)
+    default = halda_solve(devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax")
+    per_k = halda_solve_per_k(devs, model, mip_gap=GAP, kv_bits="4bit")
+    assert per_k, "per-k sweep returned nothing on a feasible instance"
+    winner = min(per_k, key=lambda r: r.obj_value)
+    _agree(default, winner)
+    for r in per_k:
+        assert r.certified
+        assert sum(r.w) * r.k == model.L
+
+    # Dominance vs the default sweep's per-k reporting entries.
+    coeffs = build_coeffs(
+        devs, model, kv_bits_to_factor("4bit"), assign_sets(devs)
+    )
+    arrays = assemble(coeffs)
+    kWs = [(k, model.L // k) for k in valid_factors_of_L(model.L)]
+    reporting, _ = solve_sweep_jax(arrays, kWs, mip_gap=GAP, coeffs=coeffs)
+    report_of = {r.k: r.obj_value for r in reporting if r is not None}
+    for r in per_k:
+        if r.k in report_of:
+            tol = 2 * GAP * abs(report_of[r.k]) + 1e-9
+            assert r.obj_value <= report_of[r.k] + tol, (
+                f"k={r.k}: per-k optimum {r.obj_value} worse than the "
+                f"default sweep's found incumbent {report_of[r.k]}"
+            )
