@@ -203,7 +203,7 @@ def test_fuzz_warm_matches_cold_after_drift_moe(seed):
     assert sum(warm.y) == model.n_routed_experts
 
 
-@pytest.mark.parametrize("seed", [13, 67])
+@pytest.mark.parametrize("seed", [13, 67, 89])
 def test_fuzz_per_k_winner_matches_default_sweep(profiles_dir, seed):
     """The per-k pruning regime must land on the same winner as the default
     global-incumbent sweep (both certified to the same gap), and every
@@ -252,3 +252,30 @@ def test_fuzz_per_k_winner_matches_default_sweep(profiles_dir, seed):
                 f"k={r.k}: per-k optimum {r.obj_value} worse than the "
                 f"default sweep's found incumbent {report_of[r.k]}"
             )
+
+
+def test_fuzz_per_k_moe_matches_fixed_k_oracle():
+    """Per-k mode composes with the MoE formulation (Lagrangian root
+    seeding runs per k, y sums to E for every entry) and each certified
+    entry matches the HiGHS oracle's fixed-k solve."""
+    from distilp_tpu.solver.api import halda_solve_per_k
+
+    rng = np.random.default_rng(31)
+    model = profile_model(
+        "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+    M = int(rng.choice([3, 4]))
+    devs = _perturb_fleet(
+        make_synthetic_fleet(M, seed=31, pool_bytes=int(96e9)), rng
+    )
+    per_k = halda_solve_per_k(devs, model, mip_gap=GAP, kv_bits="8bit")
+    assert per_k
+    for r in per_k:
+        assert r.certified
+        assert sum(r.y) == model.n_routed_experts
+        assert sum(r.w) * r.k == model.L
+        oracle = halda_solve(
+            devs, model, k_candidates=[r.k], mip_gap=GAP, kv_bits="8bit",
+            backend="cpu",
+        )
+        _agree(oracle, r)
