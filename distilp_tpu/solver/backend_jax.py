@@ -1232,15 +1232,17 @@ def _bnb_round(
         onehot = (
             kidx_sorted[:, None] == jnp.arange(n_k, dtype=kidx_sorted.dtype)
         ) & active_sorted[:, None]
-        # int64 keys: rank*(total+1) overflows int32 once the frontier
+        # int64 KEYS: rank*(total+1) overflows int32 once the frontier
         # passes ~46k rows (node_cap is an unclamped public override), and
         # a wrapped key would scramble exactly the order this exists for.
+        # The cumsum itself stays int32 (its values max out at `total`) —
+        # only the extracted 1-D rank widens, not the (total, n_k) matrix.
         rank_in_k = (
             jnp.take_along_axis(
-                jnp.cumsum(onehot.astype(jnp.int64), axis=0),
+                jnp.cumsum(onehot.astype(jnp.int32), axis=0),
                 jnp.clip(kidx_sorted, 0, n_k - 1)[:, None],
                 axis=1,
-            )[:, 0]
+            )[:, 0].astype(jnp.int64)
             - 1
         )
         fair_key = (
