@@ -406,6 +406,7 @@ def halda_solve_per_k(
     batch_size: int = 1,
     debug: bool = False,
     plot: bool = False,
+    timings: Optional[dict] = None,
 ) -> List[HALDAResult]:
     """Certified optimum for EVERY feasible k, in one device dispatch.
 
@@ -444,6 +445,7 @@ def halda_solve_per_k(
         ipm_iters=ipm_iters,
         node_cap=node_cap,
         debug=debug,
+        timings=timings,
         per_k_optima=True,
     )
     out = [

@@ -328,8 +328,10 @@ class TestTpuV5eGoldenArtifacts:
         raw = DeviceInfo.model_validate(
             json.loads((self.FIXDIR / "tpu_v5e_raw.json").read_text())
         )
-        # Capacity provenance recorded (memory_stats / HBM-kind / env).
-        assert raw.gpu is None or raw.gpu.memory.capacity_source != ""
+        # Capacity provenance recorded (memory_stats / HBM-kind / env);
+        # DeviceInfo.gpu is non-Optional, so a capture without accelerator
+        # evidence fails here rather than passing by omission.
+        assert raw.gpu.memory.capacity_source != ""
         # Timing spreads present AND carrying real measurements — all-default
         # Stat objects (p50=0.0) would mean persistence dropped the evidence.
         assert raw.stats, "no Stat spreads persisted"

@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Tabulate the committed BENCH_r*.json driver artifacts across rounds.
+
+The headline numbers ride a tunneled TPU whose per-operation wire cost
+swings run to run, so raw wall-clocks across rounds are not comparable.
+This prints them side by side with the wire-condition diagnostic
+(``tiny_put_ms``, recorded since round 4) so a regression in the ENGINE is
+distinguishable from a slow tunnel day.
+
+    python tools/bench_history.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+
+def load_rounds(root: Path):
+    rounds = []
+    for p in sorted(root.glob("BENCH_r*.json")):
+        m = re.match(r"BENCH_r(\d+)\.json", p.name)
+        if not m:
+            continue
+        try:
+            rec = json.loads(p.read_text())
+        except json.JSONDecodeError:
+            rounds.append((int(m.group(1)), {"error": "unparseable artifact"}))
+            continue
+        # Driver artifacts wrap the bench line: find the parsed payload.
+        payload = rec.get("parsed") if isinstance(rec, dict) else None
+        if payload is None and isinstance(rec, dict) and "metric" in rec:
+            payload = rec
+        if payload is None:
+            tail = rec.get("tail") or "no payload" if isinstance(rec, dict) else "no payload"
+            payload = {"error": " ".join(str(tail).split())[:80]}
+        rounds.append((int(m.group(1)), payload))
+    return rounds
+
+
+def fmt(v, suffix=""):
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.1f}{suffix}"
+    return f"{v}{suffix}"
+
+
+def main() -> int:
+    root = Path(sys.argv[1]) if len(sys.argv) > 1 else Path(__file__).resolve().parents[1]
+    rounds = load_rounds(root)
+    if not rounds:
+        print("no BENCH_r*.json artifacts found")
+        return 1
+
+    cols = [
+        ("value", "cold ms"),
+        ("warm_tick_ms", "warm ms"),
+        ("moe_warm_tick_ms", "moe warm ms"),
+        ("placements_per_sec", "plc/s"),
+        ("pipelined_placements_per_sec", "pipe/s"),
+        ("scenario_batch_placements_per_sec", "scen/s"),
+        ("vs_baseline", "x HiGHS"),
+        ("tiny_put_ms", "wire ms/op"),
+    ]
+    header = f"{'round':>5s} {'platform':>14s} " + " ".join(
+        f"{label:>11s}" for _, label in cols
+    )
+    print(header)
+    print("-" * len(header))
+    for r, payload in rounds:
+        if "error" in payload and "metric" not in payload:
+            excerpt = " ".join(str(payload["error"]).split())[:70]
+            print(f"{r:5d} {'FAILED':>14s}  {excerpt}")
+            continue
+        platform = payload.get("platform", "?")
+        row = f"{r:5d} {platform:>14s} " + " ".join(
+            f"{fmt(payload.get(key)):>11s}" for key, _ in cols
+        )
+        print(row)
+        if payload.get("error") or payload.get("tpu_error"):
+            print(f"      note: {payload.get('error') or payload.get('tpu_error')}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
