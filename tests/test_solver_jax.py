@@ -518,3 +518,41 @@ def test_per_k_truncated_budget_never_fabricates_certificates(profiles_dir):
     for r in per_k:
         if not r.certified:
             assert r.gap is None or r.gap > 1e-9
+
+
+def test_scenario_batched_moe_warm_with_duals(profiles_dir):
+    """MoE scenario batching seeded by previous results: the persisted
+    Lagrangian duals ride the dynamic blobs (has_duals engages only when
+    every scenario carries a usable set) and each warm re-batch stays
+    certified, matching its cold counterpart — the vmapped warm+duals
+    layout compiles and prices correctly."""
+    import numpy as np
+
+    from distilp_tpu.profiler.api import profile_model
+    from distilp_tpu.solver.api import halda_solve_scenarios
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    model = profile_model(
+        str(profiles_dir.parent / "configs" / "mixtral_8x7b.json"),
+        batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+    gap = 1e-3
+    rng = np.random.default_rng(71)
+    scenarios = []
+    for _ in range(3):
+        devs = make_synthetic_fleet(4, seed=71, pool_bytes=int(64e9))
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.8, 1.3)))
+        scenarios.append(devs)
+
+    cold = halda_solve_scenarios(scenarios, model, kv_bits="8bit", mip_gap=gap)
+    assert all(r.certified and r.duals is not None for r in cold)
+    warm = halda_solve_scenarios(
+        scenarios, model, kv_bits="8bit", mip_gap=gap, warms=cold
+    )
+    for c, w in zip(cold, warm):
+        assert w.certified
+        assert sum(w.y) == model.n_routed_experts
+        tol = 2 * gap * abs(c.obj_value) + 1e-9
+        assert abs(w.obj_value - c.obj_value) <= tol

@@ -33,11 +33,15 @@ def load_rounds(root: Path):
         payload = rec.get("parsed") if isinstance(rec, dict) else None
         if payload is None and isinstance(rec, dict) and "metric" in rec:
             payload = rec
-        if payload is None:
-            tail = rec.get("tail") or "no payload" if isinstance(rec, dict) else "no payload"
+        if not isinstance(payload, dict):
+            tail = (
+                payload
+                or (rec.get("tail") if isinstance(rec, dict) else None)
+                or "no payload"
+            )
             payload = {"error": " ".join(str(tail).split())[:80]}
         rounds.append((int(m.group(1)), payload))
-    return rounds
+    return sorted(rounds, key=lambda t: t[0])
 
 
 def fmt(v, suffix=""):
