@@ -1,0 +1,228 @@
+#!/usr/bin/env python3
+"""Opportunistic TPU capture loop.
+
+The tunneled TPU ("axon" PJRT plugin) flaps for hours at a time; a round
+lasts hours. Instead of attempting one end-of-round capture, this tool
+probes the backend every few minutes in a throwaway subprocess (the probe
+from bench.py — hard timeout, process-group kill, so a wedged tunnel costs
+one child, never this process) and, the moment a live window opens:
+
+1. runs ``python bench.py`` and saves the JSON line to
+   ``BENCH_tpu_capture.json`` if it reports a real TPU platform;
+2. runs ``profiler device --raw-out`` to capture the measured device
+   fixtures ``tests/profiles/tpu_v5e/{tpu_v5e.json,tpu_v5e_raw.json}``
+   (the analogue of the reference's measured device profiles, e.g.
+   /root/reference/test/profiles/llama_3_70b/online/m1.json);
+3. re-runs the skip-gated regression pins
+   (tests/test_device_profiler.py::TestTpuV5eGoldenArtifacts) against the
+   fresh fixtures and discards them if they fail;
+4. commits whatever passed.
+
+Exits 0 once both captures are committed; a partial window (bench captured
+but the tunnel dropped before the fixtures finished) commits the part that
+succeeded and keeps watching for the rest.
+
+Run from round start:  ``python tools/tpu_watch.py >> tools/tpu_watch.log``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402  - reuse the wedge-proof probe
+
+FIXDIR = REPO / "tests" / "profiles" / "tpu_v5e"
+BENCH_OUT = REPO / "BENCH_tpu_capture.json"
+
+
+def _log(msg: str) -> None:
+    print(f"[tpu_watch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def _run(cmd: list[str], timeout_s: float, env: dict | None = None) -> tuple[int | None, str, str]:
+    """Run cmd in its own session with temp-file IO; killpg on timeout.
+
+    Same containment as bench._run_probe_once: the wedging plugin can spawn
+    tunnel helpers that inherit pipe write-ends, so pipes are never used and
+    the whole process group is killed on timeout.
+    """
+    with tempfile.TemporaryFile("w+") as out, tempfile.TemporaryFile("w+") as err:
+        proc = subprocess.Popen(
+            cmd, stdout=out, stderr=err, text=True, cwd=str(REPO),
+            start_new_session=True, env=env,
+        )
+        try:
+            rc: int | None = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            rc = None
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+        out.seek(0)
+        err.seek(0)
+        return rc, out.read(), err.read()
+
+
+def _probe_once(timeout_s: float) -> str | None:
+    """One live-backend probe; returns the platform string or None."""
+    rc, stdout, _ = bench._run_probe_once(timeout_s)
+    if rc != 0:
+        return None
+    hits = [
+        ln for ln in stdout.strip().splitlines()
+        if ln.startswith(bench._PROBE_SENTINEL + " ")
+    ]
+    return hits[-1].split()[1] if hits else None
+
+
+def _capture_bench(timeout_s: float) -> bool:
+    """Run bench.py; persist the JSON line iff it ran on the TPU."""
+    # Single attempt, no retries: the window is open NOW; if the tunnel
+    # drops mid-bench the outer loop re-probes rather than stacking waits.
+    env = dict(os.environ)
+    env["DPERF_BENCH_PROBE_RETRIES"] = "1"
+    rc, stdout, stderr = _run([sys.executable, "bench.py"], timeout_s, env=env)
+    if rc is None:
+        _log("bench.py timed out (tunnel dropped mid-bench?)")
+        return False
+    line = next(
+        (ln for ln in reversed(stdout.strip().splitlines())
+         if ln.startswith("{")), None,
+    )
+    if line is None:
+        _log(f"bench.py rc={rc} with no JSON line; stderr tail: "
+             f"{stderr.strip().splitlines()[-1:] or ''}")
+        return False
+    try:
+        payload = json.loads(line)
+    except json.JSONDecodeError:
+        _log(f"bench.py emitted unparseable line: {line[:200]}")
+        return False
+    platform = str(payload.get("platform", ""))
+    if platform.startswith("cpu") or payload.get("value") is None:
+        _log(f"bench ran but not on TPU (platform={platform!r}, "
+             f"value={payload.get('value')!r}); discarding")
+        return False
+    BENCH_OUT.write_text(line + "\n")
+    _log(f"captured on-TPU bench: value={payload['value']} ms, "
+         f"warm={payload.get('warm_tick_ms')} ms, "
+         f"moe={payload.get('moe_warm_tick_ms')} ms, "
+         f"tiny_put={payload.get('tiny_put_ms')} ms")
+    return True
+
+
+def _capture_fixtures(timeout_s: float) -> bool:
+    """profiler device --raw-out → tpu_v5e fixtures, verified by the pins."""
+    FIXDIR.mkdir(parents=True, exist_ok=True)
+    prof_path = FIXDIR / "tpu_v5e.json"
+    raw_path = FIXDIR / "tpu_v5e_raw.json"
+    rc, _, stderr = _run(
+        [
+            sys.executable, "-m", "distilp_tpu.cli.profiler_cli", "device",
+            "-r", "tests/configs/llama3_70b_4bit.json",
+            "-o", str(prof_path), "--raw-out", str(raw_path),
+        ],
+        timeout_s,
+    )
+    if rc != 0:
+        _log(f"profiler device failed (rc={rc}); stderr tail: "
+             f"{stderr.strip().splitlines()[-1:] or ''}")
+        return False
+    if not (prof_path.exists() and raw_path.exists()):
+        _log("profiler device rc=0 but fixtures missing")
+        return False
+    # Verify against the committed regression pins before trusting the
+    # capture; the pin suite runs on the guarded CPU platform.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    rc, out, err = _run(
+        [sys.executable, "-m", "pytest", "-x", "-q",
+         "tests/test_device_profiler.py", "-k", "TpuV5eGoldenArtifacts"],
+        600, env=env,
+    )
+    if rc != 0:
+        _log(f"fixture pins FAILED — discarding capture; tail: "
+             f"{(out + err).strip().splitlines()[-3:]}")
+        prof_path.unlink(missing_ok=True)
+        raw_path.unlink(missing_ok=True)
+        return False
+    _log("captured tpu_v5e device fixtures (pins pass)")
+    return True
+
+
+def _commit(paths: list[str], msg: str) -> None:
+    subprocess.run(["git", "add", "--"] + paths, cwd=str(REPO), check=False)
+    staged = subprocess.run(
+        ["git", "diff", "--cached", "--quiet"], cwd=str(REPO)
+    )
+    if staged.returncode == 0:
+        return  # nothing new
+    full = msg + "\n\nNo-Verification-Needed: benchmark/fixture artifact capture\n"
+    r = subprocess.run(
+        ["git", "commit", "-m", full], cwd=str(REPO),
+        capture_output=True, text=True,
+    )
+    _log(f"git commit rc={r.returncode}: {r.stdout.strip().splitlines()[-1:] or r.stderr.strip().splitlines()[-1:]}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--interval", type=float, default=180.0,
+                    help="seconds between probes (default 180)")
+    ap.add_argument("--probe-timeout", type=float, default=60.0)
+    ap.add_argument("--bench-timeout", type=float, default=2400.0)
+    ap.add_argument("--fixture-timeout", type=float, default=1800.0)
+    ap.add_argument("--max-hours", type=float, default=11.0,
+                    help="give up after this long (default 11h)")
+    ap.add_argument("--once", action="store_true",
+                    help="single probe+capture attempt, then exit")
+    args = ap.parse_args(argv)
+
+    deadline = time.monotonic() + args.max_hours * 3600.0
+    have_bench = False
+    have_fixtures = (FIXDIR / "tpu_v5e.json").exists() and (
+        FIXDIR / "tpu_v5e_raw.json").exists()
+    if have_fixtures:
+        _log("tpu_v5e fixtures already committed; watching for bench only")
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        platform = _probe_once(args.probe_timeout)
+        if platform is None or platform.startswith("cpu"):
+            _log(f"probe #{attempt}: backend={platform or 'wedged/down'}; "
+                 f"sleeping {args.interval:.0f}s")
+        else:
+            _log(f"probe #{attempt}: LIVE backend platform={platform!r} — capturing")
+            if not have_bench and _capture_bench(args.bench_timeout):
+                have_bench = True
+                _commit([str(BENCH_OUT.relative_to(REPO))],
+                        "Capture on-TPU benchmark artifact (live tunnel window)")
+            if not have_fixtures and _capture_fixtures(args.fixture_timeout):
+                have_fixtures = True
+                _commit(["tests/profiles/tpu_v5e"],
+                        "Capture measured tpu_v5e device fixtures on live TPU")
+            if have_bench and have_fixtures:
+                _log("all captures committed; done")
+                return 0
+        if args.once:
+            return 0 if (have_bench and have_fixtures) else 2
+        time.sleep(args.interval)
+    _log("deadline reached without a full capture")
+    return 3
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
