@@ -79,25 +79,33 @@ def _env_num(name: str, default: float) -> float:
         return default
 
 
-def _run_probe_once(timeout_s: float) -> tuple[int | None, str, str]:
-    """One probe attempt; (rc, stdout, stderr), rc None on timeout.
+def run_contained(
+    cmd: list[str],
+    timeout_s: float,
+    env: dict | None = None,
+    cwd: str | None = None,
+) -> tuple[int | None, str, str]:
+    """Run cmd wedge-contained; (rc, stdout, stderr), rc None on timeout.
 
     The child gets its own session and TEMP FILES for stdout/stderr (no
     pipes): the wedging plugin can spawn tunnel helpers that inherit pipe
     write-ends, and draining a pipe after a timeout would block on those
-    grandchildren — the exact hang this probe exists to contain. On timeout
-    the whole process group is killed.
+    grandchildren — the exact hang this containment exists for. On timeout
+    the whole process group is killed. Shared by the bench probe and
+    tools/tpu_watch.py so the containment has ONE implementation.
     """
     import signal
     import tempfile
 
     with tempfile.TemporaryFile("w+") as out, tempfile.TemporaryFile("w+") as err:
         proc = subprocess.Popen(
-            [sys.executable, "-c", _PROBE_SRC],
+            cmd,
             stdout=out,
             stderr=err,
             text=True,
             start_new_session=True,
+            env=env,
+            cwd=cwd,
         )
         try:
             rc: int | None = proc.wait(timeout=timeout_s)
@@ -113,6 +121,28 @@ def _run_probe_once(timeout_s: float) -> tuple[int | None, str, str]:
         return rc, out.read(), err.read()
 
 
+def _run_probe_once(timeout_s: float) -> tuple[int | None, str, str]:
+    """One backend-liveness probe attempt (see :func:`run_contained`)."""
+    return run_contained([sys.executable, "-c", _PROBE_SRC], timeout_s)
+
+
+def parse_probe_output(rc: int | None, stdout: str) -> str | None:
+    """Platform string from a probe attempt's output, None if not live.
+
+    The single parser of the probe's sentinel protocol (used here and by
+    tools/tpu_watch.py): scans for the LAST sentinel-tagged line so library
+    chatter before or after it never confuses the result.
+    """
+    if rc != 0:
+        return None
+    hits = [
+        ln
+        for ln in stdout.strip().splitlines()
+        if ln.startswith(_PROBE_SENTINEL + " ")
+    ]
+    return hits[-1].split()[1] if hits else None
+
+
 def _probe_backend() -> tuple[str | None, str]:
     """Return (platform, detail); platform is None if no backend came up."""
     timeout_s = max(5.0, _env_num("DPERF_BENCH_PROBE_TIMEOUT", 150))
@@ -125,13 +155,9 @@ def _probe_backend() -> tuple[str | None, str]:
         if rc is None:
             detail = f"probe timed out after {timeout_s}s (backend init wedged)"
             continue
-        hits = [
-            ln
-            for ln in stdout.strip().splitlines()
-            if ln.startswith(_PROBE_SENTINEL + " ")
-        ]
-        if rc == 0 and hits:
-            return hits[-1].split()[1], ""
+        platform = parse_probe_output(rc, stdout)
+        if platform is not None:
+            return platform, ""
         detail = (stderr.strip().splitlines() or ["probe failed with no output"])[-1]
     return None, detail
 

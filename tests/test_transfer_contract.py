@@ -1,0 +1,223 @@
+"""Platform-independent proof of the delta-upload (warm-tick wire) contract.
+
+The packed single-dispatch path claims (backend_jax.StandardForm docstring):
+a cold solve ships the drift-invariant static blob ONCE, and every
+subsequent warm streaming tick ships only the few-KB dynamic blob — on a
+tunneled TPU whose wire cost is per-operation, that contract IS the warm
+tick's latency floor. BENCH captures can only measure it when the tunnel is
+up; these tests pin it by construction, whatever the platform:
+
+- transfer COUNT: exactly one static upload per distinct fleet shape, every
+  drift tick a byte-identical static blob (content-addressed cache hit);
+- transfer SIZE: the per-tick dynamic blob stays small in absolute terms
+  and relative to the static blob, at dense M=16 and on the DeepSeek-V3
+  E=256 / 32-device flagship (warm + duals layout, the largest dynamic
+  blob the streaming path ever ships).
+
+Reference contrast: /root/reference/src/distilp/solver/halda_p_solver.py
+rebuilds and re-uploads the whole MILP every solve; the split is this
+repo's design, so these assertions have no reference counterpart.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+import pytest
+
+from distilp_tpu.common import load_from_profile_folder
+from distilp_tpu.solver import StreamingReplanner, backend_jax
+from distilp_tpu.utils import make_synthetic_fleet
+
+GAP = 1e-3
+
+# The "few KB" of the docstring, made exact: generous absolute ceilings so
+# legitimate layout growth doesn't trip them, tight ratio so the static
+# half always dominates (the contract is that warm ticks skip the BULK).
+DYN_CEILING_DENSE = 32 * 1024  # bytes, M=16 dense warm tick
+DYN_CEILING_MOE = 64 * 1024  # bytes, E=256 M=32 warm+duals tick
+STATIC_OVER_DYN_MIN = 4.0
+
+
+class _UploadSpy:
+    """Wraps _static_to_device / _pack_dynamic, recording every transfer."""
+
+    def __init__(self, monkeypatch):
+        self.static_events: list[tuple[bytes, bool]] = []  # (blob bytes, uploaded)
+        self.dyn_nbytes: list[int] = []
+        orig_static = backend_jax._static_to_device
+        orig_dyn = backend_jax._pack_dynamic
+
+        def spy_static(vec):
+            dev, uploaded = orig_static(vec)
+            self.static_events.append((vec.tobytes(), uploaded))
+            return dev, uploaded
+
+        def spy_dyn(*args, **kwargs):
+            blob = orig_dyn(*args, **kwargs)
+            self.dyn_nbytes.append(blob.nbytes)
+            return blob
+
+        monkeypatch.setattr(backend_jax, "_static_to_device", spy_static)
+        monkeypatch.setattr(backend_jax, "_pack_dynamic", spy_dyn)
+
+
+def test_warm_tick_ships_only_dynamic_blob(monkeypatch):
+    """Dense M=16 streaming: 1 static upload cold, 0 on drift ticks."""
+    _, model = load_from_profile_folder("tests/profiles/llama_3_70b/online")
+    devs = make_synthetic_fleet(16, seed=123)
+    backend_jax.clear_static_cache()
+    spy = _UploadSpy(monkeypatch)
+
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="4bit", backend="jax")
+    planner.step(devs, model)
+    assert len(spy.static_events) == 1
+    cold_blob, cold_uploaded = spy.static_events[0]
+    assert cold_uploaded, "cold solve must upload the static blob"
+    static_nbytes = len(cold_blob)
+    # The static half is the BULK (A, c-structural, boxes, slack minima).
+    assert static_nbytes > 10 * 1024, static_nbytes
+
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.95, 1.05)))
+        tick = planner.step(devs, model)
+        assert tick.certified
+
+    # Drift-class perturbation leaves the static blob byte-identical, so
+    # every warm tick is a content-addressed cache hit: ZERO static uploads.
+    assert len(spy.static_events) == 4
+    for blob, uploaded in spy.static_events[1:]:
+        assert blob == cold_blob, "t_comm drift leaked into the static half"
+        assert not uploaded, "warm tick re-uploaded the static blob"
+
+    # The per-tick wire footprint is the dynamic blob alone, and it's small.
+    assert len(spy.dyn_nbytes) == 4
+    for nbytes in spy.dyn_nbytes:
+        assert nbytes <= DYN_CEILING_DENSE, nbytes
+        assert static_nbytes >= STATIC_OVER_DYN_MIN * nbytes, (
+            static_nbytes, nbytes,
+        )
+
+
+def test_fleet_shape_change_is_a_cache_miss_not_a_wrong_solve(monkeypatch):
+    """Shrinking the fleet changes the static blob SHAPE: a NEW upload, not
+    a stale hit — cache misses degrade to cold-cost, never to a wrong
+    answer. (M=8 matches test_streaming's layout so the jit cache is warm
+    in a full-suite run.)"""
+    _, model = load_from_profile_folder("tests/profiles/llama_3_70b/online")
+    devs = make_synthetic_fleet(16, seed=123)
+    backend_jax.clear_static_cache()
+    spy = _UploadSpy(monkeypatch)
+
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="4bit", backend="jax")
+    planner.step(devs, model)
+    small = planner.step(devs[:8], model)  # fleet shrinks mid-stream
+    assert small.certified and len(small.w) == 8
+    assert sum(small.w) * small.k == model.L
+
+    assert len(spy.static_events) == 2
+    (blob16, up16), (blob8, up8) = spy.static_events
+    assert up16 and up8, "a new fleet shape must re-upload the static blob"
+    assert len(blob8) != len(blob16)
+    # ...and coming BACK to the original shape hits the bounded LRU cache.
+    planner.step(devs, model)
+    blob16b, up16b = spy.static_events[-1]
+    assert blob16b == blob16 and not up16b
+
+
+def test_moe_flagship_static_blob_drift_invariant():
+    """E=256 / 32-device flagship, host-side: the packed static half is
+    byte-identical under drift and the warm+duals dynamic blob is bounded.
+
+    Runs NO solve (the flagship compile costs minutes); the contract lives
+    entirely in the packing functions, so assembling the StandardForm twice
+    is enough to pin it.
+    """
+    from distilp_tpu.profiler.api import profile_model
+    from distilp_tpu.solver.api import _build_instance
+    from distilp_tpu.solver.backend_jax import (
+        _pack_dynamic,
+        _pack_static,
+        _rounding_arrays_np,
+        build_standard_form,
+    )
+
+    split = profile_model(
+        "tests/configs/deepseek_v3.json", batch_sizes=[1], sequence_length=128
+    )
+    model = split.to_model_profile()
+    devs = make_synthetic_fleet(32, seed=11, pool_bytes=int(32e9))
+
+    def build(fleet):
+        Ks, _, coeffs, arrays = _build_instance(
+            fleet, model, None, "8bit", None, None
+        )
+        feasible = [(k, model.L // k) for k in Ks if model.L // k >= len(fleet)]
+        sf = build_standard_form(arrays, coeffs, feasible)
+        return sf, coeffs, arrays, feasible
+
+    sf, coeffs, arrays, feasible = build(devs)
+    static0 = _pack_static(sf)
+
+    M = len(devs)
+    E = int(arrays.moe.E)
+    n_k = len(sf.ks)
+    # The largest dynamic blob the streaming path ships: warm incumbent +
+    # stored root multipliers (the warm+duals layout of a real MoE tick).
+    warm_tuple = (
+        0,
+        [model.L // sf.ks[0] // M] * M,
+        [1] * M,
+        [E // M] * M,
+    )
+    duals = (
+        np.zeros(n_k), np.zeros(n_k), np.zeros((n_k, M)),
+    )
+    dyn0 = _pack_dynamic(
+        sf, _rounding_arrays_np(coeffs, arrays.moe), GAP, warm_tuple, duals
+    )
+    assert dyn0.nbytes <= DYN_CEILING_MOE, dyn0.nbytes
+    assert static0.nbytes >= STATIC_OVER_DYN_MIN * dyn0.nbytes, (
+        static0.nbytes, dyn0.nbytes,
+    )
+
+    drifted = [copy.deepcopy(d) for d in devs]
+    rng = np.random.default_rng(3)
+    for d in drifted:
+        d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.95, 1.05)))
+    sf2, coeffs2, arrays2, _ = build(drifted)
+    static1 = _pack_static(sf2)
+    assert np.array_equal(static0, static1), (
+        "drift-class t_comm perturbation must not touch the static half"
+    )
+    # ...while the dynamic half DOES carry the drift (b rows move).
+    dyn1 = _pack_dynamic(
+        sf2, _rounding_arrays_np(coeffs2, arrays2.moe), GAP, warm_tuple, duals
+    )
+    assert dyn1.shape == dyn0.shape
+    assert not np.array_equal(dyn0, dyn1)
+
+
+def test_static_cache_lru_eviction_and_clear():
+    """The content-addressed cache is bounded and clearable; eviction brings
+    back the upload, never a stale array."""
+    backend_jax.clear_static_cache()
+    blobs = [np.full(8, float(i), np.float32) for i in range(
+        backend_jax._STATIC_CACHE_CAP + 2)]
+    for b in blobs:
+        _, uploaded = backend_jax._static_to_device(b)
+        assert uploaded
+    # Most recent CAP entries hit...
+    for b in blobs[-backend_jax._STATIC_CACHE_CAP:]:
+        _, uploaded = backend_jax._static_to_device(b)
+        assert not uploaded
+    # ...the evicted ones re-upload.
+    _, uploaded = backend_jax._static_to_device(blobs[0])
+    assert uploaded
+    backend_jax.clear_static_cache()
+    _, uploaded = backend_jax._static_to_device(blobs[-1])
+    assert uploaded
+    backend_jax.clear_static_cache()

@@ -30,10 +30,8 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import signal
 import subprocess
 import sys
-import tempfile
 import time
 from pathlib import Path
 
@@ -51,41 +49,15 @@ def _log(msg: str) -> None:
 
 
 def _run(cmd: list[str], timeout_s: float, env: dict | None = None) -> tuple[int | None, str, str]:
-    """Run cmd in its own session with temp-file IO; killpg on timeout.
-
-    Same containment as bench._run_probe_once: the wedging plugin can spawn
-    tunnel helpers that inherit pipe write-ends, so pipes are never used and
-    the whole process group is killed on timeout.
-    """
-    with tempfile.TemporaryFile("w+") as out, tempfile.TemporaryFile("w+") as err:
-        proc = subprocess.Popen(
-            cmd, stdout=out, stderr=err, text=True, cwd=str(REPO),
-            start_new_session=True, env=env,
-        )
-        try:
-            rc: int | None = proc.wait(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            rc = None
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
-            proc.wait()
-        out.seek(0)
-        err.seek(0)
-        return rc, out.read(), err.read()
+    """bench.run_contained pinned to the repo root (single shared
+    implementation of the session/temp-file/killpg wedge containment)."""
+    return bench.run_contained(cmd, timeout_s, env=env, cwd=str(REPO))
 
 
 def _probe_once(timeout_s: float) -> str | None:
     """One live-backend probe; returns the platform string or None."""
     rc, stdout, _ = bench._run_probe_once(timeout_s)
-    if rc != 0:
-        return None
-    hits = [
-        ln for ln in stdout.strip().splitlines()
-        if ln.startswith(bench._PROBE_SENTINEL + " ")
-    ]
-    return hits[-1].split()[1] if hits else None
+    return bench.parse_probe_output(rc, stdout)
 
 
 def _capture_bench(timeout_s: float) -> bool:
@@ -144,6 +116,20 @@ def _capture_fixtures(timeout_s: float) -> bool:
     if not (prof_path.exists() and raw_path.exists()):
         _log("profiler device rc=0 but fixtures missing")
         return False
+    # A probe->capture race can leave the profiler on a CPU fallback that
+    # exits 0; CPU-measured fixtures committed as tpu_v5e would poison the
+    # regression pins, so require hard TPU evidence in the raw DeviceInfo.
+    try:
+        raw = json.loads(raw_path.read_text())
+        gpu_name = str(raw.get("gpu", {}).get("name", ""))
+    except (json.JSONDecodeError, AttributeError):
+        gpu_name = ""
+    if gpu_name != "tpu":
+        _log(f"capture ran without a TPU accelerator (gpu.name={gpu_name!r}) "
+             "— discarding")
+        prof_path.unlink(missing_ok=True)
+        raw_path.unlink(missing_ok=True)
+        return False
     # Verify against the committed regression pins before trusting the
     # capture; the pin suite runs on the guarded CPU platform.
     env = dict(os.environ)
@@ -163,19 +149,31 @@ def _capture_fixtures(timeout_s: float) -> bool:
     return True
 
 
-def _commit(paths: list[str], msg: str) -> None:
+def _commit(paths: list[str], msg: str) -> bool:
+    """Stage paths and commit; True iff the artifacts are durably in git.
+
+    On commit failure the paths are UNSTAGED again so a later commit of the
+    other artifact cannot sweep them in under the wrong message, and the
+    caller keeps retrying on the next live window.
+    """
+    # Everything pathspec-scoped: unrelated content the operator may have
+    # staged must neither trigger nor ride along with an artifact commit.
     subprocess.run(["git", "add", "--"] + paths, cwd=str(REPO), check=False)
     staged = subprocess.run(
-        ["git", "diff", "--cached", "--quiet"], cwd=str(REPO)
+        ["git", "diff", "--cached", "--quiet", "--"] + paths, cwd=str(REPO)
     )
     if staged.returncode == 0:
-        return  # nothing new
+        return True  # nothing new to record — already committed
     full = msg + "\n\nNo-Verification-Needed: benchmark/fixture artifact capture\n"
     r = subprocess.run(
-        ["git", "commit", "-m", full], cwd=str(REPO),
+        ["git", "commit", "-m", full, "--"] + paths, cwd=str(REPO),
         capture_output=True, text=True,
     )
     _log(f"git commit rc={r.returncode}: {r.stdout.strip().splitlines()[-1:] or r.stderr.strip().splitlines()[-1:]}")
+    if r.returncode != 0:
+        subprocess.run(["git", "reset", "--"] + paths, cwd=str(REPO), check=False)
+        return False
+    return True
 
 
 def main(argv=None) -> int:
@@ -192,11 +190,21 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     deadline = time.monotonic() + args.max_hours * 3600.0
-    have_bench = False
+    # Restart-safe: a relaunched watcher must not burn a live window redoing
+    # a capture that is already on disk — but on-disk is not durable, so
+    # pre-existing artifacts are re-committed here (a no-op when they
+    # already are; retries a capture→crash→relaunch gap when they aren't).
+    have_bench = BENCH_OUT.exists() and _commit(
+        [str(BENCH_OUT.relative_to(REPO))],
+        "Capture on-TPU benchmark artifact (live tunnel window)")
     have_fixtures = (FIXDIR / "tpu_v5e.json").exists() and (
-        FIXDIR / "tpu_v5e_raw.json").exists()
+        FIXDIR / "tpu_v5e_raw.json").exists() and _commit(
+        ["tests/profiles/tpu_v5e"],
+        "Capture measured tpu_v5e device fixtures on live TPU")
+    if have_bench:
+        _log("on-TPU bench artifact already captured; not re-running it")
     if have_fixtures:
-        _log("tpu_v5e fixtures already committed; watching for bench only")
+        _log("tpu_v5e fixtures already captured; watching for bench only")
     attempt = 0
     while time.monotonic() < deadline:
         attempt += 1
@@ -207,13 +215,13 @@ def main(argv=None) -> int:
         else:
             _log(f"probe #{attempt}: LIVE backend platform={platform!r} — capturing")
             if not have_bench and _capture_bench(args.bench_timeout):
-                have_bench = True
-                _commit([str(BENCH_OUT.relative_to(REPO))],
-                        "Capture on-TPU benchmark artifact (live tunnel window)")
+                have_bench = _commit(
+                    [str(BENCH_OUT.relative_to(REPO))],
+                    "Capture on-TPU benchmark artifact (live tunnel window)")
             if not have_fixtures and _capture_fixtures(args.fixture_timeout):
-                have_fixtures = True
-                _commit(["tests/profiles/tpu_v5e"],
-                        "Capture measured tpu_v5e device fixtures on live TPU")
+                have_fixtures = _commit(
+                    ["tests/profiles/tpu_v5e"],
+                    "Capture measured tpu_v5e device fixtures on live TPU")
             if have_bench and have_fixtures:
                 _log("all captures committed; done")
                 return 0
