@@ -156,16 +156,22 @@ def halda_solve(
     - ``node_cap``: frontier capacity (overflow floors the certificate).
 
     ``timings``: pass a dict to receive the JAX backend's wall-clock
-    breakdown (pack/upload/solve+fetch milliseconds, see
-    ``solve_sweep_jax``).
+    breakdown (build/pack/upload/solve+fetch milliseconds, see
+    ``solve_sweep_jax``; ``build_ms`` is the host-side coefficient +
+    instance assembly added here).
 
     Returns the assignment minimizing the modeled per-round latency, with
     ``certified``/``gap`` reporting the optimality certificate; raises
     ``RuntimeError`` if no candidate k admits a feasible assignment.
     """
+    import time as _time
+
+    t0 = _time.perf_counter()
     Ks, sets, coeffs, arrays = _build_instance(
         devs, model, k_candidates, kv_bits, moe, load_factors, batch_size
     )
+    if timings is not None:
+        timings["build_ms"] = (_time.perf_counter() - t0) * 1e3
 
     per_k_objs: List[Tuple[int, Optional[float]]] = []
     best: Optional[ILPResult] = None

@@ -30,6 +30,7 @@ frontier overflow is tracked honestly via ``dropped_bound``.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from functools import partial
 from typing import List, NamedTuple, Optional, Sequence, Tuple
@@ -622,9 +623,29 @@ def _decomp_terms(
     Returns (lin, cyc, ok) each shaped (5, n_k, M, w_max, e_max+1); ``ok``
     masks infeasible cells (slack caps exceeded, w > W_j).
     """
+    w_vals = jnp.arange(1, w_max + 1, dtype=dtype)  # (W,)
+    lin, cyc, ok, y_vals = _decomp_terms_for_w(
+        rd, ks, Ws, w_vals, e_max, dtype, moe=moe
+    )
+    return lin, cyc, ok, w_vals, y_vals
+
+
+def _decomp_terms_for_w(
+    rd: RoundingData, ks, Ws, w_vals, e_max: int, dtype, moe: bool = True
+):
+    """The cell pricing of ``_decomp_terms`` over an ARBITRARY w slice.
+
+    One definition of the enumeration math, two consumers: the monolithic
+    full-(W, Y) tensors of the f32 ascent (``_decomp_terms``) and the
+    memory-lean f64 final evaluation that streams one w value per
+    ``lax.scan`` step (the full f64 tensor blows last-level cache on the
+    E=256 flagship — ~20 MB per array and a dozen arrays — so streaming it
+    is ~3.5x faster on a single host core and strictly fewer bytes live on
+    a TPU core). Returns (lin, cyc, ok, y_vals) shaped
+    (5, n_k, M, len(w_vals), e_max+1).
+    """
     M = rd.a.shape[0]
     bp = rd.bprime
-    w_vals = jnp.arange(1, w_max + 1, dtype=dtype)  # (W,)
     y_vals = jnp.arange(0, e_max + 1, dtype=dtype)  # (Y,)
     Wg = w_vals[None, None, :, None]  # (1, 1, W, 1)
     Yg = y_vals[None, None, None, :]  # (1, 1, 1, Y)
@@ -705,7 +726,7 @@ def _decomp_terms(
 
     lin = a * Wg + b_gpu * n_cands + pen_set * s_ram + pen_vram * t + g_k * Yg
     cyc = lin + busy_const + 0.5 * (bp_d / s_disk) * Wg
-    return lin, cyc, ok, w_vals, y_vals
+    return lin, cyc, ok, y_vals
 
 
 def _decomp_bound_roots(
@@ -834,31 +855,77 @@ def _decomp_bound_roots(
     else:
         # Zero-step (warm tick) path: the stored duals ARE the chosen
         # multipliers, so skip the whole f32 enumeration tensor and ascent
-        # machinery — only the rigorous f64 evaluation below runs, roughly
-        # halving the warm MoE device program.
+        # machinery — only the rigorous f64 evaluation below runs.
         best_params = params0
 
-    # Rigorous final evaluation: f64 pricing at the chosen multipliers.
-    lin64, cyc64, ok64, w64, y64 = _decomp_terms(
-        rd, ks, Ws, w_max, e_max, BDTYPE, moe=moe
-    )
+    # Rigorous final evaluation: f64 pricing at the chosen multipliers,
+    # STREAMED one w value per scan step. The monolithic (5, n_k, M, W, Y)
+    # f64 tensors blow last-level cache at flagship scale (E=256: ~20 MB
+    # per array, a dozen arrays live at once); per-w slices stay resident,
+    # and the min folds associatively so the streamed bound is the same
+    # f64 value bit for bit. The primal-hint argmin folds through the scan
+    # on cold solves; warm ticks (steps == 0) skip it entirely — their
+    # incumbent comes from the previous optimum re-priced, so tracking
+    # argmin indices would only re-buy the transpose/argmin traffic this
+    # streaming removes (hint ties may resolve to a different cell than
+    # the monolithic argmin did; the hint is a repair-and-reprice seed, so
+    # only the seed quality, never correctness, could differ).
+    track_hint = steps > 0
     lam, mu, tau = jax.tree.map(lambda p: p.astype(BDTYPE), best_params)
     theta = (ks - 1.0)[:, None] * jax.nn.softmax(tau, axis=1)
-    term = (
-        lin64
-        + theta[None, :, :, None, None] * cyc64
-        - lam[None, :, None, None, None] * w64[None, None, None, :, None]
-        - mu[None, :, None, None, None] * y64[None, None, None, None, :]
+    Y = e_max + 1
+    y64 = jnp.arange(0, Y, dtype=BDTYPE)
+
+    def w_step(carry, w_scalar):
+        best, any_ok = carry[0], carry[1]
+        w_slice = jnp.reshape(w_scalar, (1,))
+        lin64, cyc64, ok64, _ = _decomp_terms_for_w(
+            rd, ks, Ws, w_slice, e_max, BDTYPE, moe=moe
+        )
+        term = (
+            lin64
+            + theta[None, :, :, None, None] * cyc64
+            - lam[None, :, None, None, None] * w_scalar
+            - mu[None, :, None, None, None] * y64[None, None, None, None, :]
+        )
+        term = jnp.where(ok64, term, jnp.inf)
+        # (5, n_k, M, 1, Y) -> per-(k, i) min over (candidate, y).
+        t2 = jnp.transpose(term[:, :, :, 0, :], (1, 2, 0, 3)).reshape(
+            n_k, M, -1
+        )
+        slice_min = t2.min(axis=2)
+        any_ok = any_ok | jnp.any(ok64, axis=(0, 3, 4))
+        if not track_hint:
+            return (jnp.minimum(best, slice_min), any_ok), None
+        best_flat, best_w = carry[2], carry[3]
+        better = slice_min < best
+        best_flat = jnp.where(
+            better, t2.argmin(axis=2).astype(jnp.int32), best_flat
+        )
+        best_w = jnp.where(better, w_scalar, best_w)
+        return (jnp.minimum(best, slice_min), any_ok, best_flat, best_w), None
+
+    carry0 = [
+        jnp.full((n_k, M), jnp.inf, BDTYPE),
+        jnp.zeros((n_k, M), bool),
+    ]
+    if track_hint:
+        carry0 += [jnp.zeros((n_k, M), jnp.int32), jnp.ones((n_k, M), BDTYPE)]
+    carry, _ = jax.lax.scan(
+        w_step, tuple(carry0), jnp.arange(1, w_max + 1, dtype=BDTYPE)
     )
-    term = jnp.where(ok64, term, jnp.inf)
-    per_dev = jnp.min(term, axis=(0, 3, 4))  # (n_k, M)
+    per_dev = carry[0]  # (n_k, M)
     bound = per_dev.sum(axis=1) + lam * Ws + mu * rd.E
     # A device with NO feasible cell proves the whole k infeasible (+inf is
     # the honest bound); a non-finite optimization artifact must degrade to
     # -inf (vacuous) instead.
-    any_feasible = jnp.any(ok64, axis=(0, 3, 4)).all(axis=1)
+    any_feasible = carry[1].all(axis=1)
     bound = jnp.where(jnp.isnan(bound), -jnp.inf, bound)
     bound = jnp.where(any_feasible, bound, jnp.inf)
+
+    if not track_hint:
+        zeros = jnp.zeros((n_k, M), BDTYPE)
+        return bound, zeros, zeros, zeros, (lam, mu, tau)
 
     # Lagrangian primal hint: each device's argmin cell at the chosen
     # multipliers, INCLUDING its optimal n-candidate (leaving n at zero
@@ -866,17 +933,10 @@ def _decomp_bound_roots(
     # exactly W near the dual optimum and sum(y*) within ~E/2 of E; the
     # caller repairs and exact-prices it as an incumbent candidate (LP
     # rounding alone lands far from the optimum on wide-expert instances).
-    Y = e_max + 1
-    WY = w_max * Y
-    n_cand_count = term.shape[0]
-    t_flat = jnp.transpose(term, (1, 2, 0, 3, 4)).reshape(
-        n_k, M, n_cand_count * WY
-    )
-    flat = t_flat.argmin(axis=2)
-    c_star = flat // WY
-    rest = flat % WY
-    w_star = (rest // Y + 1).astype(BDTYPE)
-    y_star = (rest % Y).astype(BDTYPE)
+    flat = carry[2]
+    c_star = flat // Y
+    w_star = carry[3]
+    y_star = (flat % Y).astype(BDTYPE)
     # Reconstruct the n value of the chosen candidate: 0, w, the VRAM
     # boundary, or the RAM-slack kink (mirrors the n_cands construction in
     # _decomp_terms).
@@ -1322,6 +1382,15 @@ def _seed_root_bounds(
         node_bound=state.node_bound.at[:n_k].set(root_bounds)
     )
 
+    if decomp_steps == 0:
+        # Warm tick: the bound evaluation at the stored duals is all the
+        # certificate needs — the incumbent is the previous tick's optimum,
+        # re-priced exactly by the ``has_warm`` block, which beats a fresh
+        # Lagrangian-primal repair essentially always. Skipping the repair
+        # removes an (e_max + 4)-step sequential scan (260 steps at E=256,
+        # each pricing 2M candidate vectors) from the warm device program.
+        return state, duals
+
     # Seed the incumbent from the Lagrangian primal: repair each k's
     # per-device argmin cells to a feasible placement (greedy exact-priced
     # y repair, scan budget E) and keep the best. On wide-expert instances
@@ -1469,28 +1538,52 @@ def _pack_dynamic(
 # tens of microseconds), bounded to the last few distinct instances. Cache
 # misses are always CORRECT — they just pay the full upload — so drift that
 # does perturb the static half (e.g. a t_comm spike crossing a row-scale
-# boundary) degrades to round-2 behavior, never to a wrong solve.
+# boundary) degrades to round-2 behavior, never to a wrong solve. The lock
+# covers host-thread races on the list (concurrent solves from multiple
+# threads would otherwise lose entries or double-upload — correctness-
+# neutral but contradicting the warm-tick wire-cost contract).
 _STATIC_CACHE: List[Tuple[np.ndarray, jax.Array]] = []
 _STATIC_CACHE_CAP = 4
+_STATIC_CACHE_LOCK = threading.Lock()
+
+
+def _entry_alive(dev: jax.Array) -> bool:
+    """A cached device buffer is reusable only while its backend lives: a
+    torn-down backend (or a reconnected tunnel) deletes buffers, and
+    dispatching against one fails with an opaque runtime error — treat it
+    as a miss and re-upload instead."""
+    try:
+        if dev.is_deleted():
+            return False
+        return all(d in jax.devices() for d in dev.devices())
+    except Exception:  # noqa: BLE001 - any probe failure means "dead"
+        return False
 
 
 def _static_to_device(vec: np.ndarray) -> Tuple[jax.Array, bool]:
     """(device array, uploaded-this-call). Reuses a cached device copy when
-    the packed static bytes match a recent instance."""
-    for i, (host, dev) in enumerate(_STATIC_CACHE):
-        if host.shape == vec.shape and np.array_equal(host, vec):
-            if i != len(_STATIC_CACHE) - 1:  # LRU bump
-                _STATIC_CACHE.append(_STATIC_CACHE.pop(i))
-            return dev, False
+    the packed static bytes match a recent instance AND its buffer is still
+    alive on a current device."""
+    with _STATIC_CACHE_LOCK:
+        for i, (host, dev) in enumerate(_STATIC_CACHE):
+            if host.shape == vec.shape and np.array_equal(host, vec):
+                if not _entry_alive(dev):
+                    del _STATIC_CACHE[i]
+                    break
+                if i != len(_STATIC_CACHE) - 1:  # LRU bump
+                    _STATIC_CACHE.append(_STATIC_CACHE.pop(i))
+                return dev, False
     dev = jnp.asarray(vec)
-    _STATIC_CACHE.append((vec, dev))
-    del _STATIC_CACHE[:-_STATIC_CACHE_CAP]
+    with _STATIC_CACHE_LOCK:
+        _STATIC_CACHE.append((vec, dev))
+        del _STATIC_CACHE[:-_STATIC_CACHE_CAP]
     return dev, True
 
 
 def clear_static_cache() -> None:
     """Drop cached device-resident static blobs (tests; device teardown)."""
-    _STATIC_CACHE.clear()
+    with _STATIC_CACHE_LOCK:
+        _STATIC_CACHE.clear()
 
 
 _RD_VEC_FIELDS = (
@@ -2032,8 +2125,16 @@ def solve_sweep_jax(
     if sf.moe:
         w_max = max(W for _, W in feasible)
         e_max = int(arrays.moe.E)
+        # Zero-step (warm) mode needs BOTH: the stored duals to evaluate
+        # the bound at, and a warm incumbent to seed the search — steps=0
+        # also skips the Lagrangian primal repair, so a duals-without-hint
+        # call (e.g. a k-grid change that invalidates the hint but not the
+        # multiplier shapes) must pay the full ascent or it would start
+        # with no incumbent at all.
         decomp_steps = (
-            DECOMP_STEPS_WARM if duals_tuple is not None else DECOMP_STEPS_COLD
+            DECOMP_STEPS_WARM
+            if duals_tuple is not None and warm_tuple is not None
+            else DECOMP_STEPS_COLD
         )
     else:
         w_max = e_max = decomp_steps = 0
@@ -2426,7 +2527,11 @@ def solve_sweep_scenarios(
     if sf.moe:
         w_max = max(W for _, W in feasible)
         e_max = int(arrays_list[0].moe.E)
-        decomp_steps = DECOMP_STEPS_WARM if use_duals else DECOMP_STEPS_COLD
+        # Same both-or-cold rule as the single-dispatch path: steps=0 skips
+        # the primal repair, which is only sound with a warm incumbent.
+        decomp_steps = (
+            DECOMP_STEPS_WARM if use_duals and use_warm else DECOMP_STEPS_COLD
+        )
     else:
         w_max = e_max = decomp_steps = 0
 

@@ -55,8 +55,15 @@ class StreamingReplanner:
         devs: Sequence[DeviceProfile],
         model: ModelProfile,
         k_candidates: Optional[Sequence[int]] = None,
+        timings: Optional[dict] = None,
     ) -> HALDAResult:
         """One tick: re-solve under the current profiles, warm when possible.
+
+        ``timings`` (a dict, JAX backend) receives the tick's wall-clock
+        breakdown: ``build_ms``/``pack_ms``/``upload_ms``/``solve_ms``/
+        ``static_hit`` (see ``halda_solve``) — a stale-dual cold fallback
+        overwrites the dict with the fallback solve's numbers, which ARE
+        that tick's cost.
 
         When the profile carries skewed ``expert_loads`` (refreshed per tick
         from router statistics), the tick prices each device's y-units at
@@ -96,6 +103,7 @@ class StreamingReplanner:
             moe=self.moe,
             warm=warm,
             load_factors=factors,
+            timings=timings,
         )
         if warm is not None and warm.duals is not None and not result.certified:
             # A warm MoE tick certifies against the bound EVALUATED at the
@@ -114,6 +122,7 @@ class StreamingReplanner:
                 backend=self.backend,
                 moe=self.moe,
                 load_factors=factors,
+                timings=timings,
             )
 
         if loads is not None and result.y is not None:

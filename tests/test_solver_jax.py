@@ -148,8 +148,11 @@ def test_timings_breakdown_populated(profiles_dir):
         devs, model, kv_bits="4bit", mip_gap=1e-3, backend="jax", timings=tm
     )
     assert result.certified
-    assert set(tm) == {"pack_ms", "upload_ms", "solve_ms", "static_hit"}
+    assert set(tm) == {
+        "build_ms", "pack_ms", "upload_ms", "solve_ms", "static_hit"
+    }
     assert all(v >= 0 for v in tm.values())
+    assert tm["build_ms"] > 0
     assert tm["solve_ms"] > 0
     assert tm["static_hit"] in (0.0, 1.0)
 
