@@ -428,7 +428,16 @@ def test_solver_cli_per_k(tmp_path, capsys):
     )
     assert rc == 0
     out = capsys.readouterr().out
-    assert out.count("True") == 9  # all 9 feasible k's certified
+    # Parse the per-k table rows (k / objective / certified / assignment)
+    # rather than substring-counting "True" across the whole capture, which
+    # any future status line could inflate.
+    rows = [
+        ln.split()
+        for ln in out.splitlines()
+        if ln.strip() and ln.split()[0].isdigit()
+    ]
+    assert len(rows) == 9  # all 9 feasible k's reported
+    assert all(r[2] == "True" for r in rows)  # ...each one certified
     assert "Best: k=40" in out
     saved = json.loads(sol.read_text())
     assert saved["k"] == 40 and saved["certified"] is True

@@ -151,6 +151,101 @@ def test_interconnect_dcn_split_virtual_mesh():
     assert info.dcn_latency_s > 0 and info.dcn_bandwidth > 0
 
 
+def test_cross_slice_pricing_steers_placement():
+    """End-to-end profiler->solver loop (the reference never closes it: its
+    t_comm is a hand-edited scalar): MEASURED ICI/DCN numbers from a fake
+    2-slice virtual mesh price a 2-device fleet's t_comm via
+    ``estimate_t_comm`` — the within-slice device on the ICI link, the
+    cross-boundary device on the DCN link — and the solver must (a) pay
+    strictly more for the fleet whose hop crosses the slice boundary on the
+    slower link, and (b) shift layers OFF the boundary device once the
+    measured link difference exceeds two per-layer compute costs (exchange
+    argument: with k=2 and otherwise-identical devices, moving one layer
+    off the busier device strictly lowers the cycle max)."""
+    import copy
+
+    from distilp_tpu.common import load_model_profile
+    from distilp_tpu.profiler.topology import estimate_t_comm, measure_interconnect
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.solver.coeffs import build_coeffs
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    info = measure_interconnect(
+        latency_iters=3, bandwidth_mb=1, slice_of=lambda d: d.id % 2
+    )
+    assert info.num_slices == 2
+    info_ici = info.model_copy(update={"num_slices": 1})
+
+    model = load_model_profile(
+        Path(__file__).resolve().parent
+        / "profiles" / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    base = make_synthetic_fleet(1, seed=5)[0]
+
+    def fleet(t_boundary: float, t_within: float):
+        d0 = copy.deepcopy(base)
+        d1 = copy.deepcopy(base)
+        d0.name, d1.name = "within-slice", "cross-boundary"
+        d0.is_head, d1.is_head = True, False
+        d0.t_comm, d1.t_comm = t_within, t_boundary
+        # Thread the link terms exactly as profiler.device does (same
+        # link-selection rule), so the profile records WHICH link priced it.
+        d0.comm_latency = info.ici_allreduce_latency_s
+        d0.comm_bandwidth = info.ici_bandwidth
+        d1.comm_latency = info.dcn_latency_s
+        d1.comm_bandwidth = info.dcn_bandwidth
+        return [d0, d1]
+
+    # Worst-case marginal cost of moving one layer between these devices:
+    # compute (a, b_gpu) plus the slack/VRAM penalty staircases a layer can
+    # cross on the receiving device. A link delta of twice this FORCES a
+    # shift (exchange argument: at equal w the busier side exceeds the
+    # other by >= 2x the worst exchange cost, so moving one layer strictly
+    # lowers the k=2 cycle max whatever penalties it triggers).
+    c = build_coeffs(fleet(0.0, 0.0), model, kv_factor=0.5)
+    alpha = float(
+        (abs(c.a) + abs(c.b_gpu) + c.pen_m1 + c.pen_vram).max()
+    )
+
+    # Find a payload where the measured DCN-vs-ICI delta forces the shift:
+    # delta(X) = (lat_d - lat_i) + X * (1/bw_d - 1/bw_i), monotone in X.
+    lat_i, bw_i = info.ici_allreduce_latency_s, info.ici_bandwidth
+    lat_d, bw_d = info.dcn_latency_s, info.dcn_bandwidth
+    assert bw_i > 0 and bw_d > 0
+    slope = 1.0 / bw_d - 1.0 / bw_i
+    need = 2.0 * alpha
+    if abs(lat_d - lat_i) >= need:
+        payload = 1
+    elif abs(slope) > 1e-18:
+        # Aim past the target on the side the slope grows toward.
+        payload = int(abs((need * (1 if slope > 0 else -1) - (lat_d - lat_i)) / slope)) + 1
+    else:
+        pytest.skip("virtual mesh measured identical ICI and DCN links")
+    t_within = estimate_t_comm(payload, info_ici)
+    t_cross = estimate_t_comm(payload, info)
+    delta = t_cross - t_within
+    if abs(delta) < need:
+        pytest.skip(f"measured link delta {delta:.3g}s below 2*alpha {need:.3g}s")
+
+    # Price both devices on the faster effective link, then move the
+    # boundary device onto the slower one. k=2 pins the cycle term.
+    t_fast, t_slow = min(t_within, t_cross), max(t_within, t_cross)
+    uniform = halda_solve(
+        fleet(t_fast, t_fast), model, k_candidates=[2], kv_bits="4bit",
+        mip_gap=1e-4, backend="cpu",
+    )
+    split = halda_solve(
+        fleet(t_slow, t_fast), model, k_candidates=[2], kv_bits="4bit",
+        mip_gap=1e-4, backend="cpu",
+    )
+    # (a) the boundary hop costs real objective, not just bookkeeping...
+    assert split.obj_value > uniform.obj_value
+    # (b) ...and the measured delta moved the placement: layers shift off
+    # the device paying the slower link.
+    assert split.w[1] < uniform.w[1]
+    assert sum(split.w) * split.k == model.L
+
+
 def test_estimate_t_comm_reproduces_fixture_order_of_magnitude():
     """The reference's only multi-device fixture carries a HAND-measured
     t_comm of 0.06355 s (test/profiles/llama_3_70b/online/m1.json, a

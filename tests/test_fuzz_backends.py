@@ -92,6 +92,36 @@ def test_fuzz_moe_backends_agree(seed):
     assert sum(got.y) == model.n_routed_experts
 
 
+@pytest.mark.parametrize("seed", [11, 29])
+def test_fuzz_moe_extreme_load_skew_agrees(seed):
+    """One device carrying ~90% of the realized expert load: the MoE g
+    entries then dwarf the row's other coefficients (row scaling excludes
+    g from the row magnitude, so scaled A entries land far above 1), and
+    the f32 IPM must still agree with the f64 HiGHS oracle. Guards the
+    conditioning regime the moderate-skew fuzz above never reaches."""
+    rng = np.random.default_rng(seed)
+    model = profile_model(
+        "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+    M = int(rng.choice([3, 4]))
+    devs = _perturb_fleet(
+        make_synthetic_fleet(M, seed=seed, pool_bytes=int(96e9)), rng
+    )
+    # ~90% of the load on one device, the rest sharing the remainder.
+    hot = int(rng.integers(M))
+    factors = [0.9 * M if i == hot else 0.1 * M / (M - 1) for i in range(M)]
+    ref = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="8bit", backend="cpu",
+        load_factors=factors,
+    )
+    got = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="8bit", backend="jax",
+        load_factors=factors,
+    )
+    _agree(ref, got)
+    assert sum(got.y) == model.n_routed_experts
+
+
 def test_fuzz_streaming_drift_stays_certified(profiles_dir):
     """A long drift run: 8 warm ticks under compounding perturbation must
     stay certified and keep matching a cold solve at the end."""

@@ -24,7 +24,6 @@ from __future__ import annotations
 import copy
 
 import numpy as np
-import pytest
 
 from distilp_tpu.common import load_from_profile_folder
 from distilp_tpu.solver import StreamingReplanner, backend_jax

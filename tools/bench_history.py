@@ -48,7 +48,10 @@ def fmt(v, suffix=""):
     if v is None:
         return "—"
     if isinstance(v, float):
-        return f"{v:.1f}{suffix}"
+        # %g keeps sub-millisecond values (tiny_put_ms — the wire-condition
+        # diagnostic this tool exists to surface) distinguishable instead of
+        # collapsing every run to "0.0", while big numbers stay compact.
+        return f"{v:.4g}{suffix}"
     return f"{v}{suffix}"
 
 
