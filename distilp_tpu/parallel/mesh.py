@@ -139,7 +139,7 @@ def solve_sweep_sharded(
         # single-chip packed path: without them, wide-expert MoE instances
         # cannot close the structural LP root gap and the sharded sweep
         # would silently miss the certificate the single-chip path earns.
-        state, _ = _seed_root_bounds(
+        state, _, _, _ = _seed_root_bounds(
             state,
             rd,
             jnp.asarray(sf.ks, BDTYPE),

@@ -124,6 +124,7 @@ def halda_solve(
     timings: Optional[dict] = None,
     load_factors: Optional[Sequence[float]] = None,
     batch_size: int = 1,
+    margin_state: Optional[dict] = None,
 ) -> HALDAResult:
     """Pick the best (k, w, n[, y]) placement over all candidate segment counts.
 
@@ -159,6 +160,12 @@ def halda_solve(
     breakdown (build/pack/upload/solve+fetch milliseconds, see
     ``solve_sweep_jax``; ``build_ms`` is the host-side coefficient +
     instance assembly added here).
+
+    ``margin_state``: a dict threaded across streaming MoE ticks enabling
+    the margin fast path (previous tick's decomposition bounds reused
+    under a rigorous host-computed drift margin — see
+    ``backend_jax.margin_bounds_from_state``). ``StreamingReplanner``
+    manages one automatically; direct callers may pass their own.
 
     Returns the assignment minimizing the modeled per-round latency, with
     ``certified``/``gap`` reporting the optimality certificate; raises
@@ -197,6 +204,7 @@ def halda_solve(
             ipm_iters=ipm_iters,
             node_cap=node_cap,
             timings=timings,
+            margin_state=margin_state,
         )
         for k, res in zip(Ks, results):
             per_k_objs.append((k, res.obj_value if res is not None else None))

@@ -85,14 +85,22 @@ def default_search_params(moe: bool, n_k: int) -> Tuple[int, int, int]:
 
     Dense HALDA trees certify in a couple of rounds with a handful of live
     nodes, so a small frontier and a short IPM keep the one-dispatch program
-    lean (measured on the v5e north-star instance: cap 64 / beam 8 / 14 iters
-    certifies identically to cap 256 / beam 16 / 26 and shaves ~1/3 of the
-    device program). Wide-expert MoE instances (E up to 256) need the full
-    budget. Callers override any of these through ``halda_solve``.
+    lean. Measured across the four golden fixtures plus 16 perturbed
+    synthetic fleets at M in {3..16} (every dense fuzz instance in the
+    suite): beam 6 / 8 iters certifies + matches the HiGHS oracle
+    everywhere and halves the M=16 north-star device program (30 -> 15 ms
+    on a single host core). The edges are real: beam 4 starves one hard
+    perturbed M=6 fleet's frontier (gap stalls at 0.019) and 6 iters'
+    duals are too weak for one M=5 fleet (0.0101) — both failures are
+    honest (certified=False), since fewer iters/rows can only LOOSEN
+    bounds, never invalidate them: the bound is evaluated in f64 from
+    whatever dual the iteration reached (see ops/ipm.py). Wide-expert MoE
+    instances (E up to 256) need the full budget. Callers override any of
+    these through ``halda_solve``.
     """
     if moe:
         return NODE_CAP, BEAM, IPM_ITERS
-    return max(64, 2 * n_k), 8, 14
+    return max(64, 2 * n_k), 6, 8
 
 
 def _resolve_search_params(
@@ -877,7 +885,7 @@ def _decomp_bound_roots(
     y64 = jnp.arange(0, Y, dtype=BDTYPE)
 
     def w_step(carry, w_scalar):
-        best, any_ok = carry[0], carry[1]
+        m_y, any_ok = carry[0], carry[1]
         w_slice = jnp.reshape(w_scalar, (1,))
         lin64, cyc64, ok64, _ = _decomp_terms_for_w(
             rd, ks, Ws, w_slice, e_max, BDTYPE, moe=moe
@@ -889,32 +897,44 @@ def _decomp_bound_roots(
             - mu[None, :, None, None, None] * y64[None, None, None, None, :]
         )
         term = jnp.where(ok64, term, jnp.inf)
-        # (5, n_k, M, 1, Y) -> per-(k, i) min over (candidate, y).
+        # (5, n_k, M, 1, Y) -> per-(k, i, y) min over the n-candidate dim:
+        # folding the y-PROFILE (not just the scalar min) is what the
+        # margin fast path reuses — the g-term is linear in y, so a later
+        # tick can shift the profile by (1+theta)*dg*y/k and re-min
+        # EXACTLY, host-side (see ``margin_bounds_from_state``).
+        c_min = term[:, :, :, 0, :].min(axis=0)  # (n_k, M, Y)
+        m_y = jnp.minimum(m_y, c_min)
+        any_ok = any_ok | jnp.any(ok64, axis=(0, 3, 4))
+        if not track_hint:
+            return (m_y, any_ok), None
+        best, best_flat, best_w = carry[2], carry[3], carry[4]
         t2 = jnp.transpose(term[:, :, :, 0, :], (1, 2, 0, 3)).reshape(
             n_k, M, -1
         )
         slice_min = t2.min(axis=2)
-        any_ok = any_ok | jnp.any(ok64, axis=(0, 3, 4))
-        if not track_hint:
-            return (jnp.minimum(best, slice_min), any_ok), None
-        best_flat, best_w = carry[2], carry[3]
         better = slice_min < best
         best_flat = jnp.where(
             better, t2.argmin(axis=2).astype(jnp.int32), best_flat
         )
         best_w = jnp.where(better, w_scalar, best_w)
-        return (jnp.minimum(best, slice_min), any_ok, best_flat, best_w), None
+        return (m_y, any_ok, jnp.minimum(best, slice_min), best_flat,
+                best_w), None
 
     carry0 = [
-        jnp.full((n_k, M), jnp.inf, BDTYPE),
+        jnp.full((n_k, M, Y), jnp.inf, BDTYPE),
         jnp.zeros((n_k, M), bool),
     ]
     if track_hint:
-        carry0 += [jnp.zeros((n_k, M), jnp.int32), jnp.ones((n_k, M), BDTYPE)]
+        carry0 += [
+            jnp.full((n_k, M), jnp.inf, BDTYPE),
+            jnp.zeros((n_k, M), jnp.int32),
+            jnp.ones((n_k, M), BDTYPE),
+        ]
     carry, _ = jax.lax.scan(
         w_step, tuple(carry0), jnp.arange(1, w_max + 1, dtype=BDTYPE)
     )
-    per_dev = carry[0]  # (n_k, M)
+    m_y = carry[0]  # (n_k, M, Y)
+    per_dev = m_y.min(axis=2)  # (n_k, M)
     bound = per_dev.sum(axis=1) + lam * Ws + mu * rd.E
     # A device with NO feasible cell proves the whole k infeasible (+inf is
     # the honest bound); a non-finite optimization artifact must degrade to
@@ -925,7 +945,7 @@ def _decomp_bound_roots(
 
     if not track_hint:
         zeros = jnp.zeros((n_k, M), BDTYPE)
-        return bound, zeros, zeros, zeros, (lam, mu, tau)
+        return bound, zeros, zeros, zeros, (lam, mu, tau), m_y
 
     # Lagrangian primal hint: each device's argmin cell at the chosen
     # multipliers, INCLUDING its optimal n-candidate (leaving n at zero
@@ -933,9 +953,9 @@ def _decomp_bound_roots(
     # exactly W near the dual optimum and sum(y*) within ~E/2 of E; the
     # caller repairs and exact-prices it as an incumbent candidate (LP
     # rounding alone lands far from the optimum on wide-expert instances).
-    flat = carry[2]
+    flat = carry[3]
     c_star = flat // Y
-    w_star = carry[3]
+    w_star = carry[4]
     y_star = (flat % Y).astype(BDTYPE)
     # Reconstruct the n value of the chosen candidate: 0, w, the VRAM
     # boundary, or the RAM-slack kink (mirrors the n_cands construction in
@@ -990,7 +1010,7 @@ def _decomp_bound_roots(
             ),
         ),
     )
-    return bound, w_star, n_star, y_star, (lam, mu, tau)
+    return bound, w_star, n_star, y_star, (lam, mu, tau), m_y
 
 
 class SearchState(NamedTuple):
@@ -1360,8 +1380,13 @@ def _seed_root_bounds(
     e_max: int,
     decomp_steps: int,
     init_duals: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
-) -> Tuple[SearchState, Tuple[jax.Array, ...]]:
-    """Root Lagrangian decomposition bounds + primal incumbent seeding.
+) -> Tuple[SearchState, Tuple[jax.Array, ...], jax.Array, jax.Array]:
+    """Root Lagrangian decomposition bounds + primal incumbent seeding;
+    returns ``(state, duals, raw_bounds, m_y)`` — the raw
+    (pre-``obj_const``) per-k bounds and the per-device y-profile
+    ``m_y[k, i, y] = min over (candidate, w) of the dual term`` ride the
+    solve output so streaming ticks can reuse them (the margin fast path
+    in ``solve_sweep_jax``).
 
     Per-device integrality the LP relaxation cannot express: children
     inherit the bounds through the max(ipm, parent) in ``_bnb_round``, and
@@ -1373,7 +1398,7 @@ def _seed_root_bounds(
     single-chip-only property.
     """
     n_k = ks.shape[0]
-    raw_bounds, w_star, n_star, y_star, duals = _decomp_bound_roots(
+    raw_bounds, w_star, n_star, y_star, duals, m_y = _decomp_bound_roots(
         rd, ks, Ws, w_max, e_max, steps=decomp_steps, moe=moe,
         init_params=init_duals,
     )
@@ -1389,7 +1414,7 @@ def _seed_root_bounds(
         # Lagrangian-primal repair essentially always. Skipping the repair
         # removes an (e_max + 4)-step sequential scan (260 steps at E=256,
         # each pricing 2M candidate vectors) from the warm device program.
-        return state, duals
+        return state, duals, raw_bounds, m_y
 
     # Seed the incumbent from the Lagrangian primal: repair each k's
     # per-device argmin cells to a feasible placement (greedy exact-priced
@@ -1425,7 +1450,7 @@ def _seed_root_bounds(
         per_k_n=jnp.where(seeded_k[:, None], lag_n, state.per_k_n),
         per_k_y=jnp.where(seeded_k[:, None], lag_y, state.per_k_y),
     )
-    return state, duals
+    return state, duals, raw_bounds, m_y
 
 
 def _pack_static(sf: StandardForm) -> np.ndarray:
@@ -1471,6 +1496,7 @@ def _pack_dynamic(
     mip_gap: float,
     warm: Optional[Tuple[int, Sequence[int], Sequence[int], Sequence[int]]] = None,
     duals: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+    margin: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Flatten the PER-TICK half of a sweep into one float32 vector.
 
@@ -1494,6 +1520,11 @@ def _pack_dynamic(
     ``duals`` = (lam (n_k,), mu (n_k,), tau (n_k, M)) warm-starts the
     Lagrangian root ascent from a previous tick's best multipliers (see
     ``_decomp_bound_roots``); gated by the static ``has_duals``.
+
+    ``margin`` = (n_k,) pre-slackened raw decomp bounds from the previous
+    tick (the margin fast path: host-side drift accounting replaces the
+    on-device bound evaluation entirely); gated by the static
+    ``has_margin``.
     """
     M = sf.M
     f32_parts = [np.asarray(sf.b_k, np.float32).ravel()]
@@ -1525,6 +1556,8 @@ def _pack_dynamic(
                  np.asarray(tau, np.float64).ravel()]
             )
         )
+    if margin is not None:
+        f64_parts.append(np.asarray(margin, np.float64).ravel())
     f64_bits = np.ascontiguousarray(
         np.concatenate(f64_parts, dtype=np.float64)
     ).view(np.float32)
@@ -1608,6 +1641,7 @@ _RD_VEC_FIELDS = (
 _PACKED_STATIC_ARGS = (
     "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
     "has_warm", "w_max", "e_max", "decomp_steps", "has_duals", "per_k",
+    "has_margin",
 )
 
 
@@ -1629,6 +1663,7 @@ def _solve_packed_impl(
     decomp_steps: int = 0,
     has_duals: bool = False,
     per_k: bool = False,
+    has_margin: bool = False,
 ) -> jax.Array:
     """One-dispatch sweep: unpack the two blobs (``_pack_static`` stays
     device-resident across streaming ticks; ``_pack_dynamic`` is the per-tick
@@ -1639,16 +1674,23 @@ def _solve_packed_impl(
         [incumbent, best_bound, inc_kidx, dropped_bound,
          inc_w (M), inc_n (M), inc_y (M), per_k_best (n_k)]
 
-    When the root decomposition runs (``decomp_steps > 0 and w_max > 0``) the
-    chosen Lagrangian multipliers are appended as
-    ``[lam (n_k), mu (n_k), tau (n_k*M)]`` so the caller can persist them and
-    warm-start the next streaming tick's ascent (``has_duals``).
+    When the root decomposition runs (``decomp_steps >= 0 and w_max > 0``)
+    the chosen Lagrangian multipliers and the raw per-k bounds are appended
+    as ``[lam (n_k), mu (n_k), tau (n_k*M), root_bounds (n_k)]`` so the
+    caller can persist them and warm-start the next streaming tick's ascent
+    (``has_duals``) or reuse the bounds via the margin fast path
+    (``has_margin``: the per-k root bounds come pre-slackened from the host
+    in the dynamic blob and NO decomposition program is traced at all —
+    the duals pass through unchanged).
 
     ``per_k`` appends the per-k certified output —
     ``[per_k_w (n_k*M), per_k_n (n_k*M), per_k_y (n_k*M),
     per_k_bound (n_k)]`` — and switches the search to per-k pruning (every
     feasible k terminates with its own optimum and certificate).
     """
+    assert not has_margin or (has_duals and has_warm), (
+        "margin fast path requires stored duals AND a warm incumbent"
+    )
     lay = VarLayout(M, moe)
     N = lay.n_vars
     m_ub = m - lay.n_eq
@@ -1714,6 +1756,7 @@ def _solve_packed_impl(
         d_mu = take(n_k)
         d_tau = take(n_k * M).reshape(n_k, M)
         init_duals = (d_lam, d_mu, d_tau)
+    margin_bounds = take(n_k) if has_margin else None
     assert off64 == f64v.shape[0], (
         f"_pack_dynamic/_solve_packed layout drift: "
         f"consumed {off64} of {f64v.shape[0]}"
@@ -1763,8 +1806,22 @@ def _solve_packed_impl(
     state = _root_state(lo_k, hi_k, M, cap)
 
     out_duals = None
-    if decomp_steps >= 0 and w_max > 0:
-        state, out_duals = _seed_root_bounds(
+    out_root_bounds = None
+    out_m_y = None
+    if has_margin:
+        # Margin fast path: the previous full evaluation's per-k bounds,
+        # re-derived HOST-side under the drift (exact in the g/busy
+        # channels — see ``margin_bounds_from_state``), replace the
+        # on-device bound evaluation entirely — no decomposition program
+        # is traced. The stored duals pass through so the chain keeps
+        # flowing.
+        state = state._replace(
+            node_bound=state.node_bound.at[:n_k].set(margin_bounds + obj_const)
+        )
+        out_duals = init_duals
+        out_root_bounds = margin_bounds
+    elif decomp_steps >= 0 and w_max > 0:
+        state, out_duals, out_root_bounds, out_m_y = _seed_root_bounds(
             state, rd, ks, Ws, obj_const, nf, M, moe, w_max, e_max,
             decomp_steps, init_duals=init_duals,
         )
@@ -1844,6 +1901,7 @@ def _solve_packed_impl(
             lam.astype(BDTYPE).ravel(),
             mu.astype(BDTYPE).ravel(),
             tau.astype(BDTYPE).ravel(),
+            out_root_bounds.astype(BDTYPE).ravel(),
         ]
     if per_k:
         parts += [
@@ -1852,6 +1910,11 @@ def _solve_packed_impl(
             state.per_k_y.ravel(),
             _per_k_bound(state),
         ]
+    if out_m_y is not None:
+        # y-profile tail (n_k*M*(e_max+1)), LAST so no earlier offset moves:
+        # read back by solve_sweep_jax for the margin fast path; absent on
+        # margin ticks (statics: moe & w_max>0 & not has_margin).
+        parts += [out_m_y.astype(BDTYPE).ravel()]
     return jnp.concatenate(parts)
 
 
@@ -1863,6 +1926,115 @@ def _solve_packed_impl(
 # uncertainty (candidate t_comm futures, load scenarios) that a host MILP
 # loop would serialize.
 _solve_packed = jax.jit(_solve_packed_impl, static_argnames=_PACKED_STATIC_ARGS)
+
+
+# rd fields the margin evaluator can absorb as drift vs fields that must
+# match EXACTLY between the anchor full evaluation and this tick (they
+# shape the ceil staircases, the ok mask, or enter cells with
+# cell-internal coefficients — a single changed byte there invalidates
+# the reuse, so the gate falls back to the full on-device evaluation;
+# a fallback is always CORRECT, just slower).
+_MARGIN_DRIFT_FIELDS = ("a", "busy_const", "g_raw")
+_MARGIN_EXACT_FIELDS = tuple(
+    f for f in _RD_VEC_FIELDS if f not in _MARGIN_DRIFT_FIELDS
+)
+
+
+def margin_bounds_from_state(
+    margin_state: dict, rd: dict, sf: StandardForm,
+    duals: Tuple[np.ndarray, np.ndarray, np.ndarray],
+) -> Optional[np.ndarray]:
+    """Per-k Lagrangian bounds for THIS tick, re-derived host-side from the
+    last full evaluation's y-profile — or None when reuse is unsound.
+
+    At FIXED multipliers the bound is ``sum_i min_cells term_i + lam W +
+    mu E`` with ``term = (1+theta)·lin + theta·(busy_const + fetch) -
+    lam·w - mu·y``. Under drift confined to the linear channels the new
+    term decomposes EXACTLY over the old one:
+
+        term_new(cell) = term_old(cell)
+                         + (1+theta)*(dg_i/k)*y        (g_raw: coeff y)
+                         + (1+theta)*da_i*w            (a: coeff w)
+                         + theta*dbusy_i               (cell-independent)
+
+    The anchor stores ``m_y[k,i,y] = min over (candidate, w) term_old``,
+    so the g and busy channels correct EXACTLY (shift the y-profile, re-min
+    over 8K host floats — microseconds); the a channel decouples from the
+    y-min as a separate ``min over w`` of its linear part (a valid lower
+    bound via ``min(f+g) >= min f + min g``; exact when ``da = 0``, the
+    streaming t_comm/load case). Because every correction is computed
+    against the FIXED anchor, margin ticks do not decay the chain — under
+    pure t_comm / expert-load drift the reused bound equals the full
+    evaluation's bit for bit, indefinitely.
+
+    Reuse requires (else None): same fleet/k-grid shapes, byte-identical
+    exact-match fields (b', eb_*, rhs vectors, has_gpu, penalties, s_disk,
+    E — they shape the ceil staircases and the ok mask), and the SAME
+    multipliers the anchor was evaluated at. +inf profile slots (infeasible
+    (i, y) pairs) stay +inf: feasibility is frozen by the exact-match gate.
+    """
+    prev_rd = margin_state.get("rd")
+    m_y = margin_state.get("m_y")
+    if prev_rd is None or m_y is None:
+        return None
+    ks = np.asarray(sf.ks, np.float64)
+    Ws = np.asarray(sf.Ws, np.float64)
+    if not (
+        np.array_equal(margin_state.get("ks"), ks)
+        and np.array_equal(margin_state.get("Ws"), Ws)
+    ):
+        return None
+    M = rd["a"].shape[0]
+    E = float(rd["E"])
+    if m_y.shape != (ks.shape[0], M, int(E) + 1):
+        return None
+    for f in _MARGIN_EXACT_FIELDS:
+        if not np.array_equal(prev_rd[f], rd[f]):
+            return None
+    if not (
+        np.array_equal(prev_rd["bprime"], rd["bprime"])
+        and np.array_equal(prev_rd["E"], rd["E"])
+    ):
+        return None
+    # The anchor profile is only valid AT the multipliers it was evaluated
+    # at — reject a caller mixing duals and profiles from different results.
+    prev_duals = margin_state.get("duals")
+    if prev_duals is None or not all(
+        np.array_equal(np.asarray(p, np.float64), np.asarray(q, np.float64))
+        for p, q in zip(prev_duals, duals)
+    ):
+        return None
+
+    lam, mu, tau = (np.asarray(p, np.float64) for p in duals)
+    t = np.exp(tau - tau.max(axis=1, keepdims=True))
+    theta = (ks - 1.0)[:, None] * (t / t.sum(axis=1, keepdims=True))
+
+    dG = np.asarray(rd["g_raw"] - prev_rd["g_raw"], np.float64)
+    dA = np.asarray(rd["a"] - prev_rd["a"], np.float64)
+    dB = np.asarray(rd["busy_const"] - prev_rd["busy_const"], np.float64)
+
+    y_vals = np.arange(0, int(E) + 1, dtype=np.float64)
+    kappa = (1.0 + theta) * dG[None, :] / ks[:, None]  # (n_k, M)
+    shifted = m_y + kappa[:, :, None] * y_vals[None, None, :]
+    per_dev = shifted.min(axis=2)  # (n_k, M) — exact g correction
+    # a channel: linear in w over [1, W_k], decoupled endpoint minimum.
+    a_coef = (1.0 + theta) * dA[None, :]
+    per_dev = per_dev + np.minimum(a_coef, a_coef * Ws[:, None])
+    # busy channel: cell-independent, exact.
+    per_dev = per_dev + theta * dB[None, :]
+
+    bound = per_dev.sum(axis=1) + lam * Ws + mu * E
+    # Host numpy and the device program may round theta differently by an
+    # ulp; a hair of slack keeps the reused bound strictly on the sound
+    # side without denting the 1e-3-scale certificate.
+    bound = bound - 1e-9 * (1.0 + np.abs(bound))
+    # A device whose whole profile is +inf proves the k infeasible (+inf
+    # honest); NaN (e.g. inf - inf artifacts) degrades to reuse refusal.
+    infeasible = np.isposinf(per_dev).any(axis=1)
+    bound = np.where(infeasible, np.inf, bound)
+    if np.isnan(bound).any():
+        return None
+    return bound
 
 
 @partial(jax.jit, static_argnames=_PACKED_STATIC_ARGS)
@@ -1884,6 +2056,7 @@ def _solve_scenarios_packed(
     decomp_steps: int = 0,
     has_duals: bool = False,
     per_k: bool = False,
+    has_margin: bool = False,
 ) -> jax.Array:
     return jax.vmap(
         lambda dyn: _solve_packed_impl(
@@ -1891,6 +2064,7 @@ def _solve_scenarios_packed(
             ipm_iters=ipm_iters, max_rounds=max_rounds, beam=beam, moe=moe,
             has_warm=has_warm, w_max=w_max, e_max=e_max,
             decomp_steps=decomp_steps, has_duals=has_duals, per_k=per_k,
+            has_margin=has_margin,
         )
     )(dyn_blobs)
 
@@ -2059,6 +2233,7 @@ def solve_sweep_jax(
     timings: Optional[dict] = None,
     collect: bool = True,
     per_k_optima: bool = False,
+    margin_state: Optional[dict] = None,
 ):
     """Solve the whole k-sweep on the accelerator.
 
@@ -2086,6 +2261,15 @@ def solve_sweep_jax(
     ``warm`` seeds the search with a previous solve's integer assignment
     (re-priced exactly on-device under the current coefficients), so a
     streaming re-solve prunes against a strong incumbent from round one.
+
+    ``margin_state`` (a dict the caller threads across ticks, sync path
+    only) enables the MoE margin fast path: when consecutive ticks drift
+    only the drift-class coefficients, the previous tick's decomposition
+    bounds are slackened host-side (``margin_bounds_from_state``) and the
+    on-device bound evaluation is skipped. The dict's ``"used"`` key
+    reports whether the path engaged; clear ``"m_y"`` (the anchor profile)
+    to force a full evaluation (done by StreamingReplanner when a margin
+    tick misses its certificate).
 
     ``ipm_iters`` / ``beam`` / ``node_cap`` default by problem class (see
     ``default_search_params``); ``max_rounds`` caps the B&B rounds. All four
@@ -2147,10 +2331,26 @@ def solve_sweep_jax(
     import time as _time
 
     t0 = _time.perf_counter()
+    rd_np = _rounding_arrays_np(coeffs, arrays.moe)
+    # Margin fast path: when the caller threads a margin_state dict across
+    # streaming ticks and the drift stayed inside the reusable class, the
+    # previous tick's decomp bounds (slackened host-side, microseconds)
+    # replace the on-device bound evaluation entirely.
+    margin_np = None
+    if (
+        margin_state is not None
+        and sf.moe
+        and warm_tuple is not None
+        and duals_tuple is not None
+        and not per_k_optima
+    ):
+        margin_np = margin_bounds_from_state(
+            margin_state, rd_np, sf, duals_tuple
+        )
+    has_margin = margin_np is not None
     static_np = _pack_static(sf)
     dyn_np = _pack_dynamic(
-        sf, _rounding_arrays_np(coeffs, arrays.moe), mip_gap, warm_tuple,
-        duals=duals_tuple,
+        sf, rd_np, mip_gap, warm_tuple, duals=duals_tuple, margin=margin_np,
     )
     t1 = _time.perf_counter()
     static_dev, static_uploaded = _static_to_device(static_np)
@@ -2180,6 +2380,7 @@ def solve_sweep_jax(
         decomp_steps=decomp_steps,
         has_duals=duals_tuple is not None,
         per_k=per_k_optima,
+        has_margin=has_margin,
     )
     pending = PendingSweep(
         out=out_dev,
@@ -2197,11 +2398,43 @@ def solve_sweep_jax(
     if collect is False:
         # Async mode: the device is (or will be) computing; the caller
         # overlaps its own work and calls collect_sweep later. jax's async
-        # dispatch means no host thread blocks here.
+        # dispatch means no host thread blocks here. (The margin chain is
+        # sync-path-only: updating it needs the decoded bounds.)
         return pending
 
-    results, best = collect_sweep(pending)
+    raw_out: list = []
+    results, best = collect_sweep(pending, raw_out=raw_out)
     t3 = _time.perf_counter()
+    if margin_state is not None and sf.moe:
+        margin_state["used"] = has_margin
+        if has_margin:
+            # Margin tick: the stored full-eval anchor stays FIXED — every
+            # margin tick re-derives its bounds from that anchor under the
+            # cumulative drift (exact in the linear channels), so the
+            # chain does not decay tick over tick.
+            pass
+        elif (
+            best is not None
+            and best.duals is not None
+            and "root_bounds" in best.duals
+        ):
+            # Full evaluation: refresh the anchor — rd vectors, duals, and
+            # the per-device y-profile read from the output tail.
+            Yn = int(np.asarray(rd_np["E"])) + 1
+            m_y_flat = raw_out[0][-n_k * M * Yn:]
+            margin_state.update(
+                rd=rd_np,
+                ks=np.asarray(sf.ks, np.float64),
+                Ws=np.asarray(sf.Ws, np.float64),
+                m_y=m_y_flat.reshape(n_k, M, Yn),
+                duals=tuple(
+                    np.asarray(best.duals[f], np.float64)
+                    for f in ("lam", "mu", "tau")
+                ),
+            )
+        else:
+            margin_state.pop("m_y", None)
+            margin_state.pop("duals", None)
     if timings is not None or debug:
         tm = {
             "pack_ms": (t1 - t0) * 1e3,
@@ -2246,10 +2479,15 @@ class PendingSweep(NamedTuple):
 
 def collect_sweep(
     pending: PendingSweep,
+    raw_out: Optional[list] = None,
 ) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
     """Fetch + decode an in-flight sweep (the blocking half of the async
-    split). Same output contract as ``solve_sweep_jax``."""
+    split). Same output contract as ``solve_sweep_jax``. ``raw_out`` (a
+    list, when passed) receives the fetched host vector — the margin fast
+    path reads its y-profile tail without a second device fetch."""
     out = np.asarray(jax.device_get(pending.out))
+    if raw_out is not None:
+        raw_out.append(out)
     return _decode_sweep_out(
         out, pending.results, pending.feasible, pending.kWs, pending.M,
         pending.n_k, pending.moe, pending.w_max, pending.mip_gap,
@@ -2284,7 +2522,7 @@ def _decode_sweep_out(
             # make max_rounds=small look like "infeasible for every k".
             p0 = 4 + 3 * M + n_k
             if moe and w_max > 0:
-                p0 += 2 * n_k + n_k * M
+                p0 += 3 * n_k + n_k * M  # lam, mu, tau, root_bounds
             pk_bound0 = out[p0 + 3 * n_k * M : p0 + 3 * n_k * M + n_k]
             if not np.all(np.isposinf(pk_bound0)):
                 import warnings
@@ -2333,10 +2571,15 @@ def _decode_sweep_out(
         lam_out = out[d0 : d0 + n_k]
         mu_out = out[d0 + n_k : d0 + 2 * n_k]
         tau_out = out[d0 + 2 * n_k : d0 + 2 * n_k + n_k * M].reshape(n_k, M)
+        rb0 = d0 + 2 * n_k + n_k * M
+        # Raw (pre-obj_const) per-k decomp bounds: persisted so the next
+        # streaming tick can reuse them through the margin fast path.
+        root_bounds_out = out[rb0 : rb0 + n_k]
         out_duals = {
             "lam": lam_out.tolist(),
             "mu": mu_out.tolist(),
             "tau": tau_out.tolist(),
+            "root_bounds": root_bounds_out.tolist(),
         }
 
     # Per-k mode: the tail carries full per-k assignments + per-k bounds,
@@ -2345,7 +2588,7 @@ def _decode_sweep_out(
     if per_k:
         p0 = 4 + 3 * M + n_k
         if moe and w_max > 0:
-            p0 += 2 * n_k + n_k * M  # duals block
+            p0 += 3 * n_k + n_k * M  # duals block incl. root_bounds
         pk_w = out[p0 : p0 + n_k * M].reshape(n_k, M)
         pk_n = out[p0 + n_k * M : p0 + 2 * n_k * M].reshape(n_k, M)
         pk_y = out[p0 + 2 * n_k * M : p0 + 3 * n_k * M].reshape(n_k, M)

@@ -49,6 +49,9 @@ class StreamingReplanner:
         self._last_shape: Optional[tuple] = None
         self._load_factors = None  # realized per-device load multipliers
         self._in_flight: list = []  # (PendingHalda, shape, devs, model, loads)
+        # MoE margin fast path: previous tick's decomp bounds + rd vectors
+        # (see backend_jax.margin_bounds_from_state). Sync step() only.
+        self._margin_state: dict = {}
 
     def step(
         self,
@@ -104,7 +107,32 @@ class StreamingReplanner:
             warm=warm,
             load_factors=factors,
             timings=timings,
+            margin_state=self._margin_state,
         )
+        if (
+            not result.certified
+            and self._margin_state.pop("used", False)
+            and warm is not None
+        ):
+            # The margin-reused bound missed the certificate (the drift
+            # left the channels the anchor corrects exactly). Drop the
+            # anchor profile so the retry runs one FULL bound evaluation —
+            # still warm, far cheaper than the cold ascent the stale-dual
+            # fallback below would pay — and refreshes the anchor.
+            self._margin_state.pop("m_y", None)
+            result = halda_solve(
+                devs,
+                model,
+                k_candidates=k_candidates,
+                mip_gap=self.mip_gap,
+                kv_bits=self.kv_bits,
+                backend=self.backend,
+                moe=self.moe,
+                warm=warm,
+                load_factors=factors,
+                timings=timings,
+                margin_state=self._margin_state,
+            )
         if warm is not None and warm.duals is not None and not result.certified:
             # A warm MoE tick certifies against the bound EVALUATED at the
             # previous tick's multipliers (zero ascent steps); when the fleet
@@ -123,6 +151,7 @@ class StreamingReplanner:
                 moe=self.moe,
                 load_factors=factors,
                 timings=timings,
+                margin_state=self._margin_state,
             )
 
         if loads is not None and result.y is not None:
@@ -252,3 +281,4 @@ class StreamingReplanner:
         self._last_shape = None
         self._load_factors = None
         self._in_flight = []
+        self._margin_state = {}

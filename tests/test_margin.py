@@ -1,0 +1,155 @@
+"""Margin fast path: bound-reuse soundness and the streaming fallback chain.
+
+A margin tick certifies against bounds REUSED from the last full
+evaluation, corrected host-side for drift (backend_jax.
+margin_bounds_from_state). The certificate is only as good as those
+bounds, so this file pins the two things that matter:
+
+1. SOUNDNESS — the reused bound never exceeds a fresh full evaluation at
+   the same multipliers (fuzzed over drift classes); an overshoot would
+   certify a placement the instance doesn't support.
+2. ENGAGEMENT/GATING — the path engages on drift-class ticks (that's the
+   latency win), refuses byte-class changes (pool sizes), and the
+   replanner falls back full-eval-then-cold when the chain misses.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+import pytest
+
+from distilp_tpu.profiler.api import profile_model
+from distilp_tpu.solver import StreamingReplanner, halda_solve
+from distilp_tpu.solver import backend_jax as bj
+from distilp_tpu.solver.api import _build_instance
+from distilp_tpu.utils import make_synthetic_fleet
+
+GAP = 1e-3
+
+
+@pytest.fixture(scope="module")
+def mixtral_model():
+    return profile_model(
+        "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+
+
+def _standard_form(devs, model):
+    Ks, _, coeffs, arrays = _build_instance(devs, model, None, "8bit", None, None)
+    feasible = [(k, model.L // k) for k in Ks if model.L // k >= len(devs)]
+    sf = bj.build_standard_form(arrays, coeffs, feasible)
+    return sf, bj._rounding_arrays_np(coeffs, arrays.moe), arrays
+
+
+def _fresh_bound(rd_np, sf, arrays, duals):
+    import jax.numpy as jnp
+
+    rd = bj.RoundingData(
+        bprime=jnp.asarray(rd_np["bprime"], jnp.float64),
+        E=jnp.asarray(rd_np["E"], jnp.float64),
+        **{f: jnp.asarray(rd_np[f], jnp.float64) for f in bj._RD_VEC_FIELDS},
+    )
+    out = bj._decomp_bound_roots(
+        rd,
+        jnp.asarray(sf.ks, jnp.float64),
+        jnp.asarray(sf.Ws, jnp.float64),
+        max(sf.Ws),
+        int(arrays.moe.E),
+        steps=0,
+        moe=True,
+        init_params=tuple(jnp.asarray(p, jnp.float64) for p in duals),
+    )
+    return np.asarray(out[0])
+
+
+def test_margin_bound_sound_vs_fresh_eval_fuzz(mixtral_model):
+    """Across random t_comm AND expert-load drifts, the host-reused bound
+    never exceeds the fresh on-device evaluation at the anchor duals (a
+    hair of humility slack below it is expected and fine)."""
+    model = mixtral_model
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    ms: dict = {}
+    cold = halda_solve(
+        devs, model, kv_bits="8bit", mip_gap=GAP, backend="jax",
+        margin_state=ms,
+    )
+    assert "m_y" in ms and "rd" in ms, "full eval must store the anchor"
+    duals = ms["duals"]
+
+    rng = np.random.default_rng(5)
+    checked = 0
+    for trial in range(6):
+        drifted = [copy.deepcopy(d) for d in devs]
+        for d in drifted:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.8, 1.25)))
+        lf = None
+        if trial % 2:
+            # Expert-load re-pricing drifts g_raw — the channel the exact
+            # y-profile correction exists for.
+            lf = [float(rng.uniform(0.5, 2.0)) for _ in drifted]
+        Ks, _, coeffs, arrays = _build_instance(
+            drifted, model, None, "8bit", None, lf
+        )
+        feasible = [
+            (k, model.L // k) for k in Ks if model.L // k >= len(drifted)
+        ]
+        sf = bj.build_standard_form(arrays, coeffs, feasible)
+        rd_np = bj._rounding_arrays_np(coeffs, arrays.moe)
+        margin = bj.margin_bounds_from_state(ms, rd_np, sf, duals)
+        assert margin is not None, "drift-class tick must be reusable"
+        fresh = _fresh_bound(rd_np, sf, arrays, duals)
+        for mb, fb in zip(margin, fresh):
+            if np.isfinite(fb):
+                assert mb <= fb + 1e-12, (mb, fb)
+            checked += 1
+        # Pure t_comm/load drift: the correction is exact up to the
+        # humility slack, not just sound — the chain must not decay.
+        if np.all(np.isfinite(fresh)):
+            assert np.allclose(margin, fresh, rtol=1e-6, atol=1e-6)
+    assert checked >= 6
+    assert cold.certified
+
+
+def test_margin_refuses_byte_class_changes(mixtral_model):
+    """Pool-size (residency) changes reshape the feasibility staircases —
+    the gate must refuse reuse and fall back to a full evaluation."""
+    model = mixtral_model
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    ms: dict = {}
+    halda_solve(
+        devs, model, kv_bits="8bit", mip_gap=GAP, backend="jax",
+        margin_state=ms,
+    )
+    duals = ms["duals"]
+    grown = make_synthetic_fleet(4, seed=7, pool_bytes=int(96e9))
+    Ks, _, coeffs, arrays = _build_instance(grown, model, None, "8bit", None, None)
+    feasible = [(k, model.L // k) for k in Ks if model.L // k >= len(grown)]
+    sf = bj.build_standard_form(arrays, coeffs, feasible)
+    rd_np = bj._rounding_arrays_np(coeffs, arrays.moe)
+    assert bj.margin_bounds_from_state(ms, rd_np, sf, duals) is None
+
+
+def test_streaming_margin_ticks_engage_and_match_cold(mixtral_model):
+    """The replanner's drift ticks ride the margin path (that's the
+    latency claim) and still match a cold solve on the final fleet."""
+    model = mixtral_model
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
+    planner.step(devs, model)
+    rng = np.random.default_rng(3)
+    used = []
+    for _ in range(3):
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.9, 1.1)))
+        tick = planner.step(devs, model)
+        used.append(planner._margin_state.get("used"))
+        assert tick.certified
+    assert all(used), f"margin path did not engage: {used}"
+    cold = halda_solve(devs, model, kv_bits="8bit", mip_gap=GAP, backend="jax")
+    assert abs(tick.obj_value - cold.obj_value) <= 2 * GAP * abs(cold.obj_value) + 1e-9
+    # Fleet-shape change: margin must NOT leak across shapes (the gate
+    # compares k-grids/rd shapes); the solve stays correct.
+    small = planner.step(devs[:3], model)
+    assert small.certified is not None and len(small.w) == 3

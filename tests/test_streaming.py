@@ -184,8 +184,14 @@ def test_moe_warm_tick_falls_back_to_cold_when_uncertified(monkeypatch):
     orig = streaming_mod.halda_solve
 
     def spy(*args, **kwargs):
+        # Record (warm?, anchor-present?) at CALL time: the middle rung of
+        # the ladder must run with the anchor dropped (a true full
+        # evaluation), not a duplicate margin tick on the same bounds.
+        calls.append(
+            (kwargs.get("warm") is not None,
+             "m_y" in planner._margin_state)
+        )
         result = orig(*args, **kwargs)
-        calls.append(kwargs.get("warm") is not None)
         if kwargs.get("warm") is not None:
             # Force the warm result to look uncertified.
             result = result.model_copy(update={"certified": False})
@@ -195,10 +201,40 @@ def test_moe_warm_tick_falls_back_to_cold_when_uncertified(monkeypatch):
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         tick = planner.step(devs, model)
-    # One warm attempt, then the cold fallback; the returned result is the
-    # certified cold one.
-    assert calls == [True, False]
+    # The escalation ladder: the margin warm attempt, then a full-eval
+    # warm retry (anchor cleared), then the cold fallback; the returned
+    # result is the certified cold one.
+    assert calls == [(True, True), (True, False), (False, True)]
     assert tick.certified
+
+
+def test_moe_duals_without_usable_warm_hint_still_certifies():
+    """A warm result whose k falls OUTSIDE the new k-grid is rejected as an
+    incumbent hint, but its duals still shape-match and ride along. The
+    zero-step warm mode must NOT engage then (it skips the Lagrangian
+    primal repair, so without a warm incumbent the solve would start
+    incumbent-less and miss the certificate); the solver must fall back to
+    the full ascent and certify."""
+    from distilp_tpu.profiler.api import profile_model
+
+    model = profile_model(
+        "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    prev = halda_solve(
+        devs, model, kv_bits="8bit", mip_gap=GAP, backend="jax",
+        k_candidates=[1, 2],
+    )
+    assert prev.duals is not None and prev.k in (1, 2)
+    # New grid excludes prev.k, so the hint is unusable — but both grids
+    # have n_k=2 feasible k's (W = 32/k >= M=4), so the stored duals still
+    # pass the shape check and ride into the solve.
+    got = halda_solve(
+        devs, model, kv_bits="8bit", mip_gap=GAP, backend="jax",
+        k_candidates=[4, 8], warm=prev,
+    )
+    assert got.certified and got.k in (4, 8)
+    assert got.y is not None and sum(got.y) == model.n_routed_experts
 
 
 def test_pipelined_ticks_match_sequential(fleet_and_model):
