@@ -112,6 +112,27 @@ def test_margin_bound_sound_vs_fresh_eval_fuzz(mixtral_model):
     assert cold.certified
 
 
+def test_margin_chain_does_not_decay_over_long_streams(mixtral_model):
+    """50 drift ticks against ONE anchor: every tick margin-engaged and
+    certified. The old subtract-a-slack design decayed each tick and died
+    in a handful; the y-profile corrections are exact in the drift
+    channels, so the chain survives indefinitely."""
+    model = mixtral_model
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
+    planner.step(devs, model)
+    anchor = planner._margin_state.get("m_y")
+    rng = np.random.default_rng(11)
+    for _ in range(50):
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.97, 1.03)))
+        tick = planner.step(devs, model)
+        assert tick.certified
+        assert planner._margin_state.get("used") is True
+    # The anchor was never refreshed: all 50 ticks reused one evaluation.
+    assert planner._margin_state.get("m_y") is anchor
+
+
 def test_margin_refuses_byte_class_changes(mixtral_model):
     """Pool-size (residency) changes reshape the feasibility staircases —
     the gate must refuse reuse and fall back to a full evaluation."""
