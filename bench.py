@@ -441,6 +441,27 @@ def _moe_warm_tick(rng):
     assert result.certified, f"MoE warm tick not certified (gap={result.gap})"
     assert sum(result.y) == model.n_routed_experts
     breakdown = {k: round(statistics.median(v), 3) for k, v in acc.items()}
+
+    # Pipelined MoE: one tick in flight, margin bounds decided at dispatch
+    # and the anchor refreshed at collect — on a per-operation-billed
+    # tunnel this is the E=256 streaming throughput path (host prep +
+    # upload overlap the previous solve's execution + result transfer).
+    n_pipe = 2 * REPEATS
+    uncert = 0
+    t0 = time.perf_counter()
+    planner.submit(devs, model)
+    for _ in range(n_pipe):
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.95, 1.05)))
+        planner.submit(devs, model)
+        if not planner.collect().certified:
+            uncert += 1
+    if not planner.collect().certified:
+        uncert += 1
+    pipe_s = time.perf_counter() - t0
+    breakdown["pipelined_placements_per_sec"] = round((n_pipe + 1) / pipe_s, 1)
+    if uncert:
+        breakdown["pipelined_uncertified_ticks"] = uncert
     return statistics.median(times), result, breakdown
 
 

@@ -279,6 +279,7 @@ def halda_solve_async(
     node_cap: Optional[int] = None,
     load_factors: Optional[Sequence[float]] = None,
     batch_size: int = 1,
+    margin_state: Optional[dict] = None,
 ) -> PendingHalda:
     """Dispatch a HALDA solve and return without waiting for the result.
 
@@ -286,7 +287,9 @@ def halda_solve_async(
     semantics as ``halda_solve`` otherwise; redeem with ``.collect()``.
     Pipelining warm hints one tick behind (seed tick t+1 with tick t-1's
     collected result) is sound: hints are re-priced exactly on-device, so
-    staleness only affects pruning speed, never correctness.
+    staleness only affects pruning speed, never correctness. The MoE
+    margin chain (``margin_state``) works pipelined too: the bound reuse
+    is decided at dispatch, the anchor refresh at collect.
     """
     try:
         from .backend_jax import PendingSweep, solve_sweep_jax
@@ -311,6 +314,7 @@ def halda_solve_async(
         ipm_iters=ipm_iters,
         node_cap=node_cap,
         collect=False,
+        margin_state=margin_state,
     )
     if not isinstance(pending, PendingSweep):
         # Plain (results, None) tuple: structurally infeasible sweep

@@ -2404,47 +2404,25 @@ def solve_sweep_jax(
         mip_gap=mip_gap,
         debug=debug,
         per_k=per_k_optima,
+        margin_ctx=(
+            (
+                margin_state, has_margin, rd_np,
+                np.asarray(sf.ks, np.float64),
+                np.asarray(sf.Ws, np.float64),
+            )
+            if margin_state is not None and sf.moe
+            else None
+        ),
     )
     if collect is False:
         # Async mode: the device is (or will be) computing; the caller
         # overlaps its own work and calls collect_sweep later. jax's async
-        # dispatch means no host thread blocks here. (The margin chain is
-        # sync-path-only: updating it needs the decoded bounds.)
+        # dispatch means no host thread blocks here; the margin-chain
+        # refresh rides the eventual collect_sweep.
         return pending
 
-    raw_out: list = []
-    results, best = collect_sweep(pending, raw_out=raw_out)
+    results, best = collect_sweep(pending)
     t3 = _time.perf_counter()
-    if margin_state is not None and sf.moe:
-        margin_state["used"] = has_margin
-        if has_margin:
-            # Margin tick: the stored full-eval anchor stays FIXED — every
-            # margin tick re-derives its bounds from that anchor under the
-            # cumulative drift (exact in the linear channels), so the
-            # chain does not decay tick over tick.
-            pass
-        elif (
-            best is not None
-            and best.duals is not None
-            and "root_bounds" in best.duals
-        ):
-            # Full evaluation: refresh the anchor — rd vectors, duals, and
-            # the per-device y-profile read from the output tail.
-            Yn = int(np.asarray(rd_np["E"])) + 1
-            m_y_flat = raw_out[0][-n_k * M * Yn:]
-            margin_state.update(
-                rd=rd_np,
-                ks=np.asarray(sf.ks, np.float64),
-                Ws=np.asarray(sf.Ws, np.float64),
-                m_y=m_y_flat.reshape(n_k, M, Yn),
-                duals=tuple(
-                    np.asarray(best.duals[f], np.float64)
-                    for f in ("lam", "mu", "tau")
-                ),
-            )
-        else:
-            margin_state.pop("m_y", None)
-            margin_state.pop("duals", None)
     if timings is not None or debug:
         tm = {
             "pack_ms": (t1 - t0) * 1e3,
@@ -2485,24 +2463,56 @@ class PendingSweep(NamedTuple):
     mip_gap: float
     debug: bool
     per_k: bool = False
+    # (margin_state, has_margin, rd_np, ks, Ws) when the caller threads a
+    # margin chain — the anchor refresh happens at COLLECT time (it needs
+    # the fetched y-profile tail), which is what lets pipelined
+    # submit/collect ticks ride the margin fast path too.
+    margin_ctx: Optional[tuple] = None
 
 
 def collect_sweep(
     pending: PendingSweep,
-    raw_out: Optional[list] = None,
 ) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
     """Fetch + decode an in-flight sweep (the blocking half of the async
-    split). Same output contract as ``solve_sweep_jax``. ``raw_out`` (a
-    list, when passed) receives the fetched host vector — the margin fast
-    path reads its y-profile tail without a second device fetch."""
+    split). Same output contract as ``solve_sweep_jax``."""
     out = np.asarray(jax.device_get(pending.out))
-    if raw_out is not None:
-        raw_out.append(out)
-    return _decode_sweep_out(
+    results, best = _decode_sweep_out(
         out, pending.results, pending.feasible, pending.kWs, pending.M,
         pending.n_k, pending.moe, pending.w_max, pending.mip_gap,
         pending.debug, per_k=pending.per_k,
     )
+    if pending.margin_ctx is not None:
+        margin_state, has_margin, rd_np, ks_arr, Ws_arr = pending.margin_ctx
+        margin_state["used"] = has_margin
+        if has_margin:
+            # Margin tick: the stored full-eval anchor stays FIXED — every
+            # margin tick re-derives its bounds from that anchor under the
+            # cumulative drift (exact in the linear channels), so the
+            # chain does not decay tick over tick.
+            pass
+        elif (
+            best is not None
+            and best.duals is not None
+            and "root_bounds" in best.duals
+        ):
+            # Full evaluation: refresh the anchor — rd vectors, duals, and
+            # the per-device y-profile read from the output tail.
+            Yn = int(np.asarray(rd_np["E"])) + 1
+            m_y_flat = out[-pending.n_k * pending.M * Yn:]
+            margin_state.update(
+                rd=rd_np,
+                ks=ks_arr,
+                Ws=Ws_arr,
+                m_y=m_y_flat.reshape(pending.n_k, pending.M, Yn),
+                duals=tuple(
+                    np.asarray(best.duals[f], np.float64)
+                    for f in ("lam", "mu", "tau")
+                ),
+            )
+        else:
+            margin_state.pop("m_y", None)
+            margin_state.pop("duals", None)
+    return results, best
 
 
 def _decode_sweep_out(

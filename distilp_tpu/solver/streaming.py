@@ -109,50 +109,9 @@ class StreamingReplanner:
             timings=timings,
             margin_state=self._margin_state,
         )
-        if (
-            not result.certified
-            and self._margin_state.pop("used", False)
-            and warm is not None
-        ):
-            # The margin-reused bound missed the certificate (the drift
-            # left the channels the anchor corrects exactly). Drop the
-            # anchor profile so the retry runs one FULL bound evaluation —
-            # still warm, far cheaper than the cold ascent the stale-dual
-            # fallback below would pay — and refreshes the anchor.
-            self._margin_state.pop("m_y", None)
-            result = halda_solve(
-                devs,
-                model,
-                k_candidates=k_candidates,
-                mip_gap=self.mip_gap,
-                kv_bits=self.kv_bits,
-                backend=self.backend,
-                moe=self.moe,
-                warm=warm,
-                load_factors=factors,
-                timings=timings,
-                margin_state=self._margin_state,
-            )
-        if warm is not None and warm.duals is not None and not result.certified:
-            # A warm MoE tick certifies against the bound EVALUATED at the
-            # previous tick's multipliers (zero ascent steps); when the fleet
-            # drifted far enough that those duals go stale, fall back to a
-            # cold solve — full ascent, fresh duals — instead of returning
-            # an uncertified placement. MoE-only (gated on stored duals): a
-            # dense solve that misses its certificate does so for search-
-            # budget reasons a cold re-solve would not fix.
-            result = halda_solve(
-                devs,
-                model,
-                k_candidates=k_candidates,
-                mip_gap=self.mip_gap,
-                kv_bits=self.kv_bits,
-                backend=self.backend,
-                moe=self.moe,
-                load_factors=factors,
-                timings=timings,
-                margin_state=self._margin_state,
-            )
+        result = self._certify_or_fallback(
+            result, devs, model, k_candidates, factors, warm, timings
+        )
 
         if loads is not None and result.y is not None:
             from .moe import build_moe_arrays
@@ -168,6 +127,64 @@ class StreamingReplanner:
 
         self.last = result
         self._last_shape = shape
+        return result
+
+    def _certify_or_fallback(
+        self,
+        result: HALDAResult,
+        devs: Sequence[DeviceProfile],
+        model: ModelProfile,
+        k_candidates,
+        factors,
+        warm: Optional[HALDAResult],
+        timings: Optional[dict],
+    ) -> HALDAResult:
+        """The certification escalation ladder, shared by ``step()`` and
+        ``collect()``.
+
+        Rung 1 — a MARGIN tick that missed its certificate drops the
+        anchor profile and retries with ONE full bound evaluation, still
+        warm: far cheaper than a cold ascent, and it refreshes the anchor
+        for subsequent ticks.
+
+        Rung 2 — a warm tick whose STORED DUALS went stale (the zero-step
+        bound at the previous multipliers no longer certifies) re-solves
+        cold: full ascent, fresh duals. MoE-only, gated on those duals —
+        a dense solve that misses its certificate does so for search-
+        budget reasons a cold re-solve would not fix.
+        """
+        if (
+            not result.certified
+            and self._margin_state.pop("used", False)
+            and warm is not None
+        ):
+            self._margin_state.pop("m_y", None)
+            result = halda_solve(
+                devs,
+                model,
+                k_candidates=k_candidates,
+                mip_gap=self.mip_gap,
+                kv_bits=self.kv_bits,
+                backend=self.backend,
+                moe=self.moe,
+                warm=warm,
+                load_factors=factors,
+                timings=timings,
+                margin_state=self._margin_state,
+            )
+        if warm is not None and warm.duals is not None and not result.certified:
+            result = halda_solve(
+                devs,
+                model,
+                k_candidates=k_candidates,
+                mip_gap=self.mip_gap,
+                kv_bits=self.kv_bits,
+                backend=self.backend,
+                moe=self.moe,
+                load_factors=factors,
+                timings=timings,
+                margin_state=self._margin_state,
+            )
         return result
 
     def submit(
@@ -223,6 +240,7 @@ class StreamingReplanner:
             moe=self.moe,
             warm=warm,
             load_factors=factors,
+            margin_state=self._margin_state,
         )
         # Snapshot the fleet AND the model: streaming callers mutate both in
         # place between ticks (t_comm drifts, expert_loads refresh), and
@@ -244,22 +262,11 @@ class StreamingReplanner:
         (pending, shape, devs, model, loads, k_candidates, factors,
          warm) = self._in_flight.pop(0)
         result = pending.collect()
-        if warm is not None and warm.duals is not None and not result.certified:
-            # Same stale-dual fallback as step(): re-solve cold (same
-            # instance — k_candidates and load factors included) rather
-            # than return an uncertified placement. Synchronous: the
-            # pipeline hiccups, correctness does not. MoE-only, gated on
-            # the stale duals that caused the miss.
-            result = halda_solve(
-                devs,
-                model,
-                k_candidates=k_candidates,
-                mip_gap=self.mip_gap,
-                kv_bits=self.kv_bits,
-                backend=self.backend,
-                moe=self.moe,
-                load_factors=factors,
-            )
+        # Pipelined misses escalate synchronously — the pipeline hiccups,
+        # correctness does not.
+        result = self._certify_or_fallback(
+            result, devs, model, k_candidates, factors, warm, None
+        )
         if loads is not None and result.y is not None:
             from .moe import build_moe_arrays
             from .routing import map_experts

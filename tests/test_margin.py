@@ -133,6 +133,39 @@ def test_margin_chain_does_not_decay_over_long_streams(mixtral_model):
     assert planner._margin_state.get("m_y") is anchor
 
 
+def test_margin_rides_pipelined_ticks(mixtral_model):
+    """submit/collect MoE ticks engage the margin path too (the decision
+    is taken at dispatch, the anchor refresh at collect), stay certified,
+    and match a cold solve at the end of the stream."""
+    model = mixtral_model
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
+    planner.step(devs, model)  # cold anchor
+    rng = np.random.default_rng(13)
+    planner.submit(devs, model)
+    used = []
+    results = []
+    for _ in range(4):
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.95, 1.05)))
+        planner.submit(devs, model)
+        results.append(planner.collect())
+        used.append(planner._margin_state.get("used"))
+    results.append(planner.collect())
+    assert all(r.certified for r in results)
+    # A single miss-and-retry is LEGITIMATE (the retry resets "used" and
+    # still certifies); what the contract promises is that the margin path
+    # carries the stream, not that no tick ever escalates.
+    assert sum(1 for u in used if u) >= len(used) - 1, (
+        f"pipelined ticks did not ride the margin path: {used}"
+    )
+    cold = halda_solve(devs, model, kv_bits="8bit", mip_gap=GAP, backend="jax")
+    assert (
+        abs(results[-1].obj_value - cold.obj_value)
+        <= 2 * GAP * abs(cold.obj_value) + 1e-9
+    )
+
+
 def test_margin_refuses_byte_class_changes(mixtral_model):
     """Pool-size (residency) changes reshape the feasibility staircases —
     the gate must refuse reuse and fall back to a full evaluation."""
