@@ -13,3 +13,14 @@ test:
 .PHONY: bench
 bench:
 	python bench.py
+
+# Scheduler-service smoke: replay the bundled 20-event churn trace through
+# the daemon on the CPU platform (no slow tests, no accelerator needed);
+# any structural tick missing its optimality certificate fails the target.
+.PHONY: smoke-sched
+smoke-sched:
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+		--trace tests/traces/scheduler_smoke_20.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--synthetic-fleet 4 --fleet-seed 11 --k-candidates 8,10 \
+		--quiet --fail-uncertified

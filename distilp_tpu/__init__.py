@@ -10,6 +10,9 @@ Capabilities (matching and extending firstbatchxyz/distilp):
   profiling straight from HF ``config.json`` metadata (no Metal/MLX needed).
 - ``distilp_tpu.parallel`` — device-mesh utilities and the ICI/DCN
   communication cost model.
+- ``distilp_tpu.sched``    — the solver run as a long-lived scheduler service:
+  churn events in, certified placements out, warm solver state pooled
+  across replans (see ``sched.Scheduler`` and ``solver serve --trace``).
 """
 
 __version__ = "0.1.0"

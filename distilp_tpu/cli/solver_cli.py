@@ -101,7 +101,159 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solver serve",
+        description="run the fleet scheduler service over a churn trace "
+        "(see distilp_tpu.sched): events in, certified placements out, "
+        "warm solver state kept across ticks",
+    )
+    p.add_argument(
+        "--trace",
+        required=True,
+        help="JSONL churn trace (one event per line; see sched.events "
+        "for the schema, sched.sim / `generate_trace` to make one)",
+    )
+    p.add_argument(
+        "--profile",
+        "-p",
+        required=True,
+        help="profile folder; model_profile.json is the served model, the "
+        "device JSONs are the starting fleet unless --synthetic-fleet",
+    )
+    p.add_argument(
+        "--synthetic-fleet",
+        type=int,
+        default=0,
+        metavar="M",
+        help="start from M synthetic devices instead of the folder's "
+        "device JSONs (deterministic; see utils.make_synthetic_fleet)",
+    )
+    p.add_argument("--fleet-seed", type=int, default=0)
+    p.add_argument("--backend", choices=["cpu", "jax"], default="jax")
+    p.add_argument("--mip-gap", type=float, default=1e-3)
+    p.add_argument("--kv-bits", default="4bit")
+    p.add_argument(
+        "--k-candidates",
+        default=None,
+        help="comma-separated k values (default: all proper factors of L)",
+    )
+    p.add_argument(
+        "--warm-pool",
+        type=int,
+        default=4,
+        help="max warm replanners kept (LRU over (fleet, model) identities)",
+    )
+    p.add_argument(
+        "--fail-uncertified",
+        action="store_true",
+        help="exit 1 if any structural event's placement misses its "
+        "optimality certificate",
+    )
+    p.add_argument(
+        "--metrics-out",
+        default=None,
+        help="write the final metrics snapshot + replay summary JSON here",
+    )
+    p.add_argument("--quiet", action="store_true", help="summary line only")
+    return p
+
+
+def serve_main(argv=None) -> int:
+    """``solver serve``: replay a churn trace through the scheduler daemon."""
+    args = build_serve_parser().parse_args(argv)
+
+    from ..axon_guard import force_cpu_if_env_requested
+
+    force_cpu_if_env_requested()
+
+    from ..common import load_from_profile_folder, load_model_profile
+    from ..sched import Scheduler, drift_warm_share, read_trace, replay
+    from ..utils import make_synthetic_fleet
+
+    folder = Path(args.profile)
+    if not folder.is_dir():
+        print(f"error: {folder} is not a directory", file=sys.stderr)
+        return 2
+    if args.synthetic_fleet > 0:
+        model = load_model_profile(folder / "model_profile.json")
+        devices = make_synthetic_fleet(args.synthetic_fleet, seed=args.fleet_seed)
+    else:
+        devices, model = load_from_profile_folder(folder)
+
+    trace_path = Path(args.trace)
+    if not trace_path.is_file():
+        print(f"error: trace {trace_path} not found", file=sys.stderr)
+        return 2
+    try:
+        events = read_trace(trace_path)
+    except (OSError, ValueError) as e:  # ValidationError is a ValueError
+        print(f"error: cannot parse trace: {e}", file=sys.stderr)
+        return 2
+    if not events:
+        print("error: trace is empty", file=sys.stderr)
+        return 2
+
+    k_candidates = None
+    if args.k_candidates:
+        k_candidates = [int(x) for x in args.k_candidates.split(",") if x.strip()]
+
+    sched = Scheduler(
+        devices,
+        model,
+        mip_gap=args.mip_gap,
+        kv_bits=args.kv_bits,
+        backend=args.backend,
+        k_candidates=k_candidates,
+        warm_pool_size=args.warm_pool,
+    )
+
+    def log_event(ev, view, ms):
+        # The daemon's event log: one line per tick, streamed.
+        if args.quiet:
+            return
+        r = view.result
+        print(
+            f"[{sched.fleet.seq:4d}] {ev.kind:<10s} "
+            f"M={len(r.w):2d} mode={view.mode:<6s} "
+            f"certified={str(r.certified):<5s} k={r.k:<3d} "
+            f"obj={r.obj_value:.6f} {ms:8.1f} ms"
+        )
+
+    try:
+        report = replay(sched, events, on_event=log_event)
+    except (RuntimeError, ValueError) as e:
+        print(f"error: replay failed: {e}", file=sys.stderr)
+        return 1
+
+    summary = {
+        "replay": report.summary(),
+        "drift_warm_share": round(drift_warm_share(sched.metrics), 4),
+        "metrics": sched.metrics_snapshot(),
+    }
+    print(json.dumps(summary))
+    if args.metrics_out:
+        Path(args.metrics_out).write_text(json.dumps(summary, indent=2))
+    if args.fail_uncertified and (
+        report.structural_uncertified or report.failed_ticks
+    ):
+        print(
+            f"error: {report.structural_uncertified} structural event(s) "
+            f"missed the optimality certificate, {report.failed_ticks} "
+            "tick(s) produced no placement at all",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        # Subcommand dispatch; the bare flag form stays the one-shot solver
+        # (reference-CLI compatible), so existing invocations are untouched.
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     from ..axon_guard import force_cpu_if_env_requested

@@ -1,0 +1,63 @@
+"""Fleet scheduler service: the solver library run as a long-lived daemon.
+
+``distilp_tpu.solver`` answers "where do the layers/experts go, right now?"
+one call at a time; nothing owns a fleet over time. This package does:
+
+- ``events``    — typed device-churn events + the JSONL trace format;
+- ``fleet``     — the mutable fleet snapshot events apply to;
+- ``scheduler`` — the replanning core: one warm ``StreamingReplanner`` per
+  (fleet, model) identity in a bounded LRU pool, drift events riding warm/
+  margin ticks, structural events re-solving (warm when the identity was
+  seen before, cold otherwise), latest certified placement always served;
+- ``metrics``   — per-tick counters + latency histograms as a plain dict;
+- ``sim``       — deterministic churn scenario generator + trace replay.
+
+The design target is the restarted-PDHG observation (arXiv:2407.16144)
+packaged as infrastructure (arXiv:2412.09734): repeated nearby solves
+should keep their warm state alive across invocations, which only a
+long-lived process can do.
+"""
+
+from .events import (
+    DRIFT_KINDS,
+    STRUCTURAL_KINDS,
+    DeviceDegrade,
+    DeviceJoin,
+    DeviceLeave,
+    FleetEvent,
+    LoadTick,
+    ModelSwap,
+    event_from_dict,
+    is_structural,
+    read_trace,
+    write_trace,
+)
+from .fleet import FleetState
+from .metrics import LatencyHist, SchedulerMetrics
+from .scheduler import PlacementView, Scheduler, WarmPool, drift_warm_share
+from .sim import ReplayReport, generate_trace, replay
+
+__all__ = [
+    "DeviceJoin",
+    "DeviceLeave",
+    "DeviceDegrade",
+    "ModelSwap",
+    "LoadTick",
+    "FleetEvent",
+    "STRUCTURAL_KINDS",
+    "DRIFT_KINDS",
+    "is_structural",
+    "event_from_dict",
+    "read_trace",
+    "write_trace",
+    "FleetState",
+    "SchedulerMetrics",
+    "LatencyHist",
+    "Scheduler",
+    "WarmPool",
+    "drift_warm_share",
+    "PlacementView",
+    "ReplayReport",
+    "generate_trace",
+    "replay",
+]

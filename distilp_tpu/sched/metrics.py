@@ -1,0 +1,107 @@
+"""Scheduler observability: counters + latency histograms, plain dicts out.
+
+One ``SchedulerMetrics`` instance funnels everything: the scheduler counts
+events, pool traffic and per-event latency; every pooled
+``StreamingReplanner`` reports its tick mode (cold / warm / margin),
+certification outcome and fallback-ladder escalations through the same
+object (``solver.streaming`` calls ``record_tick`` when a metrics sink is
+attached — duck-typed, so the solver package does not import this one).
+
+``snapshot()`` returns nothing but plain ints/floats in dicts — safe to
+``json.dumps`` straight into a bench line or a /metrics endpoint.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import Dict, List
+
+TICK_MODES = ("cold", "warm", "margin")
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank quantile on an already-sorted list (no numpy needed)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class LatencyHist:
+    """Latency recorder with p50/p99 snapshots.
+
+    Keeps raw samples (traces are thousands of events, not millions); the
+    snapshot sorts once. ``cap`` bounds memory for genuinely long-lived
+    daemons by keeping the most recent window.
+    """
+
+    def __init__(self, cap: int = 100_000):
+        # deque(maxlen=...) keeps the recent-window trim O(1) per record;
+        # the snapshot (rare) pays the sort.
+        self._vals: "deque[float]" = deque(maxlen=cap)
+        self.count = 0
+        self.total = 0.0
+
+    def record(self, ms: float) -> None:
+        self.count += 1
+        self.total += ms
+        self._vals.append(float(ms))
+
+    def snapshot(self) -> Dict[str, float]:
+        vals = sorted(self._vals)
+        return {
+            "count": self.count,
+            "mean_ms": round(self.total / self.count, 3) if self.count else 0.0,
+            "p50_ms": round(_quantile(vals, 0.50), 3),
+            "p99_ms": round(_quantile(vals, 0.99), 3),
+            "max_ms": round(vals[-1], 3) if vals else 0.0,
+        }
+
+
+class SchedulerMetrics:
+    """Counters + histograms for one scheduler (or one replanner)."""
+
+    def __init__(self):
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.hists: Dict[str, LatencyHist] = {}
+
+    # -- generic sinks ----------------------------------------------------
+
+    def inc(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    def observe(self, name: str, ms: float) -> None:
+        hist = self.hists.get(name)
+        if hist is None:
+            hist = self.hists[name] = LatencyHist()
+        hist.record(ms)
+
+    # -- the replanner hook (see StreamingReplanner.metrics) --------------
+
+    def record_tick(self, mode: str, certified: bool, escalations: int = 0) -> None:
+        """One solver tick: its mode, certificate, and ladder escalations."""
+        if mode not in TICK_MODES:
+            mode = "cold"
+        self.inc(f"tick_{mode}")
+        self.inc("tick_certified" if certified else "tick_uncertified")
+        if escalations:
+            self.inc("fallback_escalations", escalations)
+
+    # -- derived views ----------------------------------------------------
+
+    def tick_total(self) -> int:
+        return sum(self.counters[f"tick_{m}"] for m in TICK_MODES)
+
+    def pool_hit_rate(self) -> float:
+        hits = self.counters["pool_hit"]
+        total = hits + self.counters["pool_miss"]
+        return hits / total if total else 0.0
+
+    def snapshot(self) -> dict:
+        """Plain-dict view: counters, derived rates, histogram quantiles."""
+        return {
+            "counters": dict(self.counters),
+            "pool_hit_rate": round(self.pool_hit_rate(), 4),
+            "tick_total": self.tick_total(),
+            "latency": {name: h.snapshot() for name, h in self.hists.items()},
+        }

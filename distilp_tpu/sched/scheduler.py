@@ -1,0 +1,249 @@
+"""The replanning core: events in, certified placements out, warm state kept.
+
+``Scheduler`` owns a ``FleetState`` and a bounded LRU **warm pool** of
+``StreamingReplanner`` instances keyed by (fleet_digest, model_digest).
+Routing follows the event classes:
+
+- **drift** events (degrade / load) keep the key, so the tick lands on the
+  same warm replanner — a warm re-solve (dense) or a margin tick (MoE
+  chains), exactly the solver's streaming fast paths;
+- **structural** events (join / leave / model swap) change the key. A key
+  seen before gets its replanner — and its warm incumbent, duals and
+  margin anchor — back from the pool (a device flapping out and back in
+  replans warm: this is the placement cache); a brand-new key starts cold.
+
+Serving never blocks on solving: ``latest()`` returns the most recently
+*published* placement plus staleness metadata (events behind, age). A tick
+that fails (e.g. the fleet drifted infeasible) increments a counter and
+leaves the last placement served; certification is the replanner's
+escalation ladder's job and its outcome is recorded per tick.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Callable, NamedTuple, Optional, Sequence, Tuple
+
+from ..common import DeviceProfile, ModelProfile
+from ..solver.result import HALDAResult
+from ..solver.streaming import StreamingReplanner
+from .fleet import FleetState
+from .metrics import SchedulerMetrics
+
+
+class PlacementView(NamedTuple):
+    """One served placement + how stale it is relative to the event stream."""
+
+    result: HALDAResult
+    seq: int  # fleet seq the placement was solved at
+    fleet_seq: int  # fleet seq at read time
+    events_behind: int  # fleet_seq - seq (0 = fresh)
+    age_s: float  # wall-clock seconds since publication
+    mode: str  # 'cold' | 'warm' | 'margin' tick that produced it
+    key: Tuple[str, str]  # (fleet_digest, model_digest) it was solved under
+
+
+class WarmPool:
+    """Bounded LRU of warm replanners, keyed by problem identity.
+
+    Eviction drops the warm state (incumbent, duals, margin anchor) — the
+    next solve under that key is cold but still correct; the pool trades
+    re-solve speed for bounded memory, never answers.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        factory: Callable[[], StreamingReplanner],
+        metrics: Optional[SchedulerMetrics] = None,
+    ):
+        if capacity < 1:
+            raise ValueError("warm pool capacity must be >= 1")
+        self.capacity = capacity
+        self._factory = factory
+        self._metrics = metrics
+        self._pool: "OrderedDict[tuple, StreamingReplanner]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._pool)
+
+    def __contains__(self, key: tuple) -> bool:
+        return key in self._pool
+
+    def get(self, key: tuple) -> Tuple[StreamingReplanner, bool]:
+        """(replanner, was_a_hit) for the key, creating + evicting LRU-style."""
+        planner = self._pool.get(key)
+        hit = planner is not None
+        if hit:
+            self._pool.move_to_end(key)
+        else:
+            planner = self._factory()
+            self._pool[key] = planner
+            while len(self._pool) > self.capacity:
+                self._pool.popitem(last=False)
+                if self._metrics is not None:
+                    self._metrics.inc("pool_evict")
+        if self._metrics is not None:
+            self._metrics.inc("pool_hit" if hit else "pool_miss")
+        return planner, hit
+
+
+class Scheduler:
+    """Event-driven replanning daemon over one fleet + model.
+
+    >>> sched = Scheduler(devs, model, k_candidates=[4, 8])
+    >>> view = sched.handle(DeviceDegrade(name="synth-android-3",
+    ...                                   t_comm_scale=1.2))
+    >>> view.result.certified, view.mode
+    (True, 'warm')
+    >>> sched.latest().events_behind
+    0
+    """
+
+    def __init__(
+        self,
+        devices: Sequence[DeviceProfile],
+        model: ModelProfile,
+        mip_gap: float = 1e-3,
+        kv_bits: str = "4bit",
+        backend: str = "jax",
+        moe: Optional[bool] = None,
+        k_candidates: Optional[Sequence[int]] = None,
+        warm_pool_size: int = 4,
+        solve_on_init: bool = False,
+        metrics: Optional[SchedulerMetrics] = None,
+    ):
+        self.fleet = FleetState(list(devices), model)
+        self.mip_gap = mip_gap
+        self.kv_bits = kv_bits
+        self.backend = backend
+        self.moe = moe
+        self.k_candidates = list(k_candidates) if k_candidates else None
+        self.metrics = metrics if metrics is not None else SchedulerMetrics()
+        self.pool = WarmPool(
+            warm_pool_size, self._make_replanner, metrics=self.metrics
+        )
+        self._published: Optional[PlacementView] = None
+        self._published_at: float = 0.0
+        if solve_on_init:
+            self.metrics.inc("init_solve")
+            self._tick(structural=None)
+
+    def _make_replanner(self) -> StreamingReplanner:
+        planner = StreamingReplanner(
+            mip_gap=self.mip_gap,
+            kv_bits=self.kv_bits,
+            backend=self.backend,
+            moe=self.moe,
+        )
+        planner.metrics = self.metrics  # tick modes funnel into one snapshot
+        return planner
+
+    # -- the event loop body ----------------------------------------------
+
+    def handle(self, event) -> PlacementView:
+        """Apply one event and replan; returns the freshly published view.
+
+        Structural events route through the warm pool under their new key;
+        drift events tick the current key's replanner warm. A failed solve
+        (no feasible placement for the mutated fleet) keeps the previous
+        placement published and is visible as ``tick_failed`` + a growing
+        ``events_behind`` on ``latest()``.
+        """
+        structural = self.fleet.apply(event)
+        self.metrics.inc("events_total")
+        self.metrics.inc(f"event_{event.kind}")
+        self.metrics.inc("structural_events" if structural else "drift_events")
+        return self._tick(structural=structural)
+
+    def _tick(self, structural: Optional[bool]) -> PlacementView:
+        """One replan; ``structural=None`` marks the eventless init solve
+        (it times and mode-counts like any tick but belongs to neither
+        routing class, so the per-class counters keep summing to events)."""
+        key = self.fleet.key()
+        planner, _hit = self.pool.get(key)
+        devs = self.fleet.device_list()
+        t0 = time.perf_counter()
+        try:
+            result = planner.step(
+                devs, self.fleet.model, k_candidates=self.k_candidates
+            )
+        except (RuntimeError, ValueError, NotImplementedError) as e:
+            self.metrics.inc("tick_failed")
+            if structural is not None:
+                self.metrics.inc(
+                    "tick_failed_structural" if structural
+                    else "tick_failed_drift"
+                )
+            self._last_error = f"{type(e).__name__}: {e}"
+            if self._published is None:
+                raise
+            return self.latest()
+        ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.observe("event_to_placement", ms)
+        mode = getattr(planner, "last_tick_mode", None) or "cold"
+        if structural is not None:
+            self.metrics.observe(
+                "structural_tick" if structural else "drift_tick", ms
+            )
+            # Mode per routing class: the acceptance gauge (drift should
+            # ride warm/margin, structural may cold-solve) reads these.
+            self.metrics.inc(
+                f"{'structural' if structural else 'drift'}_tick_{mode}"
+            )
+        if structural and not result.certified:
+            self.metrics.inc("structural_uncertified")
+        self._published = PlacementView(
+            result=result,
+            seq=self.fleet.seq,
+            fleet_seq=self.fleet.seq,
+            events_behind=0,
+            age_s=0.0,
+            mode=mode,
+            key=key,
+        )
+        self._published_at = time.monotonic()
+        return self._published
+
+    # -- the read side -----------------------------------------------------
+
+    def latest(self) -> PlacementView:
+        """The most recent published placement, with live staleness fields.
+
+        Never solves, never blocks: readers pay a tuple copy. Raises
+        ``RuntimeError`` only when nothing has ever been published.
+        """
+        if self._published is None:
+            raise RuntimeError(
+                "no placement published yet; handle an event first (or "
+                "construct with solve_on_init=True)"
+            )
+        return self._published._replace(
+            fleet_seq=self.fleet.seq,
+            events_behind=self.fleet.seq - self._published.seq,
+            age_s=time.monotonic() - self._published_at,
+        )
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    _last_error: Optional[str] = None
+
+
+def drift_warm_share(metrics: SchedulerMetrics) -> float:
+    """Fraction of drift events served by warm or margin ticks.
+
+    The streaming north star's health gauge: pure coefficient drift should
+    essentially never pay a cold solve (the acceptance bar is >= 0.6; in
+    practice it is ~1.0 — cold drift ticks mean the pool is thrashing).
+    Failed drift ticks count against the share; a tick the escalation
+    ladder restarted cold still counts by its ENTRY mode, since the entry
+    mode is what the event routing chose.
+    """
+    c = metrics.counters
+    drift = c["drift_events"]
+    if not drift:
+        return 1.0
+    fast = c["drift_tick_warm"] + c["drift_tick_margin"]
+    return fast / drift

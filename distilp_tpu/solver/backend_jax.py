@@ -1697,9 +1697,15 @@ def _solve_packed_impl(
     per_k_bound (n_k)]`` — and switches the search to per-k pruning (every
     feasible k terminates with its own optimum and certificate).
     """
-    assert not has_margin or (has_duals and has_warm), (
-        "margin fast path requires stored duals AND a warm incumbent"
-    )
+    if has_margin and not (has_duals and has_warm):
+        # Static-arg invariant, so it must survive `python -O` (an assert
+        # would not): tracing with has_margin but no duals block would
+        # build a program whose output decode is silently mis-aligned.
+        raise ValueError(
+            "margin fast path requires stored duals AND a warm incumbent "
+            f"(has_margin={has_margin}, has_duals={has_duals}, "
+            f"has_warm={has_warm})"
+        )
     lay = VarLayout(M, moe)
     N = lay.n_vars
     m_ub = m - lay.n_eq
@@ -2470,6 +2476,29 @@ class PendingSweep(NamedTuple):
     margin_ctx: Optional[tuple] = None
 
 
+def _expected_out_len(
+    M: int, n_k: int, moe: bool, w_max: int, per_k: bool,
+    has_margin: bool, Yn: int,
+) -> int:
+    """Total ``_solve_packed`` output length implied by the static flags.
+
+    Mirrors the pack order at the end of ``_solve_packed_impl``: header +
+    incumbent vectors + per-k bests, then (when the decomposition context
+    exists) the duals block, then the per-k assignment block, then — LAST,
+    and only on full-evaluation ticks — the margin anchor's y-profile.
+    The input side has the off64 layout-drift assert; this is its output
+    twin, guarding the negative tail slice the margin anchor is read with.
+    """
+    n = 4 + 3 * M + n_k
+    if moe and w_max > 0:
+        n += 3 * n_k + n_k * M  # lam, mu, tau, root_bounds
+    if per_k:
+        n += 3 * n_k * M + n_k  # per_k_w/n/y, per_k_bound
+    if moe and w_max > 0 and not has_margin:
+        n += n_k * M * Yn  # m_y anchor profile
+    return n
+
+
 def collect_sweep(
     pending: PendingSweep,
 ) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
@@ -2483,6 +2512,23 @@ def collect_sweep(
     )
     if pending.margin_ctx is not None:
         margin_state, has_margin, rd_np, ks_arr, Ws_arr = pending.margin_ctx
+        # Tail reads below depend on 'm_y appended LAST'; verify the whole
+        # layout from the static flags before trusting a negative slice
+        # (margin-tick certificates depend on the anchor being exact).
+        Yn = int(np.asarray(rd_np["E"])) + 1
+        expected = _expected_out_len(
+            pending.M, pending.n_k, pending.moe, pending.w_max,
+            pending.per_k, has_margin, Yn,
+        )
+        if out.shape[0] != expected:
+            # Explicit raise (not `assert`) so the guard survives
+            # `python -O` — same rationale as the has_margin invariant in
+            # _solve_packed_impl; a mis-aligned tail silently corrupts
+            # the margin anchor and every certificate derived from it.
+            raise AssertionError(
+                f"_solve_packed/collect_sweep output layout drift: got "
+                f"{out.shape[0]} values, static flags imply {expected}"
+            )
         margin_state["used"] = has_margin
         if has_margin:
             # Margin tick: the stored full-eval anchor stays FIXED — every
@@ -2497,7 +2543,6 @@ def collect_sweep(
         ):
             # Full evaluation: refresh the anchor — rd vectors, duals, and
             # the per-device y-profile read from the output tail.
-            Yn = int(np.asarray(rd_np["E"])) + 1
             m_y_flat = out[-pending.n_k * pending.M * Yn:]
             margin_state.update(
                 rd=rd_np,

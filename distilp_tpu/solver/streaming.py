@@ -46,6 +46,13 @@ class StreamingReplanner:
         self.moe = moe
         self.last: Optional[HALDAResult] = None
         self.last_mapping = None  # ExpertMapping of the last load-aware tick
+        # Observability (see distilp_tpu.sched.metrics): an optional sink
+        # with record_tick(mode, certified, escalations) — duck-typed so
+        # the solver package stays import-free of the scheduler service —
+        # plus the same facts as plain attributes for direct callers.
+        self.metrics = None
+        self.last_tick_mode: Optional[str] = None  # 'cold'|'warm'|'margin'
+        self.last_tick_escalations: int = 0
         self._last_shape: Optional[tuple] = None
         self._load_factors = None  # realized per-device load multipliers
         self._in_flight: list = []  # (PendingHalda, shape, devs, model, loads)
@@ -153,11 +160,14 @@ class StreamingReplanner:
         a dense solve that misses its certificate does so for search-
         budget reasons a cold re-solve would not fix.
         """
-        if (
-            not result.certified
-            and self._margin_state.pop("used", False)
-            and warm is not None
-        ):
+        # Consume the margin-path report unconditionally: 'used' describes
+        # THIS tick only, and a stale True surviving a short-circuit (e.g.
+        # a later dense or shape-change tick that never rewrites the key)
+        # would misreport that tick as a margin tick.
+        margin_used = self._margin_state.pop("used", False)
+        escalations = 0
+        if not result.certified and margin_used and warm is not None:
+            escalations += 1
             self._margin_state.pop("m_y", None)
             result = halda_solve(
                 devs,
@@ -172,7 +182,11 @@ class StreamingReplanner:
                 timings=timings,
                 margin_state=self._margin_state,
             )
+            # The retry's own report is irrelevant here (the anchor was
+            # dropped, so it cannot be a margin tick); keep the key clean.
+            self._margin_state.pop("used", None)
         if warm is not None and warm.duals is not None and not result.certified:
+            escalations += 1
             result = halda_solve(
                 devs,
                 model,
@@ -184,6 +198,17 @@ class StreamingReplanner:
                 load_factors=factors,
                 timings=timings,
                 margin_state=self._margin_state,
+            )
+            self._margin_state.pop("used", None)
+        self.last_tick_mode = (
+            "margin" if margin_used else ("warm" if warm is not None else "cold")
+        )
+        self.last_tick_escalations = escalations
+        if self.metrics is not None:
+            self.metrics.record_tick(
+                mode=self.last_tick_mode,
+                certified=result.certified,
+                escalations=escalations,
             )
         return result
 
@@ -285,6 +310,8 @@ class StreamingReplanner:
     def reset(self) -> None:
         self.last = None
         self.last_mapping = None
+        self.last_tick_mode = None
+        self.last_tick_escalations = 0
         self._last_shape = None
         self._load_factors = None
         self._in_flight = []

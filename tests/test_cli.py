@@ -446,3 +446,71 @@ def test_solver_cli_per_k(tmp_path, capsys):
         ["--profile", str(PROFILES / "hermes_70b"), "--backend", "cpu", "--per-k"]
     )
     assert rc == 2  # needs the jax backend
+
+
+def test_solver_cli_serve_trace(tmp_path, capsys):
+    """`solver serve` replays the bundled churn trace through the scheduler
+    daemon: rc 0 with --fail-uncertified, a JSON summary line, and a
+    metrics snapshot on disk — the same invocation `make smoke-sched` runs."""
+    from distilp_tpu.cli.solver_cli import main
+
+    metrics_out = tmp_path / "metrics.json"
+    rc = main(
+        [
+            "serve",
+            "--trace",
+            str(Path(__file__).resolve().parent / "traces" / "scheduler_smoke_20.jsonl"),
+            "--profile",
+            str(PROFILES / "llama_3_70b" / "online"),
+            "--synthetic-fleet",
+            "4",
+            "--fleet-seed",
+            "11",
+            "--k-candidates",
+            "8,10",
+            "--quiet",
+            "--fail-uncertified",
+            "--metrics-out",
+            str(metrics_out),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["replay"]["events"] == 20
+    assert summary["replay"]["structural_uncertified"] == 0
+    assert summary["replay"]["failed_ticks"] == 0
+    assert summary["drift_warm_share"] >= 0.6
+    saved = json.loads(metrics_out.read_text())
+    assert saved["metrics"]["counters"]["events_total"] == 20
+    assert saved["metrics"]["counters"].get("tick_uncertified", 0) == 0
+
+
+def test_solver_cli_serve_rejects_bad_inputs(tmp_path):
+    from distilp_tpu.cli.solver_cli import main
+
+    # Missing trace file.
+    rc = main(
+        [
+            "serve",
+            "--trace",
+            str(tmp_path / "nope.jsonl"),
+            "--profile",
+            str(PROFILES / "llama_3_70b" / "online"),
+        ]
+    )
+    assert rc == 2
+
+    # Malformed trace line.
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"kind": "leave"}\n')  # missing required 'name'
+    rc = main(
+        [
+            "serve",
+            "--trace",
+            str(bad),
+            "--profile",
+            str(PROFILES / "llama_3_70b" / "online"),
+        ]
+    )
+    assert rc == 2

@@ -1,0 +1,294 @@
+"""Scheduler service: events, fleet state, warm-pooled replanning, metrics.
+
+Solver-backed tests run the JAX backend on CPU with a small L=32 model and
+a restricted k-grid so each tick after jit warmup is milliseconds; the
+distinct fleet shapes (and thus compiles) are kept to a handful.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from distilp_tpu.sched import (
+    DeviceDegrade,
+    DeviceJoin,
+    DeviceLeave,
+    FleetState,
+    LoadTick,
+    Scheduler,
+    drift_warm_share,
+    generate_trace,
+    is_structural,
+    read_trace,
+    replay,
+    write_trace,
+)
+from distilp_tpu.sched.metrics import LatencyHist
+from distilp_tpu.utils import make_synthetic_fleet
+
+GAP = 1e-3
+KS = [4, 8]  # proper factors of L=32; W=8,4 keeps small fleets feasible
+
+
+@pytest.fixture(scope="module")
+def model():
+    from distilp_tpu.profiler.api import profile_model
+
+    return profile_model(
+        "tests/configs/llama31_8b_4bit.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+
+
+@pytest.fixture()
+def fleet():
+    return make_synthetic_fleet(4, seed=11)
+
+
+def make_scheduler(fleet, model, **kw):
+    kw.setdefault("mip_gap", GAP)
+    kw.setdefault("kv_bits", "4bit")
+    kw.setdefault("backend", "jax")
+    kw.setdefault("k_candidates", KS)
+    return Scheduler(fleet, model, **kw)
+
+
+# -- events + trace format (no solver) ------------------------------------
+
+
+def test_trace_jsonl_roundtrip(tmp_path, fleet):
+    trace = generate_trace("mixed", 40, seed=3, base_fleet=fleet)
+    path = tmp_path / "trace.jsonl"
+    write_trace(path, trace)
+    back = read_trace(path)
+    assert len(back) == len(trace)
+    for a, b in zip(trace, back):
+        assert type(a) is type(b)
+        assert a.model_dump() == b.model_dump()
+    # Generation itself is seed-deterministic, event for event.
+    again = generate_trace("mixed", 40, seed=3, base_fleet=fleet)
+    assert [e.model_dump() for e in again] == [e.model_dump() for e in trace]
+    # Scenario mix covers the advertised churn classes, including the
+    # bandwidth-decay degrade flavor (not just t_comm jitter).
+    kinds = {e.kind for e in trace}
+    assert "join" in kinds or "leave" in kinds
+    assert kinds & {"degrade", "load"}
+    assert any(
+        e.kind == "degrade" and e.bandwidth_scale != 1.0 for e in trace
+    )
+
+
+def test_fleet_apply_semantics(fleet, model):
+    fs = FleetState(fleet, model)
+    names = [d.name for d in fleet]
+    base_key = fs.key()
+
+    # Drift: digest stable, coefficients move.
+    t0 = fs.devices[names[1]].t_comm
+    assert fs.apply(DeviceDegrade(name=names[1], t_comm_scale=1.5)) is False
+    assert fs.devices[names[1]].t_comm == pytest.approx(t0 * 1.5)
+    assert fs.key() == base_key
+
+    # Memory degrade shrinks every advertised pool; bandwidth decay scales
+    # the measured link rate (when the profile carries one).
+    ram0 = fs.devices[names[2]].d_avail_ram
+    fs.devices[names[2]].comm_bandwidth = 1e9
+    fs.apply(DeviceDegrade(name=names[2], mem_scale=0.5, bandwidth_scale=0.9))
+    assert fs.devices[names[2]].d_avail_ram == int(ram0 * 0.5)
+    assert fs.devices[names[2]].comm_bandwidth == pytest.approx(0.9e9)
+
+    # Leave of the head promotes the next device; digest changes.
+    assert fs.apply(DeviceLeave(name=names[0])) is True
+    assert fs.key() != base_key
+    assert fs.device_list()[0].is_head
+    assert sum(d.is_head for d in fs.device_list()) == 1
+
+    # Join lands at the tail, never as head.
+    joiner = make_synthetic_fleet(1, seed=99)[0]
+    joiner.name = "joiner-0"
+    joiner.is_head = True  # must be demoted on entry
+    fs.apply(DeviceJoin(device=joiner))
+    assert fs.device_list()[-1].name == "joiner-0"
+    assert not fs.device_list()[-1].is_head
+
+    # Strictness: malformed events are errors, not silent no-ops.
+    with pytest.raises(ValueError):
+        fs.apply(DeviceLeave(name="nobody"))
+    with pytest.raises(ValueError):
+        fs.apply(DeviceJoin(device=joiner))  # duplicate name
+    with pytest.raises(ValueError):
+        fs.apply(LoadTick(t_comm_jitter={"nobody": 1.1}))
+
+    # seq counts successfully applied events (rejected ones don't count).
+    assert fs.seq == 4
+
+
+def test_latency_hist_quantiles():
+    h = LatencyHist()
+    for v in range(1, 101):
+        h.record(float(v))
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["p50_ms"] == pytest.approx(50.0, abs=1.0)
+    assert snap["p99_ms"] == pytest.approx(99.0, abs=1.0)
+    assert snap["max_ms"] == 100.0
+    assert json.dumps(snap)  # plain types only
+
+
+# -- the replanning core (JAX backend on CPU) ------------------------------
+
+
+def test_50_event_churn_acceptance(fleet, model):
+    """The acceptance trace: 50 seeded churn events (joins, leaves,
+    bandwidth decay, load drift) replay end-to-end; every structural event
+    yields a certified placement; drift rides warm/margin ticks; the
+    metrics snapshot agrees with the tick modes — and a second scheduler
+    replaying the same trace reproduces the placement sequence exactly."""
+    trace = generate_trace("mixed", 50, seed=23, base_fleet=fleet)
+    assert len(trace) == 50
+
+    sched = make_scheduler([d.model_copy(deep=True) for d in fleet], model)
+    report = replay(sched, trace)
+    assert report.failed_ticks == 0
+    assert report.structural_uncertified == 0
+    for ev, view in zip(trace, report.views):
+        if is_structural(ev):
+            assert view.result.certified, f"uncertified structural {ev.kind}"
+        assert view.events_behind == 0  # every event produced a placement
+        assert sum(view.result.w) * view.result.k == model.L
+
+    # Drift events must ride the streaming fast paths.
+    assert drift_warm_share(sched.metrics) >= 0.6
+
+    # Metrics agree with tick modes over the whole trace.
+    c = sched.metrics.counters
+    assert c["events_total"] == 50
+    assert c["structural_events"] + c["drift_events"] == 50
+    assert sched.metrics.tick_total() == 50 - c["tick_failed"]
+    assert c["tick_certified"] == 50
+    assert c["tick_uncertified"] == 0
+    # Mode split per routing class adds back up to the global mode counts.
+    for mode in ("cold", "warm", "margin"):
+        assert (
+            c[f"structural_tick_{mode}"] + c[f"drift_tick_{mode}"]
+            == c[f"tick_{mode}"]
+        )
+    # Latency histograms saw every tick.
+    snap = sched.metrics_snapshot()
+    assert snap["latency"]["event_to_placement"]["count"] == 50
+    assert json.dumps(snap)  # plain-dict contract
+
+    # Determinism: same trace, fresh scheduler => identical placements.
+    sched2 = make_scheduler([d.model_copy(deep=True) for d in fleet], model)
+    report2 = replay(sched2, trace)
+    seq1 = [
+        (v.result.k, tuple(v.result.w), tuple(v.result.n), v.result.obj_value)
+        for v in report.views
+    ]
+    seq2 = [
+        (v.result.k, tuple(v.result.w), tuple(v.result.n), v.result.obj_value)
+        for v in report2.views
+    ]
+    assert seq1 == seq2
+
+
+def test_warm_pool_eviction_keeps_serving(fleet, model):
+    """Pool capacity 1: every identity change evicts the previous warm
+    replanner. Correctness must not care — evicted identities re-solve
+    cold and still certify."""
+    trace = generate_trace("flap", 14, seed=5, base_fleet=fleet)
+    assert any(e.kind == "leave" for e in trace)
+    sched = make_scheduler(
+        [d.model_copy(deep=True) for d in fleet], model, warm_pool_size=1
+    )
+    report = replay(sched, trace)
+    c = sched.metrics.counters
+    assert c["pool_evict"] >= 2
+    assert len(sched.pool) == 1
+    assert report.failed_ticks == 0
+    assert all(v.result.certified for v in report.views)
+    # Flapped-back identities were NOT warm (capacity 1 evicted them), so
+    # structural ticks all ran cold — the pool trades speed, not answers.
+    assert c["structural_tick_warm"] == 0
+
+
+def test_degrade_event_triggers_recertification(fleet, model):
+    """A degrade event must produce a freshly certified placement (not a
+    stale serve): the tick runs warm and re-certifies under the degraded
+    coefficients."""
+    sched = make_scheduler([d.model_copy(deep=True) for d in fleet], model)
+    first = sched.handle(LoadTick(t_comm_jitter={}))  # initial cold solve
+    assert first.result.certified and first.mode == "cold"
+
+    target = fleet[2].name
+    view = sched.handle(DeviceDegrade(name=target, t_comm_scale=2.0))
+    assert view.events_behind == 0  # a new placement was published
+    assert view.result.certified
+    assert view.mode == "warm"  # same identity -> warm fast path
+    c = sched.metrics.counters
+    assert c["drift_tick_warm"] == 1
+    assert c["tick_certified"] == 2
+
+    # The degraded link is priced in: solving the degraded fleet cold
+    # agrees with the warm tick's objective.
+    from distilp_tpu.solver import halda_solve
+
+    cold = halda_solve(
+        sched.fleet.device_list(), model, k_candidates=KS,
+        mip_gap=GAP, kv_bits="4bit", backend="jax",
+    )
+    assert abs(view.result.obj_value - cold.obj_value) <= (
+        2 * GAP * abs(cold.obj_value) + 1e-9
+    )
+
+
+def test_failed_tick_serves_stale(fleet, model):
+    """An event that makes the instance infeasible (fleet outgrows the
+    k-grid) must not take the service down: the tick fails, the previous
+    placement stays served, staleness is visible."""
+    sched = make_scheduler(
+        [d.model_copy(deep=True) for d in fleet], model, k_candidates=[8]
+    )  # k=8 -> W=4: feasible at M=4, infeasible at M=5
+    ok = sched.handle(LoadTick(t_comm_jitter={}))
+    assert ok.result.certified
+
+    joiner = make_synthetic_fleet(1, seed=77)[0]
+    joiner.name = "late-joiner"
+    view = sched.handle(DeviceJoin(device=joiner))
+    # The returned view is the STALE placement, one event behind.
+    assert view.events_behind == 1
+    assert view.result.k == ok.result.k
+    assert sched.metrics.counters["tick_failed"] == 1
+    assert sched.metrics.counters["tick_failed_structural"] == 1
+    later = sched.latest()
+    assert later.events_behind == 1
+    assert later.seq == ok.seq
+
+
+def test_moe_drift_ticks_ride_margin_path():
+    """MoE identity: scheduler drift ticks engage the margin fast path and
+    the metrics record them as margin ticks (the dense tests above can
+    only ever see cold/warm)."""
+    from distilp_tpu.profiler.api import profile_model
+
+    moe_model = profile_model(
+        "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    sched = Scheduler(
+        devs, moe_model, mip_gap=GAP, kv_bits="8bit", backend="jax"
+    )
+    names = [d.name for d in devs]
+    first = sched.handle(DeviceDegrade(name=names[1], t_comm_scale=1.01))
+    assert first.result.certified and first.result.y is not None
+
+    for scale in (1.03, 0.98):
+        view = sched.handle(DeviceDegrade(name=names[2], t_comm_scale=scale))
+        assert view.result.certified
+        assert view.mode == "margin"
+    c = sched.metrics.counters
+    assert c["drift_tick_margin"] == 2
+    assert c["tick_margin"] == 2
+    # 3 drift events: the bootstrap cold tick + 2 margin ticks.
+    assert drift_warm_share(sched.metrics) == pytest.approx(2 / 3)
