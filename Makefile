@@ -1,13 +1,24 @@
+# dlint (tools/dlint/) is the stdlib-only correctness gate and runs
+# everywhere; ruff stays authoritative for style wherever it is installed.
 .PHONY: lint
 lint:
-	@command -v ruff >/dev/null 2>&1 && ruff check . || python tools/lint.py
+	python -m tools.dlint
+	@if command -v ruff >/dev/null 2>&1; then ruff check .; fi
+
+# Strict gate for CI and the tier-1 path: any non-baselined finding fails,
+# and the baseline itself must be empty or justified (no stale entries, a
+# reason on every entry). See README "Static analysis gate".
+.PHONY: lint-strict
+lint-strict:
+	python -m tools.dlint --strict
+	@if command -v ruff >/dev/null 2>&1; then ruff check .; fi
 
 .PHONY: format
 format:
 	ruff format --diff .
 
 .PHONY: test
-test:
+test: lint-strict
 	python -m pytest tests/ -q
 
 .PHONY: bench
@@ -17,8 +28,9 @@ bench:
 # Scheduler-service smoke: replay the bundled 20-event churn trace through
 # the daemon on the CPU platform (no slow tests, no accelerator needed);
 # any structural tick missing its optimality certificate fails the target.
+# Chained behind lint-strict so the smoke path can't drift from the gate.
 .PHONY: smoke-sched
-smoke-sched:
+smoke-sched: lint-strict
 	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
 		--trace tests/traces/scheduler_smoke_20.jsonl \
 		--profile tests/profiles/llama_3_70b/online \

@@ -403,7 +403,9 @@ def get_sysmem_info(di: DeviceInfo) -> None:
         nbytes, bench(fill, 5, 10, label="mem.cpu_write_warm", sink=di.stats)
     )
 
-    host_buf = np.random.randn(n // 8).astype(np.float32)
+    # Seeded: the probe buffer's contents must not vary run to run, or the
+    # memcpy timing picks up data-dependent (denormal) effects.
+    host_buf = np.random.default_rng(0).standard_normal(n // 8).astype(np.float32)
     di.memory.memcpy_delay = _ms(
         bench(
             lambda: jax.device_put(host_buf, cpu), 1, 5,

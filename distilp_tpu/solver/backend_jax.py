@@ -1725,10 +1725,14 @@ def _solve_packed_impl(
     hi_k = take_s(n_k * nf).reshape(n_k, nf)
     smin_k = take_s(n_k * m_ub).reshape(n_k, m_ub)
     int_mask = take_s(nf) > 0.5
-    assert off == static_blob.shape[0], (
-        f"_pack_static/_solve_packed layout drift: "
-        f"consumed {off} of {static_blob.shape[0]}"
-    )
+    if off != static_blob.shape[0]:
+        # Trace-time static invariant (shapes are Python ints here); it must
+        # survive `python -O` — a layout drift would decode the blob
+        # mis-aligned and corrupt the certificate, not crash.
+        raise ValueError(
+            f"_pack_static/_solve_packed layout drift: "
+            f"consumed {off} of {static_blob.shape[0]}"
+        )
 
     offd = 0
 
@@ -1772,10 +1776,12 @@ def _solve_packed_impl(
         d_tau = take(n_k * M).reshape(n_k, M)
         init_duals = (d_lam, d_mu, d_tau)
     margin_bounds = take(n_k) if has_margin else None
-    assert off64 == f64v.shape[0], (
-        f"_pack_dynamic/_solve_packed layout drift: "
-        f"consumed {off64} of {f64v.shape[0]}"
-    )
+    if off64 != f64v.shape[0]:
+        # Same class as the static-blob check above: must survive -O.
+        raise ValueError(
+            f"_pack_dynamic/_solve_packed layout drift: "
+            f"consumed {off64} of {f64v.shape[0]}"
+        )
 
     # --- in-trace materialization of the b-dependent / per-k pieces ---
     # Slack boxes: hi_slack = max(b_scaled - smin, 0), mirroring the host

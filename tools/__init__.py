@@ -1,0 +1,2 @@
+# Makes `python -m tools.dlint` resolvable from the repo root. The tools
+# package is never imported by library code.

@@ -1,0 +1,37 @@
+"""dlint: the repo's JAX-aware static-analysis gate.
+
+Run as ``python -m tools.dlint`` from the repo root (what ``make lint``
+does). Importing :mod:`tools.dlint.rules` populates the registry as a side
+effect, so pulling anything from this package is enough to have every rule
+available.
+"""
+
+from .core import (
+    Baseline,
+    BaselineEntry,
+    DEFAULT_BASELINE,
+    FileContext,
+    Finding,
+    REPO,
+    RULES,
+    Rule,
+    lint_paths,
+    lint_source,
+    run,
+)
+from . import rules  # registers the rules (and is re-exported via __all__)
+
+__all__ = [
+    "Baseline",
+    "BaselineEntry",
+    "DEFAULT_BASELINE",
+    "FileContext",
+    "Finding",
+    "REPO",
+    "RULES",
+    "Rule",
+    "lint_paths",
+    "lint_source",
+    "run",
+    "rules",
+]

@@ -1,0 +1,192 @@
+"""CLI for the dlint gate.
+
+    python -m tools.dlint [paths ...]        # baseline-aware gate
+    python -m tools.dlint --strict           # + baseline hygiene (CI)
+    python -m tools.dlint --list-rules       # rule codes + rationale
+    python -m tools.dlint --select DLP012    # run a subset
+    python -m tools.dlint --write-baseline   # grandfather current findings
+
+Exit status: 0 clean, 1 findings (or, under --strict, stale/unjustified
+baseline entries), 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import textwrap
+from pathlib import Path
+
+from .core import DEFAULT_BASELINE, RULES, Baseline, BaselineEntry, run
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dlint",
+        description="JAX-aware static-analysis gate (stdlib-only)",
+    )
+    p.add_argument("paths", nargs="*", help="files/dirs (default: whole repo)")
+    p.add_argument(
+        "--strict",
+        action="store_true",
+        help="also fail on stale or unjustified baseline entries",
+    )
+    p.add_argument(
+        "--baseline",
+        default=str(DEFAULT_BASELINE),
+        help="baseline JSON path (default: tools/dlint/baseline.json)",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: every finding fails",
+    )
+    p.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule codes to run (default: all)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print rules and exit"
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from current findings and exit 0 "
+        "(reasons start as TODO; --strict fails until they are justified "
+        "or the findings fixed)",
+    )
+    p.add_argument("--quiet", action="store_true", help="findings only")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RULES):
+            rule = RULES[code]
+            print(f"{code} {rule.name}")
+            print(textwrap.indent(textwrap.fill(rule.rationale, 74), "    "))
+        return 0
+
+    select = None
+    if args.select:
+        select = [c.strip().upper() for c in args.select.split(",") if c.strip()]
+        unknown = [c for c in select if c not in RULES]
+        if unknown:
+            print(f"error: unknown rule code(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    paths = [Path(p) for p in args.paths] or None
+    if paths:
+        for p in paths:
+            if not p.exists():
+                print(f"error: no such path: {p}", file=sys.stderr)
+                return 2
+
+    baseline_path = Path(args.baseline)
+    baseline = (
+        Baseline() if args.no_baseline else Baseline.load(baseline_path)
+    )
+
+    if args.write_baseline and args.no_baseline:
+        # The rewrite path carries existing reasons forward; --no-baseline
+        # hides them, so the combination would discard every justification.
+        print(
+            "error: --write-baseline cannot be combined with --no-baseline "
+            "(existing entry reasons would be discarded)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.write_baseline and (paths or select):
+        # A subset run sees only a subset of findings; rewriting the
+        # baseline from it would silently drop every entry outside the
+        # subset (and its human-written reason).
+        print(
+            "error: --write-baseline requires a whole-repo, all-rules run "
+            "(no paths, no --select)",
+            file=sys.stderr,
+        )
+        return 2
+
+    result = run(paths=paths, baseline=baseline, select=select)
+
+    if args.write_baseline:
+        entries = {}
+        for f in result.findings_new + result.findings_baselined:
+            key = (f.path, f.code)
+            if key in entries:
+                entries[key].count += 1
+            else:
+                old_reason = next(
+                    (
+                        e.reason
+                        for e in baseline.entries
+                        if (e.path, e.code) == key and e.reason.strip()
+                    ),
+                    "",
+                )
+                entries[key] = BaselineEntry(
+                    path=f.path, code=f.code, count=1, reason=old_reason
+                )
+        Baseline(entries=list(entries.values())).dump(baseline_path)
+        print(
+            f"baseline written: {len(entries)} entr(y/ies) covering "
+            f"{len(result.findings_new) + len(result.findings_baselined)} "
+            f"finding(s) -> {baseline_path}"
+        )
+        return 0
+
+    for f in result.findings_new:
+        print(f.render())
+    failed = result.failed(strict=args.strict)
+    if args.strict:
+        for e in result.stale_entries:
+            print(
+                f"{e.path}: STALE baseline entry {e.code} x{e.count} "
+                "no longer matches any finding; trim the baseline"
+            )
+        for e in result.unjustified_entries:
+            print(
+                f"{e.path}: baseline entry {e.code} has no reason; "
+                "justify it or fix the finding"
+            )
+    if not args.quiet:
+        n_new = len(result.findings_new)
+        n_old = len(result.findings_baselined)
+        scope = (
+            f"{result.n_files} files" if result.n_files >= 0 else "given paths"
+        )
+        if failed:
+            print(
+                f"dlint: {n_new} finding(s)"
+                + (f", {n_old} baselined" if n_old else "")
+                + (
+                    f", {len(result.stale_entries)} stale / "
+                    f"{len(result.unjustified_entries)} unjustified "
+                    "baseline entr(y/ies)"
+                    if args.strict
+                    and (result.stale_entries or result.unjustified_entries)
+                    else ""
+                )
+            )
+        else:
+            print(
+                f"dlint clean ({scope}, {len(RULES)} rules"
+                + (f", {n_old} baselined finding(s)" if n_old else "")
+                + ")"
+            )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # `dlint ... | head` closed the pipe before we finished printing.
+        # Findings were being printed, so the run must NOT read as clean —
+        # exit 141 (the conventional 128+SIGPIPE), never 0.
+        raise SystemExit(141)

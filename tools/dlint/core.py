@@ -1,0 +1,429 @@
+"""dlint core: findings, rule registry, suppressions, baseline, runner.
+
+Stdlib-only and network-free, like the tools/lint.py gate it grew out of —
+the hermetic build image has no ruff/flake8. Ruff stays authoritative for
+*style* wherever it is installed; dlint owns the repo-specific correctness
+contracts (x64 config placement, trace-boundary host syncs, assert vs
+raise, lazy-jax schema layers, seeded RNG, axon-guard routing) that no
+off-the-shelf linter knows about.
+
+Vocabulary:
+
+- A **rule** is a callable object with a ``code`` (``DLP0xx``) registered in
+  ``RULES``; given a :class:`FileContext` it yields :class:`Finding`\\ s.
+- A ``# dlint: disable=CODE[,CODE]`` comment on the finding's line
+  suppresses it; ``# dlint: disable-file=CODE`` anywhere in the file
+  suppresses the code for the whole file.  ``all`` is accepted as a code.
+- The **baseline** (``tools/dlint/baseline.json``) grandfathers known
+  findings as ``{path, code, count, reason}`` entries so the gate can be
+  adopted without fixing the world first.  Non-strict runs fail only on
+  findings beyond the baseline; ``--strict`` additionally fails on stale
+  entries (count no longer matched) and entries missing a ``reason`` — an
+  empty-or-justified baseline is the steady state CI enforces.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+REPO = Path(__file__).resolve().parents[2]
+SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".venv", "node_modules"}
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+# Codes are bare identifiers separated by commas; anything after the code
+# list (e.g. a prose justification) must NOT be swallowed into the last
+# code token, so no \s inside the capture except around commas.
+_CODES = r"[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*"
+_DISABLE_RE = re.compile(rf"#\s*dlint:\s*disable=({_CODES})")
+_DISABLE_FILE_RE = re.compile(rf"#\s*dlint:\s*disable-file=({_CODES})")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding, printed as ``path:line: CODE message``."""
+
+    path: str  # repo-relative, forward slashes
+    line: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+@dataclass
+class FileContext:
+    """Everything a rule may inspect about one file.
+
+    Built either from disk (the repo walk) or from an in-memory snippet
+    (the fixture tests): rules must only read this object, never the
+    filesystem, so test fixtures exercise them without touching the repo.
+    """
+
+    relpath: str
+    src: str
+    tree: Optional[ast.AST] = None
+    syntax_error: Optional[SyntaxError] = None
+    lines: List[str] = field(default_factory=list)
+    _file_disabled: Optional[set] = None
+    _comments: Optional[Dict[int, str]] = None
+
+    def comments(self) -> Dict[int, str]:
+        """{lineno: comment text} from the tokenizer — NOT a line regex, so
+        directive-looking text inside string literals (test fixtures, doc
+        snippets) can never suppress anything."""
+        if self._comments is None:
+            out: Dict[int, str] = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(self.src).readline
+                ):
+                    if tok.type == tokenize.COMMENT:
+                        out[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                pass  # unparseable tail; DLP000 reports the file anyway
+            self._comments = out
+        return self._comments
+
+    @classmethod
+    def from_source(cls, relpath: str, src: str) -> "FileContext":
+        ctx = cls(relpath=relpath.replace("\\", "/"), src=src)
+        ctx.lines = src.splitlines()
+        try:
+            ctx.tree = ast.parse(src, filename=relpath)
+        except SyntaxError as e:
+            ctx.syntax_error = e
+        return ctx
+
+    @property
+    def is_test(self) -> bool:
+        parts = self.relpath.split("/")
+        return parts[0] == "tests" or parts[-1].startswith("test_")
+
+    @property
+    def in_library(self) -> bool:
+        return self.relpath.startswith("distilp_tpu/")
+
+
+class Rule:
+    """Base class; subclasses set ``code``/``name``/``rationale`` and
+    implement :meth:`check`."""
+
+    code: str = ""
+    name: str = ""
+    rationale: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator adding one instance of the rule to the registry."""
+    rule = cls()
+    if not rule.code or rule.code in RULES:
+        raise ValueError(f"bad or duplicate rule code: {rule.code!r}")
+    RULES[rule.code] = rule
+    return cls
+
+
+# --------------------------------------------------------------------------
+# suppressions
+
+
+def _parse_codes(blob: str) -> set:
+    return {c.strip().upper() for c in blob.split(",") if c.strip()}
+
+
+def file_disabled_codes(ctx: FileContext) -> set:
+    # Computed once per file: is_suppressed runs per finding and must not
+    # rescan every comment each time.
+    if ctx._file_disabled is None:
+        codes: set = set()
+        for comment in ctx.comments().values():
+            m = _DISABLE_FILE_RE.search(comment)
+            if m:
+                codes |= _parse_codes(m.group(1))
+        ctx._file_disabled = codes
+    return ctx._file_disabled
+
+
+def line_disabled_codes(ctx: FileContext, lineno: int) -> set:
+    comment = ctx.comments().get(lineno)
+    if comment:
+        m = _DISABLE_RE.search(comment)
+        if m:
+            return _parse_codes(m.group(1))
+    return set()
+
+
+def is_suppressed(ctx: FileContext, finding: Finding) -> bool:
+    file_codes = file_disabled_codes(ctx)
+    if "ALL" in file_codes or finding.code in file_codes:
+        return True
+    line_codes = line_disabled_codes(ctx, finding.line)
+    return "ALL" in line_codes or finding.code in line_codes
+
+
+# --------------------------------------------------------------------------
+# baseline
+
+
+@dataclass
+class BaselineEntry:
+    path: str
+    code: str
+    count: int = 1
+    reason: str = ""
+
+
+@dataclass
+class Baseline:
+    entries: List[BaselineEntry] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.is_file():
+            return cls()
+        data = json.loads(path.read_text())
+        entries = [
+            BaselineEntry(
+                path=e["path"],
+                code=e["code"],
+                count=int(e.get("count", 1)),
+                reason=e.get("reason", ""),
+            )
+            for e in data.get("entries", [])
+        ]
+        return cls(entries=entries)
+
+    def dump(self, path: Path) -> None:
+        data = {
+            "version": 1,
+            "entries": [
+                {
+                    "path": e.path,
+                    "code": e.code,
+                    "count": e.count,
+                    "reason": e.reason or "TODO: justify or fix",
+                }
+                for e in self.entries
+            ],
+        }
+        path.write_text(json.dumps(data, indent=2) + "\n")
+
+    def partition(
+        self, findings: Iterable[Finding]
+    ) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+        """Split findings into (new, grandfathered) and report stale entries.
+
+        An entry absorbs up to ``count`` findings matching its (path, code);
+        entries that absorb fewer than ``count`` are stale (the violation
+        was fixed but the baseline not trimmed) — strict mode fails on them
+        so the baseline only ever shrinks.
+        """
+        budget: Dict[Tuple[str, str], int] = {}
+        for e in self.entries:
+            # Duplicate (path, code) entries accumulate, they don't overwrite.
+            budget[(e.path, e.code)] = budget.get((e.path, e.code), 0) + e.count
+        new: List[Finding] = []
+        old: List[Finding] = []
+        for f in findings:
+            key = (f.path, f.code)
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        stale = [
+            e for e in self.entries if budget.get((e.path, e.code), 0) > 0
+        ]
+        return new, old, stale
+
+    def unjustified(self) -> List[BaselineEntry]:
+        # The --write-baseline placeholder ("TODO: ...") is by definition
+        # not a justification; strict mode fails until a human replaces it.
+        return [
+            e
+            for e in self.entries
+            if not e.reason.strip() or e.reason.strip().upper().startswith("TODO")
+        ]
+
+
+# --------------------------------------------------------------------------
+# runner
+
+
+def iter_py_files(root: Path = REPO) -> Iterator[Path]:
+    for p in sorted(root.rglob("*.py")):
+        # Match skip dirs against REPO-RELATIVE parts only: a checkout that
+        # happens to live under .../build/... must not skip everything and
+        # report a vacuously clean gate.
+        try:
+            rel_parts = p.relative_to(root).parts
+        except ValueError:
+            rel_parts = p.parts
+        if not any(part in SKIP_DIRS for part in rel_parts):
+            yield p
+
+
+def lint_source(
+    relpath: str,
+    src: str,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run (selected) rules over one in-memory file. The fixture-test API."""
+    ctx = FileContext.from_source(relpath, src)
+    findings: List[Finding] = []
+    if ctx.syntax_error is not None:
+        e = ctx.syntax_error
+        findings.append(
+            Finding(ctx.relpath, e.lineno or 0, "DLP000", f"syntax error: {e.msg}")
+        )
+        return findings
+    codes = set(select) if select else set(RULES)
+    for code in sorted(codes):
+        rule = RULES.get(code)
+        if rule is None:
+            raise KeyError(f"unknown rule code {code!r}")
+        findings.extend(rule.check(ctx))
+    findings = [f for f in findings if not is_suppressed(ctx, f)]
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+def resolve_files(
+    paths: Optional[List[Path]] = None, root: Path = REPO
+) -> List[Path]:
+    files: List[Path] = []
+    if paths:
+        for p in paths:
+            if p.is_dir():
+                files.extend(iter_py_files(p))
+            else:
+                files.append(p)
+    else:
+        files = list(iter_py_files(root))
+    return files
+
+
+def _relpath(f: Path, root: Path) -> str:
+    try:
+        return f.resolve().relative_to(root).as_posix()
+    except ValueError:
+        # Out-of-tree path (explicit argument or symlink): rules keyed on
+        # repo-relative prefixes simply won't match; lint it as-is.
+        return f.as_posix()
+
+
+def lint_files(
+    files: List[Path],
+    select: Optional[Iterable[str]] = None,
+    root: Path = REPO,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in files:
+        findings.extend(
+            lint_source(_relpath(f, root), f.read_text(), select=select)
+        )
+    return findings
+
+
+def lint_paths(
+    paths: Optional[List[Path]] = None,
+    select: Optional[Iterable[str]] = None,
+    root: Path = REPO,
+) -> List[Finding]:
+    return lint_files(resolve_files(paths, root), select=select, root=root)
+
+
+@dataclass
+class RunResult:
+    findings_new: List[Finding]
+    findings_baselined: List[Finding]
+    stale_entries: List[BaselineEntry]
+    unjustified_entries: List[BaselineEntry]
+    n_files: int
+
+    def failed(self, strict: bool) -> bool:
+        if self.findings_new:
+            return True
+        if strict and (self.stale_entries or self.unjustified_entries):
+            return True
+        return False
+
+
+def run(
+    paths: Optional[List[Path]] = None,
+    baseline: Optional[Baseline] = None,
+    select: Optional[Iterable[str]] = None,
+    root: Path = REPO,
+) -> RunResult:
+    if baseline is None:
+        baseline = Baseline()
+    files = resolve_files(paths, root)
+    findings = lint_files(files, select=select, root=root)
+    new, old, stale = baseline.partition(findings)
+    if paths or select:
+        # Staleness is only meaningful against a whole-repo, all-rules
+        # scan: a subset run never sees the findings that keep entries for
+        # other files/rules alive, and must not tell the user to trim them.
+        stale = []
+    return RunResult(
+        findings_new=new,
+        findings_baselined=old,
+        stale_entries=stale,
+        unjustified_entries=baseline.unjustified(),
+        n_files=len(files) if not paths else -1,
+    )
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers used by several rules
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.lax.while_loop`` -> "jax.lax.while_loop"; "" if not a pure
+    Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def module_level_statements(tree: ast.AST) -> Iterator[ast.stmt]:
+    """Module-level statements, descending into top-level If/Try blocks
+    (``try: import jax`` patterns) but skipping ``if TYPE_CHECKING:`` —
+    those imports never execute."""
+
+    def walk(stmts: Iterable[ast.stmt]) -> Iterator[ast.stmt]:
+        for s in stmts:
+            if isinstance(s, ast.If):
+                test = dotted_name(s.test)
+                if test in ("TYPE_CHECKING", "typing.TYPE_CHECKING"):
+                    continue
+                yield from walk(s.body)
+                yield from walk(s.orelse)
+            elif isinstance(s, ast.Try):
+                yield from walk(s.body)
+                for h in s.handlers:
+                    yield from walk(h.body)
+                yield from walk(s.orelse)
+                yield from walk(s.finalbody)
+            else:
+                yield s
+
+    return walk(getattr(tree, "body", []))

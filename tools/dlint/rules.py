@@ -1,0 +1,620 @@
+"""dlint rules: the repo's correctness contracts, mechanically enforced.
+
+Style rules (DLP001/DLP002) port the old tools/lint.py F401/F811 checks.
+The JAX-aware rules (DLP010-DLP015) each encode one convention that until
+now lived only in a docstring — every rationale below points at where the
+contract is documented and why violating it corrupts results rather than
+crashing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from .core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    module_level_statements,
+    register,
+)
+
+# ---- rule configuration: the repo's contract surface ---------------------
+
+# The only modules allowed to flip jax_enable_x64 (ops/ipm.py:44-51 states
+# the contract: set it before jax.numpy is imported, in the module that
+# owns the f64 certificate math). Tests are exempt from the placement half
+# (they pin their own interpreter-wide config) but not the ordering half.
+SANCTIONED_X64_MODULES = {
+    "distilp_tpu/ops/ipm.py",
+    "distilp_tpu/solver/backend_jax.py",
+}
+
+# Layers that must be importable without loading jax (pyproject extras
+# split: "profile schemas are always importable"; tools/lint.py docstring:
+# "jax must not load at schema-import time"). Function-scope imports are
+# the idiom there.
+LAZY_JAX_PREFIXES = (
+    "distilp_tpu/common/",
+    "distilp_tpu/profiler/",
+    "distilp_tpu/cli/",
+    "distilp_tpu/sched/",
+)
+LAZY_JAX_MODULES = {
+    "distilp_tpu/__init__.py",
+    "distilp_tpu/axon_guard.py",
+    "distilp_tpu/solver/api.py",
+    "distilp_tpu/solver/result.py",
+    "distilp_tpu/solver/streaming.py",
+    "distilp_tpu/solver/coeffs.py",
+    "distilp_tpu/solver/routing.py",
+}
+
+# Entry points that may initialize a JAX backend. On this image a
+# sitecustomize registers the tunneled-TPU ("axon") PJRT plugin in every
+# interpreter, and a dead tunnel wedges ANY backend init forever
+# (axon_guard.py docstring) — so every process entry that can touch a
+# backend must route through distilp_tpu.axon_guard first.
+ENTRY_POINT_PREFIXES = ("distilp_tpu/cli/", "tools/", "examples/")
+ENTRY_POINT_FILES = {"bench.py", "__graft_entry__.py"}
+
+# Modules whose IMPORT eagerly loads jax (top-level `import jax` in the
+# module or its package __init__); a lazy layer importing one of these at
+# module level defeats its own laziness just as surely as `import jax`.
+EAGER_JAX_MODULES = (
+    "distilp_tpu.ops",
+    "distilp_tpu.parallel",
+    "distilp_tpu.solver.backend_jax",
+)
+
+# Imports of these layers pull (or can pull) jax backend init into the
+# process; schema-only layers (common/, profiler.datatypes, ...) do not.
+BACKEND_TOUCHING_PREFIXES = (
+    "distilp_tpu.solver",
+    "distilp_tpu.ops",
+    "distilp_tpu.parallel",
+    "distilp_tpu.sched",
+    "distilp_tpu.utils",
+    "distilp_tpu.profiler.device",
+    "distilp_tpu.profiler.topology",
+)
+
+AXON_GUARD_NAMES = {
+    "force_cpu_platform",
+    "force_cpu_if_env_requested",
+    "axon_guard",
+}
+
+HOST_SYNC_BUILTINS = {"float", "int", "bool"}
+NUMPY_ALIASES = {"np", "numpy", "onp"}
+TRACE_DECORATORS = {"jit", "vmap", "pmap"}
+TRACE_BODY_CONSUMERS = {
+    "while_loop",
+    "scan",
+    "fori_loop",
+    "cond",
+    "switch",
+    "map",
+    "jit",
+    "vmap",
+    "pmap",
+    "checkpoint",
+    "remat",
+}
+
+
+def _import_bindings(node: ast.AST):
+    """Yield (local_name, lineno) bound by an import statement."""
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            yield (a.asname or a.name.split(".")[0], node.lineno)
+    elif isinstance(node, ast.ImportFrom):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name != "*":
+                yield (a.asname or a.name, node.lineno)
+
+
+@register
+class UnusedImport(Rule):
+    code = "DLP001"
+    name = "unused-import"
+    rationale = (
+        "Module-level imports never referenced in the module (ruff F401). "
+        "Dead imports in this codebase are not just noise: an accidental "
+        "top-level `import jax` in a schema module drags backend init into "
+        "every consumer."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        tree = ctx.tree
+        used: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+        # Names re-exported via __all__ strings count as used.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__":
+                        for elt in ast.walk(node.value):
+                            if isinstance(elt, ast.Constant) and isinstance(
+                                elt.value, str
+                            ):
+                                used.add(elt.value)
+        for node in tree.body:  # module level only
+            for name, lineno in _import_bindings(node):
+                if name not in used and not name.startswith("_"):
+                    yield Finding(
+                        ctx.relpath,
+                        lineno,
+                        self.code,
+                        f"`{name}` imported but unused (F401)",
+                    )
+
+
+@register
+class ImportRedefinition(Rule):
+    code = "DLP002"
+    name = "import-redefinition"
+    rationale = (
+        "A second import rebinding a module-level name on a different line "
+        "(ruff F811): the first binding is dead and usually a merge mistake."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        seen: Dict[str, int] = {}
+        for node in ctx.tree.body:
+            for name, lineno in _import_bindings(node):
+                if name in seen and seen[name] != lineno:
+                    yield Finding(
+                        ctx.relpath,
+                        lineno,
+                        self.code,
+                        f"redefinition of unused `{name}` (F811)",
+                    )
+                seen[name] = lineno
+
+
+def _module_level_jnp_import_line(tree: ast.AST) -> Optional[int]:
+    """Line of the first module-level import that binds jax.numpy."""
+    for node in module_level_statements(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy" or a.name.startswith("jax.numpy."):
+                    return node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax.numpy" or mod.startswith("jax.numpy."):
+                return node.lineno
+            if mod == "jax" and any(a.name == "numpy" for a in node.names):
+                return node.lineno
+    return None
+
+
+@register
+class X64ConfigPlacement(Rule):
+    code = "DLP010"
+    name = "x64-config-placement"
+    rationale = (
+        'jax.config.update("jax_enable_x64", ...) is only sound in the two '
+        "modules that own the f64 certificate math, and only BEFORE "
+        "jax.numpy is imported (ops/ipm.py:44-51): set anywhere else it "
+        "either has no effect on already-traced programs or silently "
+        "changes every other module's dtypes; set after the jnp import it "
+        "races dtype canonicalization and bounds lose their f64 precision "
+        "without any error."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        jnp_line = _module_level_jnp_import_line(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            if not fn.endswith("config.update"):
+                continue
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "jax_enable_x64"
+            ):
+                continue
+            sanctioned = ctx.relpath in SANCTIONED_X64_MODULES
+            if not sanctioned and not ctx.is_test:
+                yield Finding(
+                    ctx.relpath,
+                    node.lineno,
+                    self.code,
+                    "jax_enable_x64 flipped outside the sanctioned modules "
+                    f"({', '.join(sorted(SANCTIONED_X64_MODULES))}); the "
+                    "x64 contract lives where the f64 certificate math "
+                    "lives (see ops/ipm.py:44-51)",
+                )
+            elif jnp_line is not None and node.lineno > jnp_line:
+                yield Finding(
+                    ctx.relpath,
+                    node.lineno,
+                    self.code,
+                    "jax_enable_x64 set AFTER jax.numpy was imported at "
+                    f"line {jnp_line}; move the config.update above the "
+                    "jnp import (ops/ipm.py:44-51)",
+                )
+
+
+def _decorator_is_tracing(dec: ast.AST) -> bool:
+    """True for @jax.jit, @jit, @partial(jax.jit, ...), @jax.vmap, ..."""
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn.split(".")[-1] == "partial" and dec.args:
+            return _decorator_is_tracing(dec.args[0])
+        # @jax.jit(...) / @jit(...) call-form decorators
+        return fn.split(".")[-1] in TRACE_DECORATORS
+    return dotted_name(dec).split(".")[-1] in TRACE_DECORATORS
+
+
+class _TracedScopeCollector(ast.NodeVisitor):
+    """Collect function nodes whose bodies execute under a JAX trace:
+    jit/vmap/pmap-decorated defs, lambdas handed to lax control flow, and
+    named functions handed to lax control flow / jit / vmap.
+
+    Name references are resolved lexically: a consumed name only marks
+    defs whose enclosing-scope chain is a prefix of the call site's (the
+    innermost such def wins), so a host-side helper that merely shares a
+    name with a traced function in another scope is not flagged."""
+
+    # Callables sit in the leading positions of every lax/jit signature
+    # (fori_loop's body is arg 2, the deepest); later args are data.
+    _CALLABLE_POSITIONS = 3
+
+    def __init__(self) -> None:
+        # name -> [(def node, enclosing-scope chain of function-node ids)]
+        self.defs_by_name: Dict[str, List] = {}
+        self.traced: List[ast.AST] = []
+        self._consumed: List = []  # (name, call-site scope chain)
+        self._scope: List[int] = []
+
+    def _remember_def(self, node) -> None:
+        self.defs_by_name.setdefault(node.name, []).append(
+            (node, tuple(self._scope))
+        )
+
+    def _visit_scope(self, node) -> None:
+        self._scope.append(id(node))
+        try:
+            self.generic_visit(node)
+        finally:
+            self._scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._remember_def(node)
+        if any(_decorator_is_tracing(d) for d in node.decorator_list):
+            self.traced.append(node)
+        self._visit_scope(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._remember_def(node)
+        self._visit_scope(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_scope(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = dotted_name(node.func)
+        tail = fn.split(".")[-1]
+        is_consumer = tail in TRACE_BODY_CONSUMERS and (
+            "lax" in fn or fn.startswith("jax.") or tail in TRACE_DECORATORS
+        )
+        # jax.tree.map (and friends) run their function eagerly on host —
+        # only jax.lax.map traces its body.
+        if tail == "map" and "lax" not in fn:
+            is_consumer = False
+        if is_consumer:
+            for pos, arg in enumerate(node.args):
+                if pos >= self._CALLABLE_POSITIONS:
+                    break
+                if isinstance(arg, ast.Lambda):
+                    self.traced.append(arg)
+                elif isinstance(arg, ast.Name):
+                    self._consumed.append((arg.id, tuple(self._scope)))
+        self.generic_visit(node)
+
+    def finish(self) -> List[ast.AST]:
+        for name, site_chain in self._consumed:
+            candidates = [
+                (node, chain)
+                for node, chain in self.defs_by_name.get(name, [])
+                if chain == site_chain[: len(chain)]  # lexically visible
+            ]
+            if candidates:
+                innermost = max(len(c) for _, c in candidates)
+                self.traced.extend(
+                    n for n, c in candidates if len(c) == innermost
+                )
+        # Dedup by identity, preserving order.
+        seen: Set[int] = set()
+        out: List[ast.AST] = []
+        for n in self.traced:
+            if id(n) not in seen:
+                seen.add(id(n))
+                out.append(n)
+        return out
+
+
+@register
+class HostSyncInTrace(Rule):
+    code = "DLP011"
+    name = "host-sync-in-trace"
+    rationale = (
+        "float()/int()/bool()/.item()/np.asarray() on a traced value forces "
+        "a device->host sync; on a tunneled TPU each sync pays the full "
+        "per-operation wire cost (~1000x a local dispatch, "
+        "solver/backend_jax.py docstring), and under jit it throws a "
+        "TracerConversionError only on the paths a test happens to trace."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        collector = _TracedScopeCollector()
+        collector.visit(ctx.tree)
+        # Traced scopes nest (a lambda handed to lax inside a @jit def):
+        # dedup so one violation yields one finding, or a count=1 baseline
+        # entry could never absorb it.
+        emitted = set()
+        for scope in collector.finish():
+            body = scope.body if isinstance(scope.body, list) else [scope.body]
+            for stmt in body:
+                for f in self._scan(ctx, stmt):
+                    key = (f.line, f.message)
+                    if key not in emitted:
+                        emitted.add(key)
+                        yield f
+
+    def _scan(self, ctx: FileContext, root: ast.AST) -> Iterator[Finding]:
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in HOST_SYNC_BUILTINS:
+                if len(node.args) == 1 and not isinstance(
+                    node.args[0], ast.Constant
+                ):
+                    yield Finding(
+                        ctx.relpath,
+                        node.lineno,
+                        self.code,
+                        f"`{fn.id}()` inside traced code is a host sync "
+                        "(~1000x on a tunneled TPU); keep the value on "
+                        "device (jnp ops) or hoist it out of the traced "
+                        "scope",
+                    )
+            elif isinstance(fn, ast.Attribute):
+                if fn.attr == "item" and not node.args:
+                    yield Finding(
+                        ctx.relpath,
+                        node.lineno,
+                        self.code,
+                        "`.item()` inside traced code is a host sync; "
+                        "return the array and read it outside the trace",
+                    )
+                elif (
+                    fn.attr in ("asarray", "array")
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in NUMPY_ALIASES
+                ):
+                    yield Finding(
+                        ctx.relpath,
+                        node.lineno,
+                        self.code,
+                        f"`{fn.value.id}.{fn.attr}()` inside traced code "
+                        "materializes on host; use jnp.asarray or move the "
+                        "conversion outside the traced scope",
+                    )
+
+
+@register
+class BareAssertInLibrary(Rule):
+    code = "DLP012"
+    name = "bare-assert"
+    rationale = (
+        "`assert` vanishes under `python -O`, so a runtime invariant "
+        "guarded by one silently stops being checked in optimized "
+        "deployments — in this solver that means a mis-aligned blob decode "
+        "corrupts the certificate instead of raising (the class of bug PR 1 "
+        "hand-fixed twice). Library invariants raise ValueError/RuntimeError; "
+        "tests keep assert."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_library or ctx.is_test:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assert):
+                yield Finding(
+                    ctx.relpath,
+                    node.lineno,
+                    self.code,
+                    "bare `assert` guards a runtime invariant in library "
+                    "code; raise ValueError/RuntimeError so the check "
+                    "survives `python -O`",
+                )
+
+
+@register
+class EagerJaxImportInSchemaLayer(Rule):
+    code = "DLP013"
+    name = "eager-jax-import"
+    rationale = (
+        "Schema/profile/CLI layers must import without loading jax "
+        "(pyproject extras split; tools/lint.py docstring): a top-level "
+        "`import jax` there makes `import distilp_tpu.common` pull backend "
+        "init into processes that only wanted to parse a profile JSON — on "
+        "this image that can wedge on the axon plugin."
+    )
+
+    @staticmethod
+    def _eager_jax(mod: str) -> bool:
+        if mod == "jax" or mod.startswith("jax."):
+            return True
+        # An eager-jax distilp module dragged in at top level defeats the
+        # laziness contract the same way a literal `import jax` does.
+        return any(
+            mod == p or mod.startswith(p + ".") for p in EAGER_JAX_MODULES
+        )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        lazy = ctx.relpath in LAZY_JAX_MODULES or any(
+            ctx.relpath.startswith(p) for p in LAZY_JAX_PREFIXES
+        )
+        if not lazy:
+            return
+        pkg_parts = tuple(ctx.relpath.split("/")[:-1])
+        for node in module_level_statements(ctx.tree):
+            bad_line = None
+            if isinstance(node, ast.Import):
+                if any(self._eager_jax(a.name) for a in node.names):
+                    bad_line = node.lineno
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    mod = ".".join(base + tuple(mod.split("."))) if mod else ".".join(base)
+                if self._eager_jax(mod) or any(
+                    self._eager_jax(f"{mod}.{a.name}") for a in node.names
+                ):
+                    bad_line = node.lineno
+            if bad_line is not None:
+                yield Finding(
+                    ctx.relpath,
+                    bad_line,
+                    self.code,
+                    "top-level import loads jax into a lazy "
+                    "(schema/profile/cli) module; import it inside the "
+                    "function that needs it so the schema layer stays "
+                    "importable without a backend",
+                )
+
+
+@register
+class LegacyNumpyRandom(Rule):
+    code = "DLP014"
+    name = "legacy-np-random"
+    rationale = (
+        "The legacy `np.random.<fn>` API draws from (or mutates) the "
+        "process-global RNG: probes and simulators become unreproducible, "
+        "and even `np.random.seed(...)` only pins global state that any "
+        "import can silently consume. The repo-wide idiom is an explicit "
+        "`np.random.default_rng(seed)` generator (utils/synthetic.py, "
+        "sched/sim.py, bench.py) — the whole legacy API is banned, not "
+        "just the unseeded calls."
+    )
+
+    _OK = {"default_rng", "Generator", "SeedSequence", "PCG64", "Philox"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            parts = fn.split(".")
+            if (
+                len(parts) == 3
+                and parts[0] in NUMPY_ALIASES
+                and parts[1] == "random"
+                and parts[2] not in self._OK
+            ):
+                yield Finding(
+                    ctx.relpath,
+                    node.lineno,
+                    self.code,
+                    f"`{fn}()` uses the process-global legacy RNG; use an "
+                    "explicit `np.random.default_rng(seed)` generator for "
+                    "reproducible runs",
+                )
+
+
+@register
+class UnguardedBackendEntryPoint(Rule):
+    code = "DLP015"
+    name = "unguarded-entry-point"
+    rationale = (
+        "Every process entry point that can initialize a JAX backend must "
+        "route through distilp_tpu.axon_guard first: the sitecustomize on "
+        "this image registers the tunneled-TPU PJRT plugin in every "
+        "interpreter and a dead tunnel wedges backend init forever — "
+        "JAX_PLATFORMS=cpu alone does NOT help (axon_guard.py docstring)."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.is_test:
+            return
+        is_entry = ctx.relpath in ENTRY_POINT_FILES or any(
+            ctx.relpath.startswith(p) for p in ENTRY_POINT_PREFIXES
+        )
+        if not is_entry:
+            return
+        touch_line = self._first_backend_touch(ctx)
+        if touch_line is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                tail = (
+                    node.id
+                    if isinstance(node, ast.Name)
+                    else node.attr
+                )
+                if tail in AXON_GUARD_NAMES:
+                    return
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for name, _ in _import_bindings(node):
+                    if name in AXON_GUARD_NAMES:
+                        return
+        yield Finding(
+            ctx.relpath,
+            touch_line,
+            self.code,
+            "entry point touches a JAX backend layer without routing "
+            "through distilp_tpu.axon_guard "
+            "(force_cpu_platform/force_cpu_if_env_requested); a dead TPU "
+            "tunnel will wedge this process at backend init",
+        )
+
+    @staticmethod
+    def _touches_backend(mod: str) -> bool:
+        # Prefix match on module boundaries only: distilp_tpu.scheduling
+        # must not match the distilp_tpu.sched prefix.
+        return any(
+            mod == p or mod.startswith(p + ".")
+            for p in BACKEND_TOUCHING_PREFIXES
+        )
+
+    def _first_backend_touch(self, ctx: FileContext) -> Optional[int]:
+        # Package path of this file, for resolving relative imports:
+        # distilp_tpu/cli/solver_cli.py -> ("distilp_tpu", "cli").
+        pkg_parts = tuple(ctx.relpath.split("/")[:-1])
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" or a.name.startswith("jax."):
+                        return node.lineno
+                    if self._touches_backend(a.name):
+                        return node.lineno
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level:
+                    # `from ..solver import x` with level=2 strips one
+                    # trailing package component; level=1 strips none.
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    mod = ".".join(base + tuple(mod.split("."))) if mod else ".".join(base)
+                if mod == "jax" or mod.startswith("jax."):
+                    return node.lineno
+                if self._touches_backend(mod):
+                    return node.lineno
+                # `from distilp_tpu import solver` style: the touched
+                # module is named by the alias, not the module field.
+                for a in node.names:
+                    if self._touches_backend(f"{mod}.{a.name}"):
+                        return node.lineno
+        return None

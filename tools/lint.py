@@ -1,99 +1,26 @@
 #!/usr/bin/env python3
-"""Minimal stdlib lint gate: a subset of ruff's F-class checks.
+"""Compatibility shim: the lint gate grew into the ``tools/dlint`` package.
 
-The image this framework builds in has no ruff/flake8 and no network, so
-`make lint` runs this instead; the `[tool.ruff]` config in pyproject.toml is
-authoritative wherever ruff is available. Checks:
-
-- every file parses (syntax gate);
-- F401: module-level imports never referenced in the module;
-- F811: module-level names redefined by a second import on a different line.
-
-Function-scope imports are left alone (lazy imports are idiomatic here: jax
-must not load at schema-import time).
-
-Exit status 1 on any finding, printing ``path:line: code message`` lines.
+``python tools/lint.py`` keeps working (older scripts and muscle memory
+call it) but simply delegates to ``python -m tools.dlint`` with the same
+arguments. The old F401/F811 checks live on as rules DLP001/DLP002; the
+JAX-aware contract rules are documented in README "Static analysis gate"
+and ``python -m tools.dlint --list-rules``.
 """
 
 from __future__ import annotations
 
-import ast
+import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".venv"}
-
-
-def iter_py_files():
-    for p in sorted(REPO.rglob("*.py")):
-        if not any(part in SKIP_DIRS for part in p.parts):
-            yield p
-
-
-def _import_bindings(node: ast.AST):
-    """Yield (local_name, lineno) bound by an import statement."""
-    if isinstance(node, ast.Import):
-        for a in node.names:
-            yield (a.asname or a.name.split(".")[0], node.lineno)
-    elif isinstance(node, ast.ImportFrom):
-        if node.module == "__future__":
-            return
-        for a in node.names:
-            if a.name != "*":
-                yield (a.asname or a.name, node.lineno)
-
-
-def check_file(path: Path) -> list:
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [(e.lineno or 0, "E999", f"syntax error: {e.msg}")]
-
-    problems = []
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            # "import a.b" is used via the root name; ast.Name covers it.
-            pass
-
-    # Names re-exported via __all__ strings count as used.
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "__all__":
-                    for elt in ast.walk(node.value):
-                        if isinstance(elt, ast.Constant) and isinstance(
-                            elt.value, str
-                        ):
-                            used.add(elt.value)
-
-    seen = {}
-    for node in tree.body:  # module level only
-        for name, lineno in _import_bindings(node):
-            if name in seen and seen[name] != lineno:
-                problems.append(
-                    (lineno, "F811", f"redefinition of unused `{name}`")
-                )
-            seen[name] = lineno
-            if name not in used and not name.startswith("_"):
-                problems.append((lineno, "F401", f"`{name}` imported but unused"))
-    return problems
 
 
 def main() -> int:
-    n = 0
-    for path in iter_py_files():
-        for lineno, code, msg in check_file(path):
-            print(f"{path.relative_to(REPO)}:{lineno}: {code} {msg}")
-            n += 1
-    if n:
-        print(f"{n} problem(s)")
-        return 1
-    print(f"lint clean ({len(list(iter_py_files()))} files)")
-    return 0
+    sys.path.insert(0, str(REPO))
+    from tools.dlint.__main__ import main as dlint_main
+
+    return dlint_main(sys.argv[1:])
 
 
 if __name__ == "__main__":
