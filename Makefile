@@ -25,6 +25,21 @@ test: lint-strict
 bench:
 	python bench.py
 
+# Regression gate for the perf dev loop: run the bench and diff every
+# headline metric against a committed capture (default: the latest
+# BENCH_rNN.json). Exits nonzero on a >20% regression of `value` (cold
+# sweep ms) or `warm_tick_ms` (streaming fast path). The gate compares
+# ABSOLUTE milliseconds, so the reference must come from the same box —
+# when tiny_put_ms (the recorded per-op dispatch floor) differs >1.5x the
+# compare prints a not-comparable warning; re-capture a local reference
+# (`python bench.py > /tmp/ref.json`) before trusting the verdict. Usage:
+#   make bench-compare                      # vs $(AGAINST)
+#   make bench-compare AGAINST=BENCH_r04.json
+AGAINST ?= BENCH_r05.json
+.PHONY: bench-compare
+bench-compare:
+	python bench.py --against $(AGAINST)
+
 # Scheduler-service smoke: replay the bundled 20-event churn trace through
 # the daemon on the CPU platform (no slow tests, no accelerator needed);
 # any structural tick missing its optimality certificate fails the target.

@@ -83,6 +83,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="interior-point iterations per LP relaxation (jax backend)",
     )
     p.add_argument(
+        "--ipm-warm-iters", type=int, default=None,
+        help="IPM budget of rounds after the root (warm-started nodes; "
+        "default about half of --ipm-iters; set equal to --ipm-iters to "
+        "disable the warm truncation — jax backend)",
+    )
+    p.add_argument(
         "--node-cap", type=int, default=None,
         help="frontier capacity; overflow floors the certificate (jax backend)",
     )
@@ -143,6 +149,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
         type=int,
         default=4,
         help="max warm replanners kept (LRU over (fleet, model) identities)",
+    )
+    p.add_argument(
+        "--cold-start",
+        action="store_true",
+        help="A/B debugging: disable every cross-tick warm path (incumbent "
+        "seed, Lagrangian duals, root IPM iterates, margin chain) so each "
+        "tick solves from scratch; compare against a warm run to measure "
+        "the reuse win",
     )
     p.add_argument(
         "--fail-uncertified",
@@ -206,6 +220,7 @@ def serve_main(argv=None) -> int:
         backend=args.backend,
         k_candidates=k_candidates,
         warm_pool_size=args.warm_pool,
+        cold_start=args.cold_start,
     )
 
     def log_event(ev, view, ms):
@@ -354,6 +369,7 @@ def main(argv=None) -> int:
                 max_rounds=args.max_rounds,
                 beam=args.beam,
                 ipm_iters=args.ipm_iters,
+                ipm_warm_iters=args.ipm_warm_iters,
                 node_cap=args.node_cap,
                 batch_size=args.batch_size,
                 debug=args.debug,
@@ -399,6 +415,7 @@ def main(argv=None) -> int:
                 max_rounds=args.max_rounds,
                 beam=args.beam,
                 ipm_iters=args.ipm_iters,
+                ipm_warm_iters=args.ipm_warm_iters,
                 node_cap=args.node_cap,
                 batch_size=args.batch_size,
             )
@@ -418,6 +435,7 @@ def main(argv=None) -> int:
                 max_rounds=args.max_rounds,
                 beam=args.beam,
                 ipm_iters=args.ipm_iters,
+                ipm_warm_iters=args.ipm_warm_iters,
                 node_cap=args.node_cap,
                 batch_size=args.batch_size,
             )

@@ -1,5 +1,5 @@
 """Numerical kernels (JAX/XLA; Pallas where XLA fusion is not enough)."""
 
-from .ipm import IPMResult, LPBatch, ipm_solve_batch
+from .ipm import IPMResult, IPMWarmState, LPBatch, ipm_solve_batch
 
-__all__ = ["LPBatch", "IPMResult", "ipm_solve_batch"]
+__all__ = ["LPBatch", "IPMResult", "IPMWarmState", "ipm_solve_batch"]

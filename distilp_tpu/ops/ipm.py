@@ -72,6 +72,24 @@ class LPBatch(NamedTuple):
     u: jax.Array  # (B, n)
 
 
+class IPMWarmState(NamedTuple):
+    """Warm-start iterate for one LP family, in ORIGINAL coordinates.
+
+    The internal iteration is column-equilibrated by the box width, and the
+    box (hence the scaling) changes between a parent node and its children
+    and between streaming ticks — so iterates are carried in original units
+    and re-scaled on entry. ``ok`` gates each element: a False (or any
+    non-finite component) falls back to the cold mid-box start, so a stale
+    or garbage warm state can only cost iterations, never corrupt a solve.
+    """
+
+    v: jax.Array  # (B, n) primal point (original coordinates)
+    y: jax.Array  # (B, m) row duals (scale-invariant)
+    z: jax.Array  # (B, n) lower-box duals, original units
+    f: jax.Array  # (B, n) upper-box duals, original units
+    ok: jax.Array  # (B,) bool — element carries a usable iterate
+
+
 class IPMResult(NamedTuple):
     v: jax.Array  # (B, n) primal point in original coordinates (l + x)
     bound: jax.Array  # (B,) rigorous lower bound on the LP optimum (float64)
@@ -81,6 +99,12 @@ class IPMResult(NamedTuple):
     mu: jax.Array  # (B,) final complementarity measure
     converged: jax.Array  # (B,) bool
     reduced: jax.Array  # (B, n) float64 reduced costs c - A'y of the bound's dual
+    # Final iterates in original units (see IPMWarmState) — what a caller
+    # feeds back as the next solve's warm start.
+    y_dual: jax.Array  # (B, m)
+    z_dual: jax.Array  # (B, n)
+    f_dual: jax.Array  # (B, n)
+    iters_run: jax.Array  # (B,) int32 iterations actually executed
 
 
 def _default_tol(dtype) -> float:
@@ -91,8 +115,20 @@ def _default_reg(dtype) -> float:
     return 1e-10 if dtype == jnp.float64 else 1e-7
 
 
-def _ipm_single(A, b, c, l, u, iters: int, tol, reg):
-    """Mehrotra predictor-corrector on one boxed LP. Runs under vmap."""
+def _ipm_single(A, b, c, l, u, iters: int, tol, reg, warm=None, skip=None,
+                chunk: int = 4):
+    """Mehrotra predictor-corrector on one boxed LP. Runs under vmap.
+
+    ``warm`` (an :class:`IPMWarmState` element) seeds the iteration from a
+    previous solve's point — the branch-and-bound parent's iterate projected
+    into this node's (tightened) box, or last streaming tick's root iterate.
+    ``skip`` marks the element as already-done (its lanes freeze at once and
+    stop gating the batch-wide early exit). The iteration budget is spent in
+    ``chunk``-sized pieces of a ``lax.while_loop``: once every live batch
+    element has converged (or frozen) the loop exits, so converged batches
+    stop paying Cholesky factorizations — the bound stays rigorous because
+    it is evaluated from WHATEVER dual the loop reached.
+    """
     dtype = A.dtype
     n = A.shape[1]
     m = A.shape[0]
@@ -123,12 +159,38 @@ def _ipm_single(A, b, c, l, u, iters: int, tol, reg):
     f0 = jnp.ones(n, dtype)
     y0 = jnp.zeros(m, dtype)
 
+    if warm is not None:
+        # Warm start: project the carried point into THIS box (children
+        # tighten the parent's box; ticks drift it), re-scale to the
+        # equilibrated [0, 1] coordinates, and pull strictly interior —
+        # boundary iterates have no barrier interior and a vertex z/f can
+        # be 0 or huge. Any non-finite component (or ok=False) falls back
+        # to the cold start wholesale: garbage degrades, never corrupts.
+        v_w, y_w, z_w, f_w, ok_w = warm
+        fin = (
+            ok_w
+            & jnp.all(jnp.isfinite(v_w))
+            & jnp.all(jnp.isfinite(y_w))
+            & jnp.all(jnp.isfinite(z_w))
+            & jnp.all(jnp.isfinite(f_w))
+        )
+        x_w = (jnp.clip(v_w.astype(dtype), l, u) - l) / col_s
+        x_w = jnp.clip(x_w, 0.01, 0.99)
+        z_sc = jnp.clip(z_w.astype(dtype) * col_s, 1e-2, 1e4)
+        f_sc = jnp.clip(f_w.astype(dtype) * col_s, 1e-2, 1e4)
+        x0 = jnp.where(fin, x_w, x0)
+        w0 = jnp.where(fin, r - x0, w0)
+        z0 = jnp.where(fin, z_sc, z0)
+        f0 = jnp.where(fin, f_sc, f0)
+        y0 = jnp.where(fin, y_w.astype(dtype), y0)
+
     b_scale = 1.0 + jnp.max(jnp.abs(b_hat))
     c_scale = 1.0 + jnp.max(jnp.abs(cm))
     eye = jnp.eye(m, dtype=dtype)
 
     def step(state, _):
-        x, w, y, z, f, done = state
+        x, w, y, z, f, done, it = state
+        it = it + (done <= 0.5).astype(jnp.int32)
 
         rp = b_hat - A @ (x * act)
         rd = cm - A.T @ y - z + f
@@ -217,10 +279,37 @@ def _ipm_single(A, b, c, l, u, iters: int, tol, reg):
             & (jnp.max(jnp.abs(rd)) < tol * c_scale)
         )
         done = jnp.maximum(done, conv.astype(dtype))
-        return (x, w, y, z, f, done), None
+        return (x, w, y, z, f, done, it), None
 
-    init = (x0, w0, y0, z0, f0, jnp.zeros((), dtype))
-    (x, w, y, z, f, done), _ = jax.lax.scan(step, init, None, length=iters)
+    done0 = jnp.zeros((), dtype)
+    if skip is not None:
+        # A skipped element (e.g. an inactive frontier row) freezes at once:
+        # its lanes stop moving and stop gating the batch-wide early exit.
+        done0 = jnp.where(skip, jnp.ones((), dtype), done0)
+    init = (x0, w0, y0, z0, f0, done0, jnp.zeros((), jnp.int32))
+
+    # The fixed iteration budget is spent chunk-by-chunk under a while loop
+    # whose exit test is the batch-wide convergence flag (under vmap the
+    # loop runs until EVERY element's cond is false): converged batches stop
+    # paying factorizations instead of scanning out the full budget.
+    chunk = max(1, min(int(chunk), iters))
+    n_chunks = -(-iters // chunk)
+
+    def chunk_cond(carry):
+        state, ci = carry
+        return (ci < n_chunks) & (state[5] <= 0.5)
+
+    def chunk_body(carry):
+        state, ci = carry
+        # convergence gate: the fixed-length inner scan is bounded by the
+        # enclosing while_loop's batch-wide done test above, so converged
+        # instances never pay more than one chunk of frozen iterations.
+        state, _ = jax.lax.scan(step, state, None, length=chunk)
+        return (state, ci + 1)
+
+    (x, w, y, z, f, done, it), _ = jax.lax.while_loop(
+        chunk_cond, chunk_body, (init, jnp.zeros((), jnp.int32))
+    )
 
     # Final residuals (iteration dtype, for diagnostics).
     rp = b_hat - A @ (x * act)
@@ -254,36 +343,59 @@ def _ipm_single(A, b, c, l, u, iters: int, tol, reg):
         mu=mu,
         converged=done > 0,
         reduced=reduced,
+        # Iterates back in original units (see IPMWarmState): y is shared
+        # between the scalings, z/f divide the column equilibration out.
+        y_dual=y,
+        z_dual=jnp.where(active, z / col_s, 0.0),
+        f_dual=jnp.where(active, f / col_s, 0.0),
+        iters_run=it,
     )
 
 
-@partial(jax.jit, static_argnames=("iters",))
+@partial(jax.jit, static_argnames=("iters", "chunk"))
 def ipm_solve_batch(
     batch: LPBatch,
     iters: int = 30,
     tol: Optional[float] = None,
     reg: Optional[float] = None,
+    warm: Optional[IPMWarmState] = None,
+    skip: Optional[jax.Array] = None,
+    chunk: int = 4,
 ) -> IPMResult:
     """Solve a batch of boxed LPs (shared (m, n) or per-instance (B, m, n) A).
 
     Runs in the dtype of ``batch.A`` (float32 is the TPU production path);
     returns per-element primal points, objectives, and rigorous float64
     lower bounds. ``tol``/``reg`` default by dtype.
+
+    ``warm`` carries per-element warm-start iterates (original coordinates;
+    see :class:`IPMWarmState` — elements with ``ok=False`` or non-finite
+    components start cold). ``skip`` (B,) freezes elements immediately so
+    they stop gating the early exit. ``iters`` is the per-element budget,
+    spent ``chunk`` iterations at a time with a batch-wide convergence test
+    between chunks; ``iters_run`` in the result reports what was actually
+    executed.
     """
     dtype = batch.A.dtype
     tol_v = _default_tol(dtype) if tol is None else tol
     reg_v = _default_reg(dtype) if reg is None else reg
+
+    def single(A, b, c, l, u, wm, sk):
+        return _ipm_single(
+            A, b, c, l, u, iters, tol_v, reg_v, warm=wm, skip=sk, chunk=chunk
+        )
+
     # TPU matmuls default to bf16 multiplication for f32 inputs; an IPM loses
     # its dual (and with it the Lagrangian bound quality) at bf16. Force full
     # f32 accumulation — these matrices are tiny and latency-bound, so the
     # MXU throughput cost is irrelevant.
     with jax.default_matmul_precision("highest"):
-        if batch.A.ndim == 3:
-            solver = jax.vmap(
-                lambda A, b, c, l, u: _ipm_single(A, b, c, l, u, iters, tol_v, reg_v)
-            )
-            return solver(batch.A, batch.b, batch.c, batch.l, batch.u)
-        solver = jax.vmap(
-            lambda b, c, l, u: _ipm_single(batch.A, b, c, l, u, iters, tol_v, reg_v)
+        a_axis = 0 if batch.A.ndim == 3 else None
+        axes = (
+            a_axis, 0, 0, 0, 0,
+            None if warm is None else 0,
+            None if skip is None else 0,
         )
-        return solver(batch.b, batch.c, batch.l, batch.u)
+        return jax.vmap(single, in_axes=axes)(
+            batch.A, batch.b, batch.c, batch.l, batch.u, warm, skip
+        )

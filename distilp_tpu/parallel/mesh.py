@@ -43,7 +43,10 @@ def state_shardings(mesh: Mesh, state) -> "jax.tree_util.PyTreeDef":
 
     def spec(path_leaf):
         name, leaf = path_leaf
-        if name in {"node_lo", "node_hi", "node_kidx", "node_bound", "active"}:
+        if name in {
+            "node_lo", "node_hi", "node_kidx", "node_bound", "active",
+            "node_v", "node_y", "node_z", "node_f", "node_warm",
+        }:
             return node_sharded
         return replicated
 
@@ -77,6 +80,7 @@ def solve_sweep_sharded(
     beam: Optional[int] = None,
     node_cap: Optional[int] = None,
     per_k: bool = False,
+    ipm_warm_iters: Optional[int] = None,
 ):
     """Run the fused B&B sweep with the frontier sharded across ``mesh``.
 
@@ -85,11 +89,12 @@ def solve_sweep_sharded(
     node-sharded and GSPMD partitions the batched IPM along the node axis,
     turning the incumbent/compaction reductions into ICI collectives.
 
-    ``beam``/``ipm_iters``/``node_cap`` default like the unsharded backend
-    (``default_search_params``), except the beam is rounded up to a multiple
-    of the mesh size so every device solves the same number of frontier rows
-    (GSPMD shards the IPM batch along the node axis), and the cap to a
-    multiple likewise.
+    ``beam``/``ipm_iters``/``ipm_warm_iters``/``node_cap`` default like the
+    unsharded backend (``default_search_params``/``_resolve_search_params``),
+    except the beam — and the root round's n_k-row batch — are rounded up
+    to a multiple of the mesh size so every device solves the same number
+    of frontier rows (GSPMD shards the IPM batch along the node axis), and
+    the cap to a multiple likewise.
 
     ``per_k`` switches to the per-k pruning regime (every feasible k closes
     its own certificate; read the per-k assignments off the returned
@@ -121,12 +126,15 @@ def solve_sweep_sharded(
     # spilled node floors its k's certificate), then mesh-align: cap and
     # beam round up to a multiple of the mesh size so every device solves
     # the same number of frontier rows.
-    cap, d_beam, d_iters, _ = _resolve_search_params(
+    cap, d_beam, d_iters, d_warm_iters, _ = _resolve_search_params(
         sf.moe, len(sf.ks), node_cap, beam, ipm_iters, max_rounds,
-        per_k=per_k,
+        per_k=per_k, ipm_warm_iters=ipm_warm_iters,
     )
     cap = pad_cap_to_mesh(max(cap, 2 * len(sf.ks)), mesh)
     beam = min(pad_cap_to_mesh(d_beam, mesh), cap)
+    # The root round solves exactly the n_k roots; pad its batch to the
+    # mesh size so it keeps the even-rows-per-device sharding too.
+    root_beam = min(pad_cap_to_mesh(len(sf.ks), mesh), cap)
     ipm_iters = d_iters
 
     rd = rounding_data(coeffs, arrays.moe)
@@ -166,5 +174,7 @@ def solve_sweep_sharded(
             beam=beam,
             moe=sf.moe,
             per_k=per_k,
+            ipm_warm_iters=d_warm_iters,
+            root_beam=root_beam,
         )
     return state, sf

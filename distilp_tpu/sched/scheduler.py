@@ -113,12 +113,17 @@ class Scheduler:
         warm_pool_size: int = 4,
         solve_on_init: bool = False,
         metrics: Optional[SchedulerMetrics] = None,
+        cold_start: bool = False,
     ):
         self.fleet = FleetState(list(devices), model)
         self.mip_gap = mip_gap
         self.kv_bits = kv_bits
         self.backend = backend
         self.moe = moe
+        # A/B switch (`solver serve --cold-start`): the pool still routes
+        # events, but every tick solves from scratch — the baseline against
+        # which warm/margin/iterate reuse is measured.
+        self.cold_start = cold_start
         self.k_candidates = list(k_candidates) if k_candidates else None
         self.metrics = metrics if metrics is not None else SchedulerMetrics()
         self.pool = WarmPool(
@@ -136,6 +141,7 @@ class Scheduler:
             kv_bits=self.kv_bits,
             backend=self.backend,
             moe=self.moe,
+            cold_start=self.cold_start,
         )
         planner.metrics = self.metrics  # tick modes funnel into one snapshot
         return planner
@@ -165,9 +171,11 @@ class Scheduler:
         planner, _hit = self.pool.get(key)
         devs = self.fleet.device_list()
         t0 = time.perf_counter()
+        tick_tm: dict = {}
         try:
             result = planner.step(
-                devs, self.fleet.model, k_candidates=self.k_candidates
+                devs, self.fleet.model, k_candidates=self.k_candidates,
+                timings=tick_tm,
             )
         except (RuntimeError, ValueError, NotImplementedError) as e:
             self.metrics.inc("tick_failed")
@@ -182,6 +190,14 @@ class Scheduler:
             return self.latest()
         ms = (time.perf_counter() - t0) * 1e3
         self.metrics.observe("event_to_placement", ms)
+        # Device-program work accounting (JAX backend): how many Mehrotra
+        # iterations the tick actually executed — the warm-start health
+        # gauge next to the tick-mode counters (a drift tick burning the
+        # cold budget means the iterate chain broke).
+        if "ipm_iters_executed" in tick_tm:
+            self.metrics.observe(
+                "ipm_iters_executed", tick_tm["ipm_iters_executed"]
+            )
         mode = getattr(planner, "last_tick_mode", None) or "cold"
         if structural is not None:
             self.metrics.observe(

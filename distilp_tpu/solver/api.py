@@ -32,6 +32,7 @@ def _warm_to_ilp(warm: Optional[HALDAResult]) -> Optional[ILPResult]:
     return ILPResult(
         k=warm.k, w=warm.w, n=warm.n, y=warm.y,
         obj_value=warm.obj_value, duals=warm.duals,
+        ipm_state=warm.ipm_state,
     )
 
 
@@ -48,6 +49,7 @@ def _best_to_result(best: ILPResult, sets) -> HALDAResult:
         certified=best.certified,
         gap=best.gap,
         duals=best.duals,
+        ipm_state=best.ipm_state,
     )
 
 
@@ -120,6 +122,7 @@ def halda_solve(
     max_rounds: Optional[int] = None,
     beam: Optional[int] = None,
     ipm_iters: Optional[int] = None,
+    ipm_warm_iters: Optional[int] = None,
     node_cap: Optional[int] = None,
     timings: Optional[dict] = None,
     load_factors: Optional[Sequence[float]] = None,
@@ -153,7 +156,13 @@ def halda_solve(
     - ``max_rounds``: branch-and-bound round budget. Raise it when a solve
       warns that the mip-gap certificate was not met.
     - ``beam``: frontier rows that get an IPM solve per round.
-    - ``ipm_iters``: interior-point iterations per LP relaxation.
+    - ``ipm_iters``: interior-point iterations per LP relaxation (the cold
+      root-round budget).
+    - ``ipm_warm_iters``: iteration budget of every round after the root —
+      those nodes warm-start from their parent's iterate, so the default is
+      about half the cold budget; truncation only loosens bounds (worst
+      case: more rounds), never the certificate's validity. Set equal to
+      ``ipm_iters`` to disable the truncation.
     - ``node_cap``: frontier capacity (overflow floors the certificate).
 
     ``timings``: pass a dict to receive the JAX backend's wall-clock
@@ -202,6 +211,7 @@ def halda_solve(
             max_rounds=max_rounds,
             beam=beam,
             ipm_iters=ipm_iters,
+            ipm_warm_iters=ipm_warm_iters,
             node_cap=node_cap,
             timings=timings,
             margin_state=margin_state,
@@ -276,6 +286,7 @@ def halda_solve_async(
     max_rounds: Optional[int] = None,
     beam: Optional[int] = None,
     ipm_iters: Optional[int] = None,
+    ipm_warm_iters: Optional[int] = None,
     node_cap: Optional[int] = None,
     load_factors: Optional[Sequence[float]] = None,
     batch_size: int = 1,
@@ -312,6 +323,7 @@ def halda_solve_async(
         max_rounds=max_rounds,
         beam=beam,
         ipm_iters=ipm_iters,
+        ipm_warm_iters=ipm_warm_iters,
         node_cap=node_cap,
         collect=False,
         margin_state=margin_state,
@@ -335,6 +347,7 @@ def halda_solve_scenarios(
     max_rounds: Optional[int] = None,
     beam: Optional[int] = None,
     ipm_iters: Optional[int] = None,
+    ipm_warm_iters: Optional[int] = None,
     node_cap: Optional[int] = None,
     load_factors_list: Optional[Sequence[Optional[Sequence[float]]]] = None,
     timings: Optional[dict] = None,
@@ -397,6 +410,7 @@ def halda_solve_scenarios(
         max_rounds=max_rounds,
         beam=beam,
         ipm_iters=ipm_iters,
+        ipm_warm_iters=ipm_warm_iters,
         node_cap=node_cap,
         timings=timings,
     )
@@ -419,6 +433,7 @@ def halda_solve_per_k(
     max_rounds: Optional[int] = None,
     beam: Optional[int] = None,
     ipm_iters: Optional[int] = None,
+    ipm_warm_iters: Optional[int] = None,
     node_cap: Optional[int] = None,
     load_factors: Optional[Sequence[float]] = None,
     batch_size: int = 1,
@@ -461,6 +476,7 @@ def halda_solve_per_k(
         max_rounds=max_rounds,
         beam=beam,
         ipm_iters=ipm_iters,
+        ipm_warm_iters=ipm_warm_iters,
         node_cap=node_cap,
         debug=debug,
         timings=timings,

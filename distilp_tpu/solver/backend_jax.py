@@ -45,7 +45,7 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
-from ..ops.ipm import LPBatch, ipm_solve_batch  # noqa: E402
+from ..ops.ipm import IPMWarmState, LPBatch, ipm_solve_batch  # noqa: E402
 from .assemble import INACTIVE_RHS, MilpArrays, VarLayout  # noqa: E402
 from .coeffs import HaldaCoeffs  # noqa: E402
 from .result import ILPResult  # noqa: E402
@@ -119,24 +119,37 @@ def _resolve_search_params(
     ipm_iters: Optional[int],
     max_rounds: Optional[int],
     per_k: bool = False,
-) -> Tuple[int, int, int, int]:
-    """(cap, beam, ipm_iters, max_rounds): caller overrides applied over the
-    problem-class defaults — the one resolution rule for every solve path
-    (single-dispatch, async, scenario-batched).
+    ipm_warm_iters: Optional[int] = None,
+) -> Tuple[int, int, int, int, int]:
+    """(cap, beam, ipm_iters, ipm_warm_iters, max_rounds): caller overrides
+    applied over the problem-class defaults — the one resolution rule for
+    every solve path (single-dispatch, async, scenario-batched).
 
     Per-k mode keeps EVERY k's subtree alive to its own certificate, so the
     frontier carries ~n_k concurrent searches: capacity and beam scale with
     n_k (a frontier sized for one winner spills, and a spilled node floors
     its k's certificate forever).
+
+    ``ipm_warm_iters`` is the iteration budget of every round AFTER the
+    root round: children warm-start from their parent's iterate, so they
+    need far fewer Mehrotra steps to recover a useful dual, and a truncated
+    budget only LOOSENS bounds (the f64 Lagrangian bound is valid for any
+    dual), never invalidates them. Default: half the cold budget, floored
+    where the dual would get too weak to prune at all.
     """
     d_cap, d_beam, d_iters = default_search_params(moe, n_k)
     if per_k:
         d_cap = max(d_cap, 32 * n_k)
         d_beam = max(d_beam, 4 * n_k)
+    it = ipm_iters if ipm_iters is not None else d_iters
+    warm_it = (
+        ipm_warm_iters if ipm_warm_iters is not None else max(6, it // 2)
+    )
     return (
         max(node_cap, n_k) if node_cap is not None else d_cap,
         beam if beam is not None else d_beam,
-        ipm_iters if ipm_iters is not None else d_iters,
+        it,
+        min(warm_it, it) if ipm_warm_iters is None else warm_it,
         max_rounds if max_rounds is not None else MAX_ROUNDS,
     )
 
@@ -1043,6 +1056,22 @@ class SearchState(NamedTuple):
     per_k_n: jax.Array  # (n_k, M) float64
     per_k_y: jax.Array  # (n_k, M) float64
     per_k_dropped: jax.Array  # (n_k,) float64
+    # Per-node IPM iterates (original coordinates, see ops.ipm.IPMWarmState):
+    # children seed their LP solve from the parent's point projected into
+    # the tightened box, duals verbatim — the HALDA child differs from its
+    # parent by one collapsed box, so the warm solve recovers a pruning
+    # dual in a fraction of the cold budget. ``node_warm`` gates rows that
+    # actually carry one (roots start cold unless the previous streaming
+    # tick's root iterates were shipped in).
+    node_v: jax.Array  # (CAP, nf) float32
+    node_y: jax.Array  # (CAP, m) float32
+    node_z: jax.Array  # (CAP, nf) float32
+    node_f: jax.Array  # (CAP, nf) float32
+    node_warm: jax.Array  # (CAP,) bool
+    # Observability accumulators (ride the packed output header): useful
+    # IPM iterations executed across every round, and rounds executed.
+    stat_ipm_iters: jax.Array  # () float64
+    stat_rounds: jax.Array  # () float64
 
 
 class SweepData(NamedTuple):
@@ -1053,7 +1082,7 @@ class SweepData(NamedTuple):
     ``halda_solve`` calls of the same shape.
     """
 
-    A: jax.Array  # (m, nf) float32 shared (dense) or (n_k, m, nf) per-k (MoE)
+    A: jax.Array  # (m, nf) float32 shared base (dense AND hoisted MoE)
     b_k: jax.Array  # (n_k, m) float32
     c_k: jax.Array  # (n_k, nf) float32
     int_mask: jax.Array  # (nf,) bool
@@ -1061,11 +1090,45 @@ class SweepData(NamedTuple):
     Ws: jax.Array  # (n_k,) float64
     obj_const: jax.Array  # () float64
     rd: RoundingData
+    # MoE A-gather hoist: the per-k matrices differ from the shared base in
+    # exactly 2M entries (the expert-busy g/k values on the cycle/prefetch
+    # rows, already row-scaled). Carrying the base once plus this
+    # (n_k, 2, M) table lets ``_bnb_round`` scatter the per-NODE entries
+    # in-trace instead of gathering B full (m, nf) matrices every round —
+    # the only part of the A select that branching can change is which k's
+    # 2M values land on each beam row. None in dense mode (A is k-free).
+    gky: Optional[jax.Array] = None  # (n_k, 2, M) float32
+
+
+def _gky_scatter_table(g_raw, ks, gscale):
+    """(gky, table) for the MoE expert-busy entries: ``gky`` = g_raw/k
+    (n_k, M) — the objective's y-column values — and ``table`` (n_k, 2, M)
+    — the same values times the cycle/prefetch row scales, i.e. exactly
+    what ``build_standard_form`` scatters into A host-side. ONE definition
+    shared by the eager (``_sweep_data``) and packed
+    (``_solve_packed_impl``) paths, so the two A constructions cannot
+    drift apart. Works traced or eager; both outputs are DTYPE.
+    """
+    gky = (
+        jnp.asarray(g_raw, BDTYPE)[None, :] / jnp.asarray(ks, BDTYPE)[:, None]
+    ).astype(DTYPE)
+    gs = jnp.asarray(gscale)
+    table = jnp.stack(
+        [
+            gky * gs[0][None, :].astype(DTYPE),
+            gky * gs[1][None, :].astype(DTYPE),
+        ],
+        axis=1,
+    )
+    return gky, table
 
 
 def _sweep_data(sf: StandardForm, rd: RoundingData) -> SweepData:
+    gky = None
+    if sf.moe:
+        _, gky = _gky_scatter_table(rd.g_raw, sf.ks, sf.gscale)
     return SweepData(
-        A=jnp.asarray(sf.A if sf.moe else sf.A[0], DTYPE),
+        A=jnp.asarray(sf.A_base if sf.moe else sf.A[0], DTYPE),
         b_k=jnp.asarray(sf.b_k, DTYPE),
         c_k=jnp.asarray(sf.c_k, DTYPE),
         int_mask=jnp.asarray(sf.int_mask),
@@ -1073,6 +1136,7 @@ def _sweep_data(sf: StandardForm, rd: RoundingData) -> SweepData:
         Ws=jnp.asarray(sf.Ws, BDTYPE),
         obj_const=jnp.asarray(sf.obj_const, BDTYPE),
         rd=rd,
+        gky=gky,
     )
 
 
@@ -1080,10 +1144,30 @@ def _default_cap(n_k: int) -> int:
     return max(NODE_CAP, 2 * n_k)
 
 
-def _root_state(lo_k, hi_k, M: int, cap: int) -> SearchState:
+def _root_state(
+    lo_k, hi_k, M: int, cap: int, m: int, root_warm=None
+) -> SearchState:
     """Root frontier (one node per k) built from box arrays; jnp throughout,
-    so it works both eagerly and traced inside ``_solve_packed``."""
+    so it works both eagerly and traced inside ``_solve_packed``.
+
+    ``root_warm`` = (ok (n_k,), v (n_k, nf), y (n_k, m), z (n_k, nf),
+    f (n_k, nf)) seeds the roots' IPM iterates from a previous tick's root
+    solve (original coordinates; per-k ``ok`` gates stale entries), so a
+    streaming re-solve starts its root round hot instead of from mid-box.
+    """
     n_k, nf = lo_k.shape
+    node_v = jnp.zeros((cap, nf), DTYPE)
+    node_y = jnp.zeros((cap, m), DTYPE)
+    node_z = jnp.zeros((cap, nf), DTYPE)
+    node_f = jnp.zeros((cap, nf), DTYPE)
+    node_warm = jnp.zeros(cap, bool)
+    if root_warm is not None:
+        ok_w, v_w, y_w, z_w, f_w = root_warm
+        node_v = node_v.at[:n_k].set(v_w.astype(DTYPE))
+        node_y = node_y.at[:n_k].set(y_w.astype(DTYPE))
+        node_z = node_z.at[:n_k].set(z_w.astype(DTYPE))
+        node_f = node_f.at[:n_k].set(f_w.astype(DTYPE))
+        node_warm = node_warm.at[:n_k].set(ok_w)
     return SearchState(
         node_lo=jnp.zeros((cap, nf), DTYPE).at[:n_k].set(lo_k.astype(DTYPE)),
         node_hi=jnp.zeros((cap, nf), DTYPE).at[:n_k].set(hi_k.astype(DTYPE)),
@@ -1103,6 +1187,13 @@ def _root_state(lo_k, hi_k, M: int, cap: int) -> SearchState:
         per_k_n=jnp.zeros((n_k, M), BDTYPE),
         per_k_y=jnp.zeros((n_k, M), BDTYPE),
         per_k_dropped=jnp.full(n_k, jnp.inf, BDTYPE),
+        node_v=node_v,
+        node_y=node_y,
+        node_z=node_z,
+        node_f=node_f,
+        node_warm=node_warm,
+        stat_ipm_iters=jnp.zeros((), BDTYPE),
+        stat_rounds=jnp.zeros((), BDTYPE),
     )
 
 
@@ -1114,7 +1205,9 @@ def _init_state(sf: StandardForm, cap: Optional[int] = None) -> SearchState:
         cap = _default_cap(n_k)
     elif cap < n_k:
         raise ValueError(f"frontier cap {cap} cannot hold {n_k} root nodes")
-    return _root_state(jnp.asarray(sf.lo_k), jnp.asarray(sf.hi_k), sf.M, cap)
+    return _root_state(
+        jnp.asarray(sf.lo_k), jnp.asarray(sf.hi_k), sf.M, cap, sf.A.shape[1]
+    )
 
 
 def _bnb_round(
@@ -1125,9 +1218,16 @@ def _bnb_round(
     beam: Optional[int] = None,
     moe: bool = False,
     per_k: bool = False,
-) -> SearchState:
+    return_res: bool = False,
+    ipm_chunk: Optional[int] = None,
+):
     """One batched branch-and-bound round over the frontier (pure function;
     traced inside the fused solve loop or jitted standalone by callers).
+    Returns the new state; with ``return_res=True`` also the beam rows' raw
+    ``IPMResult`` (the root round reads its iterates for persistence).
+    ``ipm_chunk`` sets the kernel's convergence-test granularity (None =
+    kernel default; pass ``ipm_iters`` to disable the early exit when the
+    rows are known to need the whole budget, e.g. a cold root).
 
     ``beam`` (static) caps how many frontier rows get an IPM solve this round.
     Compaction keeps the frontier sorted best-bound-first, so the prefix holds
@@ -1159,11 +1259,45 @@ def _bnb_round(
     active_p = state.active[:B]
 
     # Dense mode shares one (m, nf) A across every k (the IPM broadcasts a
-    # 2-D A); the MoE family gathers its per-k matrices per node.
-    A_p = A if A.ndim == 2 else A[kidx_p]
+    # 2-D A). MoE mode scatters each node's 2M per-k expert-busy entries
+    # onto the shared base (``SweepData.gky``): branching only ever changes
+    # WHICH k's entries land on a row, so the round gathers B*(2M) scalars
+    # instead of B full matrices. A legacy (n_k, m, nf) A still gathers.
+    if data.gky is not None:
+        m_rows = A.shape[0]
+        nf_cols = A.shape[1]
+        A_p = jnp.broadcast_to(A, (B, m_rows, nf_cols))
+        g_p = data.gky[kidx_p]  # (B, 2, M)
+        y_cols = 2 * M + jnp.arange(M)
+        rows_cyc = 4 * M + jnp.arange(M)
+        rows_pre = 5 * M + jnp.arange(M)
+        A_p = A_p.at[:, rows_cyc, y_cols].set(g_p[:, 0, :])
+        A_p = A_p.at[:, rows_pre, y_cols].set(g_p[:, 1, :])
+    else:
+        A_p = A if A.ndim == 2 else A[kidx_p]
     b = data.b_k[kidx_p]
     c = data.c_k[kidx_p]
-    res = ipm_solve_batch(LPBatch(A=A_p, b=b, c=c, l=lo_p, u=hi_p), iters=ipm_iters)
+    # Warm-start each node from the iterate it carries (the parent's point
+    # projected into this node's box — the projection happens inside the
+    # kernel — with duals reused verbatim); inactive rows are skipped so
+    # they stop gating the kernel's batch-wide early exit. Budget truncation
+    # and warm quality only move bound TIGHTNESS: the f64 Lagrangian bound
+    # is valid for whatever dual the solve reaches.
+    warm = IPMWarmState(
+        v=state.node_v[:B],
+        y=state.node_y[:B],
+        z=state.node_z[:B],
+        f=state.node_f[:B],
+        ok=state.node_warm[:B],
+    )
+    chunk_kw = {} if ipm_chunk is None else {"chunk": ipm_chunk}
+    res = ipm_solve_batch(
+        LPBatch(A=A_p, b=b, c=c, l=lo_p, u=hi_p),
+        iters=ipm_iters,
+        warm=warm,
+        skip=~active_p,
+        **chunk_kw,
+    )
     bound = res.bound + obj_const
     # A diverged IPM instance reports -inf (see ops/ipm.py); fall back to the
     # inherited parent bound so the node keeps exploring instead of being
@@ -1304,6 +1438,21 @@ def _bnb_round(
     child_kidx = jnp.concatenate([kidx_p, kidx_p, state.node_kidx[B:]])
     child_bound = jnp.concatenate([bound, bound, rest_bound])
     child_active = jnp.concatenate([survive, survive, rest_active])
+    # Both children inherit the node's final iterate (their boxes differ
+    # from it by one split; the kernel projects on entry); pass-through
+    # rows keep what they carried. Rows that were solved this round carry
+    # a usable iterate whether or not they survive pruning.
+    solved = active_p[:, None]
+    v_new = jnp.where(solved, res.v.astype(DTYPE), state.node_v[:B])
+    y_new = jnp.where(solved, res.y_dual.astype(DTYPE), state.node_y[:B])
+    z_new = jnp.where(solved, res.z_dual.astype(DTYPE), state.node_z[:B])
+    f_new = jnp.where(solved, res.f_dual.astype(DTYPE), state.node_f[:B])
+    warm_new = active_p | state.node_warm[:B]
+    child_v = jnp.concatenate([v_new, v_new, state.node_v[B:]], axis=0)
+    child_y = jnp.concatenate([y_new, y_new, state.node_y[B:]], axis=0)
+    child_z = jnp.concatenate([z_new, z_new, state.node_z[B:]], axis=0)
+    child_f = jnp.concatenate([f_new, f_new, state.node_f[B:]], axis=0)
+    child_warm = jnp.concatenate([warm_new, warm_new, state.node_warm[B:]])
 
     # Compact best-bound-first back into the full capacity; track what falls off.
     sort_key = jnp.where(child_active, child_bound, jnp.inf)
@@ -1356,7 +1505,7 @@ def _bnb_round(
     else:
         per_k_dropped = state.per_k_dropped
 
-    return SearchState(
+    out = SearchState(
         node_lo=child_lo[keep],
         node_hi=child_hi[keep],
         node_kidx=child_kidx[keep],
@@ -1373,7 +1522,16 @@ def _bnb_round(
         per_k_n=per_k_n,
         per_k_y=per_k_y,
         per_k_dropped=per_k_dropped,
+        node_v=child_v[keep],
+        node_y=child_y[keep],
+        node_z=child_z[keep],
+        node_f=child_f[keep],
+        node_warm=child_warm[keep],
+        stat_ipm_iters=state.stat_ipm_iters
+        + jnp.sum(res.iters_run).astype(BDTYPE),
+        stat_rounds=state.stat_rounds + 1.0,
     )
+    return (out, res) if return_res else out
 
 
 def _seed_root_bounds(
@@ -1506,6 +1664,7 @@ def _pack_dynamic(
     warm: Optional[Tuple[int, Sequence[int], Sequence[int], Sequence[int]]] = None,
     duals: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
     margin: Optional[np.ndarray] = None,
+    root_warm: Optional[Tuple[np.ndarray, ...]] = None,
 ) -> np.ndarray:
     """Flatten the PER-TICK half of a sweep into one float32 vector.
 
@@ -1534,9 +1693,23 @@ def _pack_dynamic(
     tick (the margin fast path: host-side drift accounting replaces the
     on-device bound evaluation entirely); gated by the static
     ``has_margin``.
+
+    ``root_warm`` = (ok, v, y, z, f) per-k root IPM iterates from the
+    previous tick (see ``_solve_packed_impl``'s output tail); f32 — they
+    are search state, not certificate inputs — and gated by the static
+    ``has_root_warm``.
     """
     M = sf.M
     f32_parts = [np.asarray(sf.b_k, np.float32).ravel()]
+    if root_warm is not None:
+        ok_w, v_w, y_w, z_w, f_w = root_warm
+        f32_parts += [
+            np.asarray(ok_w, np.float32).ravel(),
+            np.asarray(v_w, np.float32).ravel(),
+            np.asarray(y_w, np.float32).ravel(),
+            np.asarray(z_w, np.float32).ravel(),
+            np.asarray(f_w, np.float32).ravel(),
+        ]
     f64_parts = [
         np.asarray(sf.ks, np.float64),
         np.asarray(sf.Ws, np.float64),
@@ -1650,7 +1823,7 @@ _RD_VEC_FIELDS = (
 _PACKED_STATIC_ARGS = (
     "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
     "has_warm", "w_max", "e_max", "decomp_steps", "has_duals", "per_k",
-    "has_margin",
+    "has_margin", "ipm_warm_iters", "has_root_warm",
 )
 
 
@@ -1673,6 +1846,8 @@ def _solve_packed_impl(
     has_duals: bool = False,
     per_k: bool = False,
     has_margin: bool = False,
+    ipm_warm_iters: Optional[int] = None,
+    has_root_warm: bool = False,
 ) -> jax.Array:
     """One-dispatch sweep: unpack the two blobs (``_pack_static`` stays
     device-resident across streaming ticks; ``_pack_dynamic`` is the per-tick
@@ -1681,6 +1856,7 @@ def _solve_packed_impl(
     fused B&B loop, and pack the answer into one float64 vector:
 
         [incumbent, best_bound, inc_kidx, dropped_bound,
+         ipm_iters_executed, bnb_rounds,
          inc_w (M), inc_n (M), inc_y (M), per_k_best (n_k)]
 
     When the root decomposition runs (``decomp_steps >= 0 and w_max > 0``)
@@ -1696,6 +1872,12 @@ def _solve_packed_impl(
     ``[per_k_w (n_k*M), per_k_n (n_k*M), per_k_y (n_k*M),
     per_k_bound (n_k)]`` — and switches the search to per-k pruning (every
     feasible k terminates with its own optimum and certificate).
+
+    The ROOT-ROUND IPM iterates — ``[ok (n_k), v (n_k*nf), y (n_k*m),
+    z (n_k*nf), f (n_k*nf)]`` — always follow (before the m_y tail): the
+    caller persists them and ships them back through ``has_root_warm``'s
+    dynamic-blob slot so the next streaming tick's root round starts from
+    this tick's iterates instead of mid-box.
     """
     if has_margin and not (has_duals and has_warm):
         # Static-arg invariant, so it must survive `python -O` (an assert
@@ -1743,6 +1925,17 @@ def _solve_packed_impl(
         return s
 
     b_k = take32(n_k * m).reshape(n_k, m)
+    root_warm = None
+    if has_root_warm:
+        # Previous tick's per-k root iterates (f32: they are search state,
+        # not certificate inputs — a corrupted iterate can only cost
+        # iterations, the kernel falls back to cold per element).
+        rw_ok = take32(n_k) > 0.5
+        rw_v = take32(n_k * nf).reshape(n_k, nf)
+        rw_y = take32(n_k * m).reshape(n_k, m)
+        rw_z = take32(n_k * nf).reshape(n_k, nf)
+        rw_f = take32(n_k * nf).reshape(n_k, nf)
+        root_warm = (rw_ok, rw_v, rw_y, rw_z, rw_f)
 
     # Everything certificate-critical rides as f64 bit pairs (_pack_dynamic).
     f64v = jax.lax.bitcast_convert_type(
@@ -1798,19 +1991,17 @@ def _solve_packed_impl(
         jnp.maximum(b_k[:, :m_ub] - (smin_k + cmin), 0.0)
     )
     hi_k = hi_k.at[:, C_idx].set(C_ub_k.astype(DTYPE))
+    gky_tab = None
     if moe:
-        # Scatter the 2M per-k expert-busy entries onto the shared base and
-        # fill c's y block: g_raw/k (objective), g_raw/k * row_scale (A).
+        # The per-k matrices differ from the base in only the 2M expert-busy
+        # entries: keep the base SHARED and hand ``_bnb_round`` the per-k
+        # scatter table — each round scatters the beam's 2M-entry rows
+        # in-trace instead of this program materializing (and the round
+        # gathering) n_k full matrices. c's y block still fills here.
         y_cols = 2 * M + jnp.arange(M)
-        gky = (rd_vecs["g_raw"][None, :] / ks[:, None]).astype(DTYPE)
+        gky, gky_tab = _gky_scatter_table(rd_vecs["g_raw"], ks, gscale)
         c_k = c_k.at[:, y_cols].set(gky)
-        A = jnp.broadcast_to(A_base, (n_k, m, nf))
-        rows_cyc = 4 * M + jnp.arange(M)
-        rows_pre = 5 * M + jnp.arange(M)
-        A = A.at[:, rows_cyc, y_cols].set(gky * gscale[0][None, :].astype(DTYPE))
-        A = A.at[:, rows_pre, y_cols].set(gky * gscale[1][None, :].astype(DTYPE))
-    else:
-        A = A_base  # shared across k; _bnb_round handles the 2-D case
+    A = A_base  # shared across k; MoE rides the gky scatter
 
     rd = RoundingData(bprime=bprime, E=E, **rd_vecs)
     data = SweepData(
@@ -1822,9 +2013,10 @@ def _solve_packed_impl(
         Ws=Ws,
         obj_const=obj_const,
         rd=rd,
+        gky=gky_tab,
     )
 
-    state = _root_state(lo_k, hi_k, M, cap)
+    state = _root_state(lo_k, hi_k, M, cap, m, root_warm=root_warm)
 
     out_duals = None
     out_root_bounds = None
@@ -1892,7 +2084,7 @@ def _solve_packed_impl(
             ),
         )
 
-    state = _run_bnb_loop(
+    state, root_iters = _run_bnb_loop(
         data,
         state,
         mip_gap,
@@ -1901,6 +2093,9 @@ def _solve_packed_impl(
         beam=beam,
         moe=moe,
         per_k=per_k,
+        ipm_warm_iters=ipm_warm_iters,
+        collect_root=True,
+        root_warm_chunk=has_root_warm,
     )
 
     parts = [
@@ -1910,6 +2105,8 @@ def _solve_packed_impl(
                 _best_bound(state),
                 state.inc_kidx.astype(BDTYPE),
                 state.dropped_bound,
+                state.stat_ipm_iters,
+                state.stat_rounds,
             ]
         ),
         state.inc_w,
@@ -1932,6 +2129,17 @@ def _solve_packed_impl(
             state.per_k_y.ravel(),
             _per_k_bound(state),
         ]
+    # Root-round iterates (rows 0..n_k-1 of the root beam) for cross-tick
+    # persistence — a skipped root round (settled warm tick) re-emits the
+    # carried-in iterates, so the chain never decays to cold.
+    ok_r, v_r, y_r, z_r, f_r = root_iters
+    parts += [
+        ok_r[:n_k].astype(BDTYPE),
+        v_r[:n_k].astype(BDTYPE).ravel(),
+        y_r[:n_k].astype(BDTYPE).ravel(),
+        z_r[:n_k].astype(BDTYPE).ravel(),
+        f_r[:n_k].astype(BDTYPE).ravel(),
+    ]
     if out_m_y is not None:
         # y-profile tail (n_k*M*(e_max+1)), LAST so no earlier offset moves:
         # read back by solve_sweep_jax for the margin fast path; absent on
@@ -2079,6 +2287,8 @@ def _solve_scenarios_packed(
     has_duals: bool = False,
     per_k: bool = False,
     has_margin: bool = False,
+    ipm_warm_iters: Optional[int] = None,
+    has_root_warm: bool = False,
 ) -> jax.Array:
     return jax.vmap(
         lambda dyn: _solve_packed_impl(
@@ -2086,7 +2296,8 @@ def _solve_scenarios_packed(
             ipm_iters=ipm_iters, max_rounds=max_rounds, beam=beam, moe=moe,
             has_warm=has_warm, w_max=w_max, e_max=e_max,
             decomp_steps=decomp_steps, has_duals=has_duals, per_k=per_k,
-            has_margin=has_margin,
+            has_margin=has_margin, ipm_warm_iters=ipm_warm_iters,
+            has_root_warm=has_root_warm,
         )
     )(dyn_blobs)
 
@@ -2133,38 +2344,115 @@ def _run_bnb_loop(
     beam: Optional[int] = None,
     moe: bool = False,
     per_k: bool = False,
-) -> SearchState:
-    """``lax.while_loop`` over B&B rounds with the mip-gap test on-device.
-    The single shared definition of the search loop (traced by both the
-    packed single-dispatch path and the mesh-sharded path). ``per_k``
-    switches both the pruning regime and the termination test (every k
-    settled vs the global gap closed)."""
+    ipm_warm_iters: Optional[int] = None,
+    collect_root: bool = False,
+    root_warm_chunk: bool = False,
+    root_beam: Optional[int] = None,
+):
+    """B&B rounds with the mip-gap test on-device. The single shared
+    definition of the search loop (traced by both the packed single-dispatch
+    path and the mesh-sharded path). ``per_k`` switches both the pruning
+    regime and the termination test (every k settled vs the global gap
+    closed).
+
+    Two-phase structure: a ROOT round first — full ``ipm_iters`` budget and
+    a beam widened to cover every root, since roots either start cold or
+    from last tick's iterates — then a ``lax.while_loop`` of warm rounds at
+    the (smaller) ``ipm_warm_iters`` budget, sound because every loop node
+    carries its parent's iterate and a truncated solve only loosens the f64
+    bound. The root round itself sits under ``lax.cond``: a streaming tick
+    whose seeded bounds + warm incumbent already certify (the settled test)
+    pays ZERO IPM work, exactly like the old loop's round-0 exit.
+
+    ``collect_root=True`` additionally returns the root round's iterates
+    ``(ok, v, y, z, f)`` (beam-row arrays; roots are rows ``0..n_k-1``) for
+    cross-tick persistence — on a skipped root round the carried-in warm
+    iterates pass through unchanged.
+
+    ``root_warm_chunk=True`` keeps the kernel's small convergence-test
+    chunks for the root round (the roots carry last tick's iterates and
+    exit after a few steps); a cold root needs its whole budget, so by
+    default the root runs one full-length chunk and skips the while-loop
+    overhead entirely.
+    """
+    warm_iters = ipm_iters if ipm_warm_iters is None else ipm_warm_iters
+    n_k = state.per_k_best.shape[0]
+    cap = state.node_lo.shape[0]
+    # The root frontier is exactly the n_k root nodes (rows 0..n_k-1), so
+    # the root round's batch is sized to them — a wider beam would only add
+    # skip-masked lanes that still pay their share of each batched
+    # factorization. ``root_beam`` overrides upward (never below n_k): the
+    # mesh-sharded path pads it to a multiple of the mesh size so the root
+    # round keeps its even-rows-per-device sharding.
+    B0 = min(cap, max(n_k, root_beam or 0))
+
+    def settled_of(st):
+        return (
+            _certified_per_k(st, mip_gap)
+            if per_k
+            else _certified(st, mip_gap)
+        )
+
+    def passthrough(st):
+        return st, (
+            st.node_warm[:B0],
+            st.node_v[:B0],
+            st.node_y[:B0],
+            st.node_z[:B0],
+            st.node_f[:B0],
+        )
+
+    if max_rounds >= 1:
+        def root_fn(st):
+            ok = st.active[:B0]
+            st2, res = _bnb_round(
+                data, st, mip_gap, ipm_iters=ipm_iters, beam=B0,
+                moe=moe, per_k=per_k, return_res=True,
+                ipm_chunk=None if root_warm_chunk else ipm_iters,
+            )
+            return st2, (
+                ok,
+                res.v.astype(DTYPE),
+                res.y_dual.astype(DTYPE),
+                res.z_dual.astype(DTYPE),
+                res.f_dual.astype(DTYPE),
+            )
+
+        state, root_iters = jax.lax.cond(
+            jnp.any(state.active) & ~settled_of(state),
+            root_fn,
+            passthrough,
+            state,
+        )
+    else:
+        state, root_iters = passthrough(state)
 
     def cond(carry):
         state, i = carry
-        settled = (
-            _certified_per_k(state, mip_gap)
-            if per_k
-            else _certified(state, mip_gap)
-        )
-        return (i < max_rounds) & jnp.any(state.active) & ~settled
+        return (i < max_rounds) & jnp.any(state.active) & ~settled_of(state)
 
     def body(carry):
         state, i = carry
         return (
             _bnb_round(
-                data, state, mip_gap, ipm_iters=ipm_iters, beam=beam,
+                data, state, mip_gap, ipm_iters=warm_iters, beam=beam,
                 moe=moe, per_k=per_k,
             ),
             i + 1,
         )
 
-    state, _ = jax.lax.while_loop(cond, body, (state, jnp.asarray(0, jnp.int32)))
+    state, _ = jax.lax.while_loop(cond, body, (state, jnp.asarray(1, jnp.int32)))
+    if collect_root:
+        return state, root_iters
     return state
 
 
 @partial(
-    jax.jit, static_argnames=("ipm_iters", "max_rounds", "beam", "moe", "per_k")
+    jax.jit,
+    static_argnames=(
+        "ipm_iters", "max_rounds", "beam", "moe", "per_k", "ipm_warm_iters",
+        "root_beam",
+    ),
 )
 def _solve_fused(
     data: SweepData,
@@ -2175,6 +2463,8 @@ def _solve_fused(
     beam: Optional[int] = None,
     moe: bool = False,
     per_k: bool = False,
+    ipm_warm_iters: Optional[int] = None,
+    root_beam: Optional[int] = None,
 ) -> SearchState:
     """The full branch-and-bound sweep as one device program; the host does
     one dispatch and one fetch per HALDA solve."""
@@ -2187,6 +2477,8 @@ def _solve_fused(
         beam=beam,
         moe=moe,
         per_k=per_k,
+        ipm_warm_iters=ipm_warm_iters,
+        root_beam=root_beam,
     )
 
 
@@ -2196,9 +2488,10 @@ def _warm_and_duals(
     warm: Optional[ILPResult],
     feasible: Sequence[Tuple[int, int]],
 ):
-    """(warm_tuple, duals_tuple) for one sweep — the host-side preparation
-    of a previous solve's assignment and Lagrangian multipliers, shared by
-    the single-dispatch and scenario-batched paths."""
+    """(warm_tuple, duals_tuple, root_warm_tuple) for one sweep — the
+    host-side preparation of a previous solve's assignment, Lagrangian
+    multipliers, and root IPM iterates, shared by the single-dispatch and
+    scenario-batched paths."""
     M = sf.M
     n_k = len(sf.ks)
     warm_tuple = None
@@ -2238,7 +2531,34 @@ def _warm_and_duals(
             and np.all(np.isfinite(tau))
         ):
             duals_tuple = (lam, mu, tau)
-    return warm_tuple, duals_tuple
+
+    # Previous tick's root IPM iterates, when their shapes still match this
+    # sweep (same k grid, same LP family shape). Finite-ness is NOT gated
+    # here: the kernel falls back to a cold start per element on any
+    # non-finite component, so a partially-stale state still helps.
+    root_warm_tuple = None
+    ipm_state = getattr(warm, "ipm_state", None) if warm is not None else None
+    if ipm_state is not None:
+        m = sf.A.shape[1]
+        nf = sf.A.shape[2]
+        try:
+            ok = np.asarray(ipm_state["ok"], np.float32)
+            v = np.asarray(ipm_state["v"], np.float32)
+            y = np.asarray(ipm_state["y"], np.float32)
+            z = np.asarray(ipm_state["z"], np.float32)
+            f = np.asarray(ipm_state["f"], np.float32)
+        except (KeyError, TypeError, ValueError):
+            ok = None
+        if (
+            ok is not None
+            and ok.shape == (n_k,)
+            and v.shape == (n_k, nf)
+            and y.shape == (n_k, m)
+            and z.shape == (n_k, nf)
+            and f.shape == (n_k, nf)
+        ):
+            root_warm_tuple = (ok, v, y, z, f)
+    return warm_tuple, duals_tuple, root_warm_tuple
 
 
 def solve_sweep_jax(
@@ -2256,6 +2576,7 @@ def solve_sweep_jax(
     collect: bool = True,
     per_k_optima: bool = False,
     margin_state: Optional[dict] = None,
+    ipm_warm_iters: Optional[int] = None,
 ):
     """Solve the whole k-sweep on the accelerator.
 
@@ -2315,11 +2636,13 @@ def solve_sweep_jax(
 
     sf = build_standard_form(arrays, coeffs, feasible)
     n_k = len(sf.ks)
-    cap, beam, ipm_iters, max_rounds = _resolve_search_params(
+    cap, beam, ipm_iters, ipm_warm_iters, max_rounds = _resolve_search_params(
         sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds,
-        per_k=per_k_optima,
+        per_k=per_k_optima, ipm_warm_iters=ipm_warm_iters,
     )
-    warm_tuple, duals_tuple = _warm_and_duals(sf, arrays, warm, feasible)
+    warm_tuple, duals_tuple, root_warm_tuple = _warm_and_duals(
+        sf, arrays, warm, feasible
+    )
 
     # Root decomposition bounds are what certify wide-expert MoE instances
     # (the LP root gap there is structural); dense sweeps certify from the
@@ -2373,6 +2696,7 @@ def solve_sweep_jax(
     static_np = _pack_static(sf)
     dyn_np = _pack_dynamic(
         sf, rd_np, mip_gap, warm_tuple, duals=duals_tuple, margin=margin_np,
+        root_warm=root_warm_tuple,
     )
     t1 = _time.perf_counter()
     static_dev, static_uploaded = _static_to_device(static_np)
@@ -2403,6 +2727,8 @@ def solve_sweep_jax(
         has_duals=duals_tuple is not None,
         per_k=per_k_optima,
         has_margin=has_margin,
+        ipm_warm_iters=ipm_warm_iters,
+        has_root_warm=root_warm_tuple is not None,
     )
     pending = PendingSweep(
         out=out_dev,
@@ -2416,6 +2742,9 @@ def solve_sweep_jax(
         mip_gap=mip_gap,
         debug=debug,
         per_k=per_k_optima,
+        nf=sf.A.shape[2],
+        m=sf.A.shape[1],
+        stats=timings,
         margin_ctx=(
             (
                 margin_state, has_margin, rd_np,
@@ -2480,26 +2809,33 @@ class PendingSweep(NamedTuple):
     # the fetched y-profile tail), which is what lets pipelined
     # submit/collect ticks ride the margin fast path too.
     margin_ctx: Optional[tuple] = None
+    # LP family shape (root-iterate block decode) and an optional dict that
+    # receives the solve's device-side stats (ipm_iters_executed, rounds).
+    nf: int = 0
+    m: int = 0
+    stats: Optional[dict] = None
 
 
 def _expected_out_len(
     M: int, n_k: int, moe: bool, w_max: int, per_k: bool,
-    has_margin: bool, Yn: int,
+    has_margin: bool, Yn: int, nf: int, m: int,
 ) -> int:
     """Total ``_solve_packed`` output length implied by the static flags.
 
     Mirrors the pack order at the end of ``_solve_packed_impl``: header +
     incumbent vectors + per-k bests, then (when the decomposition context
-    exists) the duals block, then the per-k assignment block, then — LAST,
-    and only on full-evaluation ticks — the margin anchor's y-profile.
-    The input side has the off64 layout-drift assert; this is its output
-    twin, guarding the negative tail slice the margin anchor is read with.
+    exists) the duals block, then the per-k assignment block, then the
+    root-iterate block, then — LAST, and only on full-evaluation ticks —
+    the margin anchor's y-profile. The input side has the off64
+    layout-drift assert; this is its output twin, guarding the negative
+    tail slice the margin anchor is read with.
     """
-    n = 4 + 3 * M + n_k
+    n = 6 + 3 * M + n_k
     if moe and w_max > 0:
         n += 3 * n_k + n_k * M  # lam, mu, tau, root_bounds
     if per_k:
         n += 3 * n_k * M + n_k  # per_k_w/n/y, per_k_bound
+    n += n_k * (1 + 3 * nf + m)  # root-iterate block (ok, v, y, z, f)
     if moe and w_max > 0 and not has_margin:
         n += n_k * M * Yn  # m_y anchor profile
     return n
@@ -2514,7 +2850,8 @@ def collect_sweep(
     results, best = _decode_sweep_out(
         out, pending.results, pending.feasible, pending.kWs, pending.M,
         pending.n_k, pending.moe, pending.w_max, pending.mip_gap,
-        pending.debug, per_k=pending.per_k,
+        pending.debug, per_k=pending.per_k, nf=pending.nf, m=pending.m,
+        stats=pending.stats,
     )
     if pending.margin_ctx is not None:
         margin_state, has_margin, rd_np, ks_arr, Ws_arr = pending.margin_ctx
@@ -2524,7 +2861,7 @@ def collect_sweep(
         Yn = int(np.asarray(rd_np["E"])) + 1
         expected = _expected_out_len(
             pending.M, pending.n_k, pending.moe, pending.w_max,
-            pending.per_k, has_margin, Yn,
+            pending.per_k, has_margin, Yn, pending.nf, pending.m,
         )
         if out.shape[0] != expected:
             # Explicit raise (not `assert`) so the guard survives
@@ -2578,20 +2915,32 @@ def _decode_sweep_out(
     mip_gap: float,
     debug: bool,
     per_k: bool = False,
+    nf: int = 0,
+    m: int = 0,
+    stats: Optional[dict] = None,
 ) -> Tuple[List[Optional[ILPResult]], Optional[ILPResult]]:
     """Decode one fetched ``_solve_packed`` output vector (shared by the
-    single-dispatch, async, and scenario-batched paths)."""
+    single-dispatch, async, and scenario-batched paths). ``stats`` (when a
+    dict is passed) receives the device program's execution counters:
+    ``ipm_iters_executed`` (useful Mehrotra iterations summed over every
+    element of every round) and ``bnb_rounds``."""
     incumbent = float(out[0])
     best_bound = float(out[1])
+    if stats is not None:
+        stats["ipm_iters_executed"] = float(out[4])
+        stats["bnb_rounds"] = float(out[5])
     if debug:
-        print(f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f}")
+        print(
+            f"    [jax] incumbent={incumbent:.6f} bound={best_bound:.6f} "
+            f"ipm_iters={out[4]:.0f} rounds={out[5]:.0f}"
+        )
     if not np.isfinite(incumbent):
         if per_k:
             # No k found an incumbent. Distinguish budget starvation
             # (some bound still below +inf: subtrees remain) from proven
             # infeasibility (every subtree exhausted) — silence here would
             # make max_rounds=small look like "infeasible for every k".
-            p0 = 4 + 3 * M + n_k
+            p0 = 6 + 3 * M + n_k
             if moe and w_max > 0:
                 p0 += 3 * n_k + n_k * M  # lam, mu, tau, root_bounds
             pk_bound0 = out[p0 + 3 * n_k * M : p0 + 3 * n_k * M + n_k]
@@ -2629,16 +2978,16 @@ def _decode_sweep_out(
         )
 
     inc_k_idx = int(out[2])
-    inc_w = [int(round(x)) for x in out[4 : 4 + M]]
-    inc_n = [int(round(x)) for x in out[4 + M : 4 + 2 * M]]
-    inc_y = [int(round(x)) for x in out[4 + 2 * M : 4 + 3 * M]]
-    per_k_best = out[4 + 3 * M : 4 + 3 * M + n_k]
+    inc_w = [int(round(x)) for x in out[6 : 6 + M]]
+    inc_n = [int(round(x)) for x in out[6 + M : 6 + 2 * M]]
+    inc_y = [int(round(x)) for x in out[6 + 2 * M : 6 + 3 * M]]
+    per_k_best = out[6 + 3 * M : 6 + 3 * M + n_k]
 
     # Root multipliers chosen by this solve (MoE only): persist on the
     # winning result so the next streaming tick warm-starts the ascent.
     out_duals = None
     if moe and w_max > 0:
-        d0 = 4 + 3 * M + n_k
+        d0 = 6 + 3 * M + n_k
         lam_out = out[d0 : d0 + n_k]
         mu_out = out[d0 + n_k : d0 + 2 * n_k]
         tau_out = out[d0 + 2 * n_k : d0 + 2 * n_k + n_k * M].reshape(n_k, M)
@@ -2656,14 +3005,34 @@ def _decode_sweep_out(
     # Per-k mode: the tail carries full per-k assignments + per-k bounds,
     # right after the (optional) duals block.
     pk_w = pk_n = pk_y = pk_bound = None
+    p0 = 6 + 3 * M + n_k
+    if moe and w_max > 0:
+        p0 += 3 * n_k + n_k * M  # duals block incl. root_bounds
     if per_k:
-        p0 = 4 + 3 * M + n_k
-        if moe and w_max > 0:
-            p0 += 3 * n_k + n_k * M  # duals block incl. root_bounds
         pk_w = out[p0 : p0 + n_k * M].reshape(n_k, M)
         pk_n = out[p0 + n_k * M : p0 + 2 * n_k * M].reshape(n_k, M)
         pk_y = out[p0 + 2 * n_k * M : p0 + 3 * n_k * M].reshape(n_k, M)
         pk_bound = out[p0 + 3 * n_k * M : p0 + 3 * n_k * M + n_k]
+        p0 += 3 * n_k * M + n_k
+
+    # Root-round IPM iterates (always emitted, right after the per-k
+    # block): persisted on the winning result so the next streaming tick's
+    # root round starts from them (f32 on the wire; f64 here is just the
+    # output vector's dtype).
+    out_ipm_state = None
+    if nf and m:
+        r_ok = out[p0 : p0 + n_k] > 0.5
+        r_v = out[p0 + n_k : p0 + n_k + n_k * nf].reshape(n_k, nf)
+        ry0 = p0 + n_k + n_k * nf
+        r_y = out[ry0 : ry0 + n_k * m].reshape(n_k, m)
+        rz0 = ry0 + n_k * m
+        r_z = out[rz0 : rz0 + n_k * nf].reshape(n_k, nf)
+        rf0 = rz0 + n_k * nf
+        r_f = out[rf0 : rf0 + n_k * nf].reshape(n_k, nf)
+        if np.any(r_ok):
+            out_ipm_state = {
+                "ok": r_ok, "v": r_v, "y": r_y, "z": r_z, "f": r_f,
+            }
 
     best: Optional[ILPResult] = None
     pos_of = {kW: i for i, kW in enumerate(kWs)}
@@ -2700,6 +3069,7 @@ def _decode_sweep_out(
                 certified=cert_j,
                 gap=gap_j,
                 duals=out_duals if j == inc_k_idx else None,
+                ipm_state=out_ipm_state if j == inc_k_idx else None,
             )
             results[pos_of[(k, W)]] = entry
             if j == inc_k_idx:
@@ -2709,6 +3079,7 @@ def _decode_sweep_out(
             best = ILPResult(
                 k=k, w=inc_w, n=inc_n, y=y, obj_value=obj_j,
                 certified=certified, gap=achieved_gap, duals=out_duals,
+                ipm_state=out_ipm_state,
             )
             results[pos_of[(k, W)]] = best
         else:
@@ -2769,6 +3140,7 @@ def solve_sweep_scenarios(
     beam: Optional[int] = None,
     node_cap: Optional[int] = None,
     timings: Optional[dict] = None,
+    ipm_warm_iters: Optional[int] = None,
 ) -> List[Tuple[List[Optional[ILPResult]], Optional[ILPResult]]]:
     """Solve S what-if scenarios of ONE fleet in a single device dispatch.
 
@@ -2824,8 +3196,9 @@ def solve_sweep_scenarios(
 
     sf = sfs[0]
     n_k = len(sf.ks)
-    cap, beam, ipm_iters, max_rounds = _resolve_search_params(
-        sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds
+    cap, beam, ipm_iters, ipm_warm_iters, max_rounds = _resolve_search_params(
+        sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds,
+        ipm_warm_iters=ipm_warm_iters,
     )
 
     pairs = [
@@ -2834,10 +3207,12 @@ def solve_sweep_scenarios(
         )
         for i, (sf_i, a_i) in enumerate(zip(sfs, arrays_list))
     ]
-    # The jit layout (has_warm/has_duals statics) is shared across the vmap
-    # axis: engage each slot only when every scenario can fill it.
-    use_warm = all(w is not None for w, _ in pairs)
-    use_duals = all(d is not None for _, d in pairs)
+    # The jit layout (has_warm/has_duals/has_root_warm statics) is shared
+    # across the vmap axis: engage each slot only when every scenario can
+    # fill it.
+    use_warm = all(w is not None for w, _, _ in pairs)
+    use_duals = all(d is not None for _, d, _ in pairs)
+    use_root_warm = all(r is not None for _, _, r in pairs)
     if sf.moe:
         w_max = max(W for _, W in feasible)
         e_max = int(arrays_list[0].moe.E)
@@ -2860,6 +3235,7 @@ def solve_sweep_scenarios(
                 mip_gap,
                 pairs[i][0] if use_warm else None,
                 duals=pairs[i][1] if use_duals else None,
+                root_warm=pairs[i][2] if use_root_warm else None,
             )
             for i, (sf_i, a_i, c_i) in enumerate(
                 zip(sfs, arrays_list, coeffs_list)
@@ -2891,6 +3267,8 @@ def solve_sweep_scenarios(
         e_max=e_max,
         decomp_steps=decomp_steps,
         has_duals=use_duals,
+        ipm_warm_iters=ipm_warm_iters,
+        has_root_warm=use_root_warm,
     )
     out_np = np.asarray(jax.device_get(out_dev))
     t3 = _time.perf_counter()
@@ -2909,6 +3287,7 @@ def solve_sweep_scenarios(
         _decode_sweep_out(
             out_np[i], [None] * len(kWs), feasible, list(kWs), M, n_k,
             sf.moe, w_max, mip_gap, False,
+            nf=sf.A.shape[2], m=sf.A.shape[1],
         )
         for i in range(S)
     ]

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
-from pydantic import BaseModel
+from pydantic import BaseModel, Field
 
 from ..common import DeviceProfile
 
@@ -39,6 +39,13 @@ class ILPResult(BaseModel):
     # warm re-certification needs a short polish instead of the full cold
     # ascent — the bound is valid at ANY multiplier vector.
     duals: Optional[Dict[str, List]] = None
+    # Root-round IPM iterates ({"ok", "v", "y", "z", "f"} numpy arrays, one
+    # row per k; JAX solves only): the next streaming tick ships them back
+    # so its root LP solves start from this tick's iterates instead of the
+    # mid-box cold point. Search state, not part of the certificate —
+    # excluded from serialization (a reloaded result simply re-solves its
+    # roots cold).
+    ipm_state: Optional[dict] = Field(default=None, exclude=True, repr=False)
 
 
 class HALDAResult(BaseModel):
@@ -57,6 +64,9 @@ class HALDAResult(BaseModel):
     # Lagrangian root multipliers for warm-starting the next streaming tick
     # (see ILPResult.duals).
     duals: Optional[Dict[str, List]] = None
+    # Root IPM iterates for cross-tick warm starts (see ILPResult.ipm_state;
+    # excluded from serialization).
+    ipm_state: Optional[dict] = Field(default=None, exclude=True, repr=False)
 
     def solution_text(self, devices: Sequence[DeviceProfile]) -> str:
         lines = [

@@ -13,6 +13,15 @@ then used as the initial incumbent, so branch-and-bound prunes from round
 one. When the fleet barely changed, the first certificate check usually
 passes within a round or two; when it changed shape (device count, L), the
 replanner falls back to a cold solve automatically.
+
+Three kinds of warm state ride ``self.last`` across ticks: the integer
+assignment (incumbent seed), the Lagrangian root multipliers (MoE bound
+re-certification), and — since the warm-started IPM — the root LP
+iterates (``HALDAResult.ipm_state``), so each tick's root interior-point
+solves start from the previous tick's points instead of mid-box and
+early-exit after a handful of Mehrotra steps. All three are validity-gated
+on-device; staleness costs iterations, never soundness. ``cold_start=True``
+disables every one of them for A/B measurement.
 """
 
 from __future__ import annotations
@@ -39,11 +48,17 @@ class StreamingReplanner:
         kv_bits: str = "8bit",
         backend: str = "jax",
         moe: Optional[bool] = None,
+        cold_start: bool = False,
     ) -> None:
         self.mip_gap = mip_gap
         self.kv_bits = kv_bits
         self.backend = backend
         self.moe = moe
+        # A/B debugging switch (`solver serve --cold-start`): every tick
+        # solves from scratch — no warm incumbent, no stored duals, no root
+        # IPM iterates, no margin chain. Results must agree with warm ticks
+        # within mip_gap; the wall-clock delta is the warm-start win.
+        self.cold_start = cold_start
         self.last: Optional[HALDAResult] = None
         self.last_mapping = None  # ExpertMapping of the last load-aware tick
         # Observability (see distilp_tpu.sched.metrics): an optional sink
@@ -91,6 +106,8 @@ class StreamingReplanner:
         )
         shape = (len(devs), model.L, use_moe)
         warm = self.last if shape == self._last_shape else None
+        if self.cold_start:
+            warm = None  # A/B mode: no cross-tick state of any kind
 
         loads = None
         if use_moe and model.expert_loads is not None:
@@ -114,7 +131,7 @@ class StreamingReplanner:
             warm=warm,
             load_factors=factors,
             timings=timings,
-            margin_state=self._margin_state,
+            margin_state=None if self.cold_start else self._margin_state,
         )
         result = self._certify_or_fallback(
             result, devs, model, k_candidates, factors, warm, timings
@@ -242,6 +259,8 @@ class StreamingReplanner:
         )
         shape = (len(devs), model.L, use_moe)
         warm = self.last if shape == self._last_shape else None
+        if self.cold_start:
+            warm = None
 
         loads = None
         if use_moe and model.expert_loads is not None:
@@ -265,7 +284,7 @@ class StreamingReplanner:
             moe=self.moe,
             warm=warm,
             load_factors=factors,
-            margin_state=self._margin_state,
+            margin_state=None if self.cold_start else self._margin_state,
         )
         # Snapshot the fleet AND the model: streaming callers mutate both in
         # place between ticks (t_comm drifts, expert_loads refresh), and

@@ -35,7 +35,7 @@ def findings_for(code, relpath, src):
 def test_registry_has_all_rule_codes():
     expected = {
         "DLP001", "DLP002", "DLP010", "DLP011",
-        "DLP012", "DLP013", "DLP014", "DLP015",
+        "DLP012", "DLP013", "DLP014", "DLP015", "DLP016",
     }
     assert expected <= set(RULES)
     for code, rule in RULES.items():
@@ -500,6 +500,93 @@ def test_schema_only_entry_point_needs_no_guard():
 
 
 # --------------------------------------------------------------------------
+# DLP016 — fixed-length scans that factorize need a convergence gate
+
+
+_SCAN_CHOLESKY = """\
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(A, b):
+        def step(state, _):
+            chol = jax.scipy.linalg.cho_factor(A, lower=True)
+            return jax.scipy.linalg.cho_solve(chol, state), None
+
+        out, _ = jax.lax.scan(step, b, None, length=30)
+        return out
+    """
+
+
+def test_fixed_scan_with_cholesky_flagged_in_kernel_layers():
+    out = findings_for("DLP016", "distilp_tpu/ops/newkernel.py", _SCAN_CHOLESKY)
+    assert len(out) == 1 and "cho_factor" in out[0].message
+    assert findings_for(
+        "DLP016", "distilp_tpu/solver/newbackend.py", _SCAN_CHOLESKY
+    )
+
+
+def test_fixed_scan_with_cholesky_ignored_outside_kernel_layers():
+    # The contract covers ops// and solver/ kernels; a profiler helper
+    # doing a tiny fixed factorization loop is not the hot path.
+    out = findings_for("DLP016", "distilp_tpu/profiler/calib.py", _SCAN_CHOLESKY)
+    assert out == []
+
+
+def test_fixed_scan_with_convergence_gate_comment_ok():
+    out = findings_for("DLP016", "distilp_tpu/ops/newkernel.py", """\
+        import jax
+
+        def kernel(A, b, n_chunks):
+            def step(state, _):
+                chol = jax.scipy.linalg.cho_factor(A, lower=True)
+                return jax.scipy.linalg.cho_solve(chol, state), None
+
+            def body(carry):
+                state, ci = carry
+                # convergence gate: the outer while_loop stops this chunked
+                # scan once every batch element is done
+                state, _ = jax.lax.scan(step, state, None, length=4)
+                return state, ci + 1
+
+            return jax.lax.while_loop(lambda c: c[1] < n_chunks, body, (b, 0))
+        """)
+    assert out == []
+
+
+def test_fixed_scan_lambda_body_and_disable():
+    src = """\
+        import jax
+
+        def kernel(A, b):
+            out, _ = jax.lax.scan(
+                lambda s, _: (jax.scipy.linalg.cho_solve(
+                    jax.scipy.linalg.cho_factor(A), s), None),
+                b, None, length=10)
+            return out
+        """
+    assert len(findings_for("DLP016", "distilp_tpu/ops/k.py", src)) == 1
+    suppressed = src.replace(
+        "out, _ = jax.lax.scan(",
+        "out, _ = jax.lax.scan(  # dlint: disable=DLP016\n",
+    )
+    assert findings_for("DLP016", "distilp_tpu/ops/k.py", suppressed) == []
+
+
+def test_fixed_scan_without_cholesky_ok():
+    out = findings_for("DLP016", "distilp_tpu/solver/x.py", """\
+        import jax
+
+        def redistribute(vals, M):
+            def body(state, _):
+                return state + 1, None
+
+            out, _ = jax.lax.scan(body, vals, None, length=M)
+            return out
+        """)
+    assert out == []
+
+
+# --------------------------------------------------------------------------
 # suppressions
 
 
@@ -702,6 +789,10 @@ def test_repo_in_library_violations_stay_fixed():
     from tools.dlint import lint_paths
 
     found = lint_paths(
-        [lib], select=["DLP010", "DLP011", "DLP012", "DLP013", "DLP014", "DLP015"]
+        [lib],
+        select=[
+            "DLP010", "DLP011", "DLP012", "DLP013", "DLP014", "DLP015",
+            "DLP016",
+        ],
     )
     assert found == [], "\n".join(f.render() for f in found)

@@ -8,7 +8,7 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
-from distilp_tpu.ops import LPBatch, ipm_solve_batch  # noqa: E402
+from distilp_tpu.ops import IPMWarmState, LPBatch, ipm_solve_batch  # noqa: E402
 
 
 def _random_feasible_batch(rng, m, n, B, fix_frac=0.2):
@@ -74,6 +74,115 @@ def test_ipm_all_columns_fixed():
     )
     assert np.isfinite(float(res.obj[0]))
     assert float(res.obj[0]) == pytest.approx(float(c[0] @ l[0]))
+
+
+def _warm_from(res, B):
+    return IPMWarmState(
+        v=res.v, y=res.y_dual, z=res.z_dual, f=res.f_dual,
+        ok=jnp.ones(B, bool),
+    )
+
+
+def test_ipm_warm_start_matches_cold_and_early_exits():
+    """(a) A warm-started solve must reach the cold solve's certified
+    objective/bound, and do so in strictly fewer iterations (the whole
+    point of carrying iterates across B&B nodes and streaming ticks)."""
+    rng = np.random.default_rng(11)
+    batch, refs = _random_feasible_batch(rng, m=10, n=25, B=12)
+    cold = ipm_solve_batch(batch, iters=50)
+    assert np.all(np.array(cold.converged))
+    warm = ipm_solve_batch(batch, iters=50, warm=_warm_from(cold, 12))
+    assert np.all(np.array(warm.converged))
+    np.testing.assert_allclose(
+        np.array(warm.obj), np.array(cold.obj), rtol=1e-6, atol=1e-8
+    )
+    # Bound validity is independent of the start point.
+    assert np.all(np.array(warm.bound) <= refs + 1e-8)
+    assert np.array(warm.iters_run).max() < np.array(cold.iters_run).max()
+
+
+def test_ipm_early_exit_stops_before_budget():
+    """The chunked while_loop must stop once the batch converges instead of
+    scanning out the fixed budget (iters_run is the executed count)."""
+    rng = np.random.default_rng(5)
+    batch, _ = _random_feasible_batch(rng, m=8, n=20, B=6)
+    res = ipm_solve_batch(batch, iters=200)
+    assert np.all(np.array(res.converged))
+    assert np.array(res.iters_run).max() < 40  # nowhere near 200
+
+
+def test_ipm_truncated_budget_bound_stays_sound():
+    """(b) An early-exited / truncated solve must still return a rigorous
+    float64 lower bound (bound <= true optimum) — branch-and-bound prunes
+    on it, so this is the soundness half of the warm-start contract."""
+    rng = np.random.default_rng(21)
+    batch, refs = _random_feasible_batch(rng, m=10, n=25, B=12)
+    for iters in (2, 3, 5, 8):
+        res = ipm_solve_batch(batch, iters=iters, chunk=2)
+        b = np.array(res.bound)
+        assert np.all(np.isfinite(b) | np.isneginf(b))
+        assert np.all(b <= refs + 1e-8), f"unsound bound at iters={iters}"
+
+
+def test_ipm_garbage_warm_state_degrades_to_cold():
+    """(c) NaN/inf warm components must fall back to the cold start, and
+    finite-but-absurd warm points must still converge to the cold result —
+    a stale streaming iterate can cost iterations, never correctness."""
+    rng = np.random.default_rng(33)
+    B = 8
+    batch, refs = _random_feasible_batch(rng, m=10, n=25, B=B)
+    cold = ipm_solve_batch(batch, iters=60)
+
+    bad = IPMWarmState(
+        v=jnp.full_like(cold.v, jnp.nan),
+        y=jnp.full_like(cold.y_dual, jnp.inf),
+        z=cold.z_dual,
+        f=cold.f_dual,
+        ok=jnp.ones(B, bool),
+    )
+    res = ipm_solve_batch(batch, iters=60, warm=bad)
+    np.testing.assert_allclose(
+        np.array(res.obj), np.array(cold.obj), rtol=1e-7, atol=1e-8
+    )
+
+    absurd = IPMWarmState(
+        v=1e6 * jnp.ones_like(cold.v),
+        y=-1e5 * jnp.ones_like(cold.y_dual),
+        z=1e9 * jnp.ones_like(cold.z_dual),
+        f=1e-12 * jnp.ones_like(cold.f_dual),
+        ok=jnp.ones(B, bool),
+    )
+    res2 = ipm_solve_batch(batch, iters=60, warm=absurd)
+    assert np.all(np.array(res2.converged))
+    np.testing.assert_allclose(
+        np.array(res2.obj), np.array(cold.obj), rtol=1e-6, atol=1e-7
+    )
+    assert np.all(np.array(res2.bound) <= refs + 1e-8)
+
+    # ok=False must behave exactly like no warm state at all.
+    off = IPMWarmState(
+        v=absurd.v, y=absurd.y, z=absurd.z, f=absurd.f,
+        ok=jnp.zeros(B, bool),
+    )
+    res3 = ipm_solve_batch(batch, iters=60, warm=off)
+    np.testing.assert_allclose(
+        np.array(res3.obj), np.array(cold.obj), rtol=1e-9, atol=1e-10
+    )
+
+
+def test_ipm_skip_mask_freezes_elements():
+    """Skipped elements execute zero iterations and never gate the batch
+    early exit (inactive frontier rows ride this)."""
+    rng = np.random.default_rng(44)
+    B = 6
+    batch, _ = _random_feasible_batch(rng, m=8, n=18, B=B)
+    sk = jnp.zeros(B, bool).at[2].set(True)
+    res = ipm_solve_batch(batch, iters=50, skip=sk)
+    runs = np.array(res.iters_run)
+    assert runs[2] == 0
+    live = np.delete(np.arange(B), 2)
+    assert np.all(runs[live] > 0)
+    assert np.all(np.array(res.converged)[live])
 
 
 def test_ipm_infeasible_bound_grows():

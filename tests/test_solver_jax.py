@@ -149,12 +149,17 @@ def test_timings_breakdown_populated(profiles_dir):
     )
     assert result.certified
     assert set(tm) == {
-        "build_ms", "pack_ms", "upload_ms", "solve_ms", "static_hit"
+        "build_ms", "pack_ms", "upload_ms", "solve_ms", "static_hit",
+        "ipm_iters_executed", "bnb_rounds",
     }
     assert all(v >= 0 for v in tm.values())
     assert tm["build_ms"] > 0
     assert tm["solve_ms"] > 0
     assert tm["static_hit"] in (0.0, 1.0)
+    # The device program's execution counters: a certified solve ran at
+    # least one round and spent at least one IPM iteration on it.
+    assert tm["bnb_rounds"] >= 1
+    assert tm["ipm_iters_executed"] >= 1
 
 
 def test_static_cache_survives_t_comm_drift(profiles_dir):

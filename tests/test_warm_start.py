@@ -1,0 +1,137 @@
+"""Warm-started solve equivalence: the iterate-carrying fast paths must
+reach the cold solve's certified answer.
+
+The PR's perf contract: warm starts (incumbent seed, Lagrangian duals, root
+IPM iterates) and the truncated warm-round IPM budget may only change how
+FAST the certificate closes, never what it certifies. These tests pin that
+on the 16-device north-star fixture and the MoE family fixtures.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jax.config.update("jax_enable_x64", True)
+
+from distilp_tpu.common import load_model_profile  # noqa: E402
+from distilp_tpu.solver import halda_solve  # noqa: E402
+from distilp_tpu.solver.streaming import StreamingReplanner  # noqa: E402
+from distilp_tpu.utils import make_synthetic_fleet  # noqa: E402
+
+GAP = 1e-3
+
+
+def _north_star(profiles_dir):
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    return make_synthetic_fleet(16, seed=123), model
+
+
+def test_warm_equals_cold_on_north_star(profiles_dir):
+    """Acceptance: warm and cold solves agree within mip_gap on the
+    16-device north-star fixture, and the warm solve demonstrably reuses
+    the iterates (fewer executed IPM iterations)."""
+    devs, model = _north_star(profiles_dir)
+    tm_cold: dict = {}
+    cold = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax",
+        timings=tm_cold,
+    )
+    assert cold.certified
+    assert cold.ipm_state is not None
+    assert np.asarray(cold.ipm_state["ok"]).any()
+
+    tm_warm: dict = {}
+    warm = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax", warm=cold,
+        timings=tm_warm,
+    )
+    assert warm.certified
+    assert abs(warm.obj_value - cold.obj_value) <= GAP * abs(cold.obj_value)
+    assert warm.k == cold.k
+    assert tm_warm["ipm_iters_executed"] <= tm_cold["ipm_iters_executed"]
+
+
+def test_warm_equals_cold_under_drift(profiles_dir):
+    """Streaming regime: drifted coefficients, warm seed from the previous
+    tick. The warm result must match a from-scratch cold solve of the SAME
+    drifted instance within the certificate window."""
+    devs, model = _north_star(profiles_dir)
+    prev = halda_solve(devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax")
+    rng = np.random.default_rng(7)
+    for d in devs:
+        d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.9, 1.1)))
+    warm = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax", warm=prev
+    )
+    cold = halda_solve(devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax")
+    assert warm.certified and cold.certified
+    assert abs(warm.obj_value - cold.obj_value) <= GAP * abs(cold.obj_value)
+
+
+def test_warm_iters_knob_plumbed(profiles_dir):
+    """ipm_warm_iters reaches the device program: a full-budget override
+    must still certify and agree; an equal-budget override disables the
+    truncation without changing the answer."""
+    devs, model = _north_star(profiles_dir)
+    devs = devs[:6]
+    base = halda_solve(devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax")
+    full = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax",
+        ipm_iters=8, ipm_warm_iters=8,
+    )
+    assert base.certified and full.certified
+    assert abs(full.obj_value - base.obj_value) <= GAP * abs(base.obj_value)
+
+
+@pytest.mark.parametrize("cfg", ["qwen15_moe_a27b", "mixtral_8x7b"])
+def test_warm_equals_cold_on_moe_families(cfg):
+    """Acceptance: MoE family fixtures — warm ticks (incumbent + duals +
+    root iterates riding the streaming replanner) certify the same optimum
+    as a cold solve of the drifted instance."""
+    from distilp_tpu.profiler.api import profile_model
+
+    model = profile_model(
+        f"tests/configs/{cfg}.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+    devs = make_synthetic_fleet(4, seed=3, pool_bytes=int(48e9))
+
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
+    planner.step(devs, model)  # cold + compile
+    rng = np.random.default_rng(17)
+    for d in devs:
+        d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.95, 1.05)))
+    warm = planner.step(devs, model)
+    assert warm.certified
+    assert planner.last_tick_mode in ("warm", "margin")
+
+    cold = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="8bit", backend="jax"
+    )
+    assert cold.certified
+    assert abs(warm.obj_value - cold.obj_value) <= GAP * abs(cold.obj_value)
+    if model.n_routed_experts:
+        assert sum(warm.y) == model.n_routed_experts
+
+
+def test_cold_start_flag_disables_reuse_but_matches(profiles_dir):
+    """`--cold-start` A/B mode: every tick reports mode='cold' and still
+    lands on the warm run's objective within the certificate."""
+    devs, model = _north_star(profiles_dir)
+    devs = devs[:6]
+    warm_p = StreamingReplanner(mip_gap=GAP, kv_bits="4bit", backend="jax")
+    cold_p = StreamingReplanner(
+        mip_gap=GAP, kv_bits="4bit", backend="jax", cold_start=True
+    )
+    warm_p.step(devs, model)
+    cold_p.step(devs, model)
+    rng = np.random.default_rng(9)
+    for d in devs:
+        d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.95, 1.05)))
+    w = warm_p.step(devs, model)
+    c = cold_p.step(devs, model)
+    assert warm_p.last_tick_mode in ("warm", "margin")
+    assert cold_p.last_tick_mode == "cold"
+    assert c.certified and w.certified
+    assert abs(w.obj_value - c.obj_value) <= GAP * abs(c.obj_value)

@@ -536,6 +536,83 @@ class LegacyNumpyRandom(Rule):
 
 
 @register
+class FixedScanCholeskyNeedsGate(Rule):
+    code = "DLP016"
+    name = "fixed-scan-cholesky"
+    rationale = (
+        "A fixed-`length=` lax.scan whose body factorizes (cho_factor) pays "
+        "one Cholesky per step for the WHOLE budget, converged or not — the "
+        "pay-for-converged-work pattern the warm-started IPM rewrite "
+        "removed (ops/ipm.py: the budget is spent in chunks under a "
+        "while_loop whose exit test is batch-wide convergence). New kernels "
+        "in ops//solver/ must either gate the scan the same way or justify "
+        "the fixed length with a nearby 'convergence' comment "
+        "(or `# dlint: disable=DLP016`)."
+    )
+
+    _PATH_PREFIXES = ("distilp_tpu/ops/", "distilp_tpu/solver/")
+    _GATE_WORD = "convergence"
+    # A justification comment counts when it sits on the scan call's line
+    # or within this many lines above it (the idiom: a short gate comment
+    # directly over the call, see ops/ipm.py's chunk body).
+    _COMMENT_WINDOW = 3
+
+    def _contains_cho_factor(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                if dotted_name(sub.func).split(".")[-1] == "cho_factor":
+                    return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not any(ctx.relpath.startswith(p) for p in self._PATH_PREFIXES):
+            return
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        comments = ctx.comments()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            if fn.split(".")[-1] != "scan" or "lax" not in fn:
+                continue
+            if not any(kw.arg == "length" for kw in node.keywords):
+                continue
+            body_arg = node.args[0] if node.args else None
+            if isinstance(body_arg, ast.Lambda):
+                has_chol = self._contains_cho_factor(body_arg)
+            elif isinstance(body_arg, ast.Name):
+                has_chol = any(
+                    self._contains_cho_factor(d)
+                    for d in defs.get(body_arg.id, [])
+                )
+            else:
+                has_chol = False
+            if not has_chol:
+                continue
+            gated = any(
+                self._GATE_WORD in comments.get(ln, "").lower()
+                for ln in range(
+                    node.lineno - self._COMMENT_WINDOW, node.lineno + 1
+                )
+            )
+            if gated:
+                continue
+            yield Finding(
+                ctx.relpath,
+                node.lineno,
+                self.code,
+                "fixed-length lax.scan whose body calls cho_factor runs the "
+                "full factorization budget even after convergence; bound it "
+                "with a convergence-gated while_loop (see ops/ipm.py) or "
+                "justify the fixed length with a nearby 'convergence' "
+                "comment",
+            )
+
+
+@register
 class UnguardedBackendEntryPoint(Rule):
     code = "DLP015"
     name = "unguarded-entry-point"
