@@ -18,7 +18,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict
+test: lint-strict smoke-twin
 	python -m pytest tests/ -q
 
 .PHONY: bench
@@ -39,6 +39,31 @@ AGAINST ?= BENCH_r05.json
 .PHONY: bench-compare
 bench-compare:
 	python bench.py --against $(AGAINST)
+
+# Digital-twin smoke: a seeded 256-sample Monte-Carlo robustness report on
+# a bundled golden fixture, on the CPU platform. --check-determinism runs
+# the vmapped report twice with the same seed and fails on any difference;
+# --json output is piped through a schema re-validation, and the command's
+# own exit gate asserts the twin's unperturbed latency matches the HALDA
+# objective (the conformance cross-check). Chained into `make test`.
+# && chain to a per-invocation temp file, NOT a pipeline: /bin/sh has no
+# pipefail, and the evaluate CLI prints its JSON before the cross-check
+# exit gate — piped, a failing gate would be masked by the downstream
+# validator's success; a fixed path would race concurrent runs.
+.PHONY: smoke-twin
+smoke-twin: lint-strict
+	@T=$$(mktemp) && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli evaluate \
+		--profile tests/profiles/llama_3_70b/online \
+		--samples 256 --seed 7 --dropout-p 0.05 \
+		--check-determinism --json > $$T && \
+	JAX_PLATFORMS=cpu python -c "import json; \
+		from distilp_tpu.twin import RobustnessReport, TwinEvaluation; \
+		d=json.load(open('$$T')); \
+		TwinEvaluation.model_validate(d['evaluation']); \
+		RobustnessReport.model_validate(d['robustness']); \
+		print('smoke-twin OK: report schema + determinism + objective cross-check')"; \
+	rc=$$?; rm -f $$T; exit $$rc
 
 # Scheduler-service smoke: replay the bundled 20-event churn trace through
 # the daemon on the CPU platform (no slow tests, no accelerator needed);

@@ -208,6 +208,7 @@ _COMPARE_LOWER_BETTER = (
 _COMPARE_HIGHER_BETTER = (
     "vs_baseline", "placements_per_sec", "pipelined_placements_per_sec",
     "scenario_batch_placements_per_sec", "scheduler_events_per_sec",
+    "twin_mc_evals_per_sec", "twin_rank_agreement",
 )
 
 
@@ -530,6 +531,17 @@ def main(against: str | None = None) -> int:
     except Exception as e:  # pragma: no cover - defensive bench path
         payload["scheduler_error"] = f"{type(e).__name__}: {e}"
 
+    # Digital twin (distilp_tpu.twin): Monte-Carlo throughput of the
+    # vmapped robustness report (1024 perturbed what-if executions per
+    # dispatch) and the objective-vs-twin rank agreement over the
+    # solver-enumerated k-candidates — the proxy-validation gauge. Rides
+    # the `--against` compare like every other section; a failure costs
+    # only these keys.
+    try:
+        payload.update(_twin_bench(model, devs))
+    except Exception as e:  # pragma: no cover - defensive bench path
+        payload["twin_error"] = f"{type(e).__name__}: {e}"
+
     print(json.dumps(payload))
     if against:
         return _compare_against(payload, against)
@@ -572,6 +584,34 @@ def _scheduler_bench(model, base_devs) -> dict:
         "scheduler_pool_hit_rate": round(sched.metrics.pool_hit_rate(), 3),
         "scheduler_structural_uncertified": report.structural_uncertified,
         "scheduler_failed_ticks": report.failed_ticks,
+    }
+
+
+def _twin_bench(model, base_devs) -> dict:
+    """twin_* section: MC evals/sec + objective-vs-twin rank agreement."""
+    from distilp_tpu.solver import halda_solve_per_k
+    from distilp_tpu.twin import rank_agreement, robustness_report
+
+    devs = [d.model_copy(deep=True) for d in base_devs]
+    per_k = halda_solve_per_k(devs, model, mip_gap=MIP_GAP, kv_bits="4bit")
+    ra = rank_agreement(devs, model, per_k, kv_bits="4bit")
+    best = min(per_k, key=lambda r: r.obj_value)
+    samples = 1024
+    mc = dict(samples=samples, seed=0, kv_bits="4bit", dropout_p=0.05)
+    robustness_report(devs, model, best, **mc)  # compile the kernel
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        robustness_report(devs, model, best, **mc)
+        times.append((time.perf_counter() - t0) * 1e3)
+    ms = statistics.median(times)
+    return {
+        "twin_mc_samples": samples,
+        "twin_mc_ms": round(ms, 3),
+        "twin_mc_evals_per_sec": round(samples * 1000.0 / ms, 1),
+        "twin_rank_agreement": round(ra["spearman"], 4),
+        "twin_rank_inversions": ra["pairwise_inversions"],
+        "twin_k_candidates": len(per_k),
     }
 
 

@@ -72,7 +72,13 @@ def force_cpu_if_env_requested() -> bool:
     import os
 
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        return force_cpu_platform()
+        try:
+            return force_cpu_platform()
+        except ImportError:
+            # Backend-less install (schema/CPU-only extras): there is no
+            # jax to wedge, and the pure-HiGHS solve paths that call this
+            # guard unconditionally must keep working without one.
+            return False
     return False
 
 

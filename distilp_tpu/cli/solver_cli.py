@@ -159,6 +159,25 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "the reuse win",
     )
     p.add_argument(
+        "--risk-aware",
+        action="store_true",
+        help="risk-aware serving: every tick scores the fresh solve, the "
+        "warm pool's cached incumbents and the solver-enumerated per-k "
+        "optima on the digital twin (seeded Monte-Carlo p95 + feasibility-"
+        "violation penalty; see distilp_tpu.twin) and serves the lowest-"
+        "risk candidate instead of the freshest placement",
+    )
+    p.add_argument(
+        "--risk-samples",
+        type=int,
+        default=256,
+        help="Monte-Carlo samples per risk-aware candidate score",
+    )
+    p.add_argument(
+        "--risk-seed", type=int, default=0,
+        help="PRNG seed of the risk-aware perturbation draws",
+    )
+    p.add_argument(
         "--fail-uncertified",
         action="store_true",
         help="exit 1 if any structural event's placement misses its "
@@ -171,6 +190,207 @@ def build_serve_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--quiet", action="store_true", help="summary line only")
     return p
+
+
+def build_evaluate_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solver evaluate",
+        description="digital-twin evaluation of a placement: deterministic "
+        "simulated execution + seeded Monte-Carlo robustness report "
+        "(latency quantiles under device drift, feasibility-violation "
+        "probability, worst-device sensitivity ranking; see "
+        "distilp_tpu.twin)",
+    )
+    p.add_argument(
+        "--profile",
+        "-p",
+        required=True,
+        help="folder containing model_profile.json and per-device JSONs",
+    )
+    p.add_argument(
+        "--solution",
+        default=None,
+        help="placement JSON previously written by --save-solution; "
+        "default: solve first (same backend/knob semantics as the solver)",
+    )
+    p.add_argument("--backend", choices=["cpu", "jax"], default="jax")
+    p.add_argument("--mip-gap", type=float, default=1e-3)
+    p.add_argument("--kv-bits", default="4bit")
+    p.add_argument(
+        "--k-candidates",
+        default=None,
+        help="comma-separated k values (used when solving; default: all "
+        "proper factors of L)",
+    )
+    p.add_argument(
+        "--moe",
+        choices=["auto", "on", "off"],
+        default="auto",
+        help="expert+layer co-assignment mode the placement was solved with",
+    )
+    p.add_argument("--samples", type=int, default=1024, help="Monte-Carlo draws")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--sigma-compute", type=float, default=0.08)
+    p.add_argument("--sigma-comm", type=float, default=0.15)
+    p.add_argument("--sigma-disk", type=float, default=0.10)
+    p.add_argument(
+        "--sigma-mem", type=float, default=0.0,
+        help="memory-headroom jitter; >0 makes the feasibility-violation "
+        "probability a real tail statistic instead of a 0/1 flag",
+    )
+    p.add_argument(
+        "--dropout-p", type=float, default=0.0,
+        help="per-device straggler probability per sample (device runs "
+        "--dropout-slowdown x slower)",
+    )
+    p.add_argument("--dropout-slowdown", type=float, default=8.0)
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the two reports as one JSON object instead of text",
+    )
+    p.add_argument(
+        "--check-determinism",
+        action="store_true",
+        help="run the Monte-Carlo report twice with the same seed and fail "
+        "unless the reports are identical (the smoke gate's assertion)",
+    )
+    return p
+
+
+def evaluate_main(argv=None) -> int:
+    """``solver evaluate``: render the digital-twin report for a placement."""
+    args = build_evaluate_parser().parse_args(argv)
+
+    from ..axon_guard import force_cpu_if_env_requested
+
+    force_cpu_if_env_requested()
+
+    from ..common import load_from_profile_folder
+
+    folder = Path(args.profile)
+    if not folder.is_dir():
+        print(f"error: {folder} is not a directory", file=sys.stderr)
+        return 2
+    if args.samples < 1:
+        print(
+            f"error: --samples must be >= 1 (got {args.samples})",
+            file=sys.stderr,
+        )
+        return 2
+    devices, model = load_from_profile_folder(folder)
+
+    k_candidates = None
+    if args.k_candidates:
+        k_candidates = [int(x) for x in args.k_candidates.split(",") if x.strip()]
+    moe = {"auto": None, "on": True, "off": False}[args.moe]
+
+    from ..solver import HALDAResult, halda_solve
+
+    if args.solution:
+        try:
+            result = HALDAResult.model_validate(
+                json.loads(Path(args.solution).read_text())
+            )
+        except (OSError, TypeError, ValueError) as e:
+            print(f"error: cannot load --solution: {e}", file=sys.stderr)
+            return 2
+        # Full structural validation against THIS fleet+model — the same
+        # gate the risk-aware scheduler runs on cached candidates. Without
+        # it a solution saved against a different model/fleet would either
+        # crash mid-report or be confidently mispriced.
+        from ..twin import build_twin_arrays, placement_applicable
+
+        try:
+            arrays = build_twin_arrays(
+                devices, model, kv_bits=args.kv_bits, moe=moe
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if not placement_applicable(
+            arrays, result.w, result.n, y=result.y, k=result.k
+        ):
+            print(
+                "error: the saved solution cannot execute on this profile "
+                f"folder (devices={len(devices)}, L={model.L}, "
+                f"moe={'on' if arrays.moe else 'off'}): check device "
+                "count, window sums, offload counts and expert cover — "
+                "was it solved for a different fleet/model, or with a "
+                "different --moe mode?",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        try:
+            result = halda_solve(
+                devices,
+                model,
+                k_candidates=k_candidates,
+                mip_gap=args.mip_gap,
+                kv_bits=args.kv_bits,
+                backend=args.backend,
+                moe=moe,
+            )
+        except (ValueError, RuntimeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    from ..twin import evaluate_placement, robustness_report
+
+    evaluation = evaluate_placement(
+        devices, model, result, kv_bits=args.kv_bits, moe=moe
+    )
+    mc_kwargs = dict(
+        samples=args.samples,
+        seed=args.seed,
+        kv_bits=args.kv_bits,
+        moe=moe,
+        sigma_compute=args.sigma_compute,
+        sigma_comm=args.sigma_comm,
+        sigma_disk=args.sigma_disk,
+        sigma_mem=args.sigma_mem,
+        dropout_p=args.dropout_p,
+        dropout_slowdown=args.dropout_slowdown,
+    )
+    report = robustness_report(devices, model, result, **mc_kwargs)
+    if args.check_determinism:
+        report2 = robustness_report(devices, model, result, **mc_kwargs)
+        if report.model_dump() != report2.model_dump():
+            print(
+                "error: Monte-Carlo report is not deterministic for a "
+                "fixed seed",
+                file=sys.stderr,
+            )
+            return 1
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "evaluation": evaluation.model_dump(),
+                    "robustness": report.model_dump(),
+                }
+            )
+        )
+    else:
+        print(evaluation.render_text())
+        print()
+        print(report.render_text())
+
+    # The conformance contract: the twin's unperturbed execution must agree
+    # with the objective the placement was priced at. A reloaded solution
+    # evaluated under drifted profiles will legitimately disagree — the
+    # exit code only gates when we solved in-process above.
+    if args.solution is None and evaluation.rel_err is not None:
+        if evaluation.rel_err > 1e-6:
+            print(
+                f"error: twin latency {evaluation.latency_s:.9f} disagrees "
+                f"with the solver objective {evaluation.objective_s:.9f} "
+                f"(rel err {evaluation.rel_err:.3e})",
+                file=sys.stderr,
+            )
+            return 1
+    return 0
 
 
 def serve_main(argv=None) -> int:
@@ -221,6 +441,9 @@ def serve_main(argv=None) -> int:
         k_candidates=k_candidates,
         warm_pool_size=args.warm_pool,
         cold_start=args.cold_start,
+        risk_aware=args.risk_aware,
+        risk_samples=args.risk_samples,
+        risk_seed=args.risk_seed,
     )
 
     def log_event(ev, view, ms):
@@ -228,11 +451,15 @@ def serve_main(argv=None) -> int:
         if args.quiet:
             return
         r = view.result
+        risk = ""
+        if view.twin_p95_s is not None:
+            star = "*" if view.risk_selected else ""
+            risk = f" twin_p95={view.twin_p95_s:.4f}{star}"
         print(
             f"[{sched.fleet.seq:4d}] {ev.kind:<10s} "
             f"M={len(r.w):2d} mode={view.mode:<6s} "
             f"certified={str(r.certified):<5s} k={r.k:<3d} "
-            f"obj={r.obj_value:.6f} {ms:8.1f} ms"
+            f"obj={r.obj_value:.6f} {ms:8.1f} ms{risk}"
         )
 
     try:
@@ -246,6 +473,14 @@ def serve_main(argv=None) -> int:
         "drift_warm_share": round(drift_warm_share(sched.metrics), 4),
         "metrics": sched.metrics_snapshot(),
     }
+    if args.risk_aware:
+        c = sched.metrics.counters
+        summary["risk"] = {
+            "evals": c["risk_eval"],
+            "candidates": c["risk_candidates"],
+            "switches": c["risk_switch"],
+            "errors": c["risk_error"],
+        }
     print(json.dumps(summary))
     if args.metrics_out:
         Path(args.metrics_out).write_text(json.dumps(summary, indent=2))
@@ -269,6 +504,8 @@ def main(argv=None) -> int:
         # Subcommand dispatch; the bare flag form stays the one-shot solver
         # (reference-CLI compatible), so existing invocations are untouched.
         return serve_main(argv[1:])
+    if argv and argv[0] == "evaluate":
+        return evaluate_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     from ..axon_guard import force_cpu_if_env_requested

@@ -32,6 +32,24 @@ from .fleet import FleetState
 from .metrics import SchedulerMetrics
 
 
+# Serving-side perturbation model for risk-aware candidate scoring: modest
+# symmetric jitter on compute/link/disk plus a straggler scenario (each
+# device has a 5% chance per draw of running 8x slower — the GC-pause /
+# thermal-throttle / contended-host class of event on consumer swarms).
+# The straggler channel is what separates candidates: a deeper pipeline
+# multiplies the straggled bottleneck cycle (k-1) times, so the twin's p95
+# regularly prefers a shallower runner-up k the mean-objective ranking
+# puts second; pure symmetric jitter rarely reorders close candidates.
+DEFAULT_RISK_MC = {
+    "sigma_compute": 0.10,
+    "sigma_comm": 0.15,
+    "sigma_disk": 0.10,
+    "sigma_mem": 0.0,
+    "dropout_p": 0.05,
+    "dropout_slowdown": 8.0,
+}
+
+
 class PlacementView(NamedTuple):
     """One served placement + how stale it is relative to the event stream."""
 
@@ -40,8 +58,18 @@ class PlacementView(NamedTuple):
     fleet_seq: int  # fleet seq at read time
     events_behind: int  # fleet_seq - seq (0 = fresh)
     age_s: float  # wall-clock seconds since publication
-    mode: str  # 'cold' | 'warm' | 'margin' tick that produced it
-    key: Tuple[str, str]  # (fleet_digest, model_digest) it was solved under
+    # 'cold' | 'warm' | 'margin' tick that produced it; 'risk' when the
+    # risk-aware selector served a candidate OTHER than that tick's fresh
+    # solve (a cached incumbent or per-k alternative).
+    mode: str
+    # Problem identity at publication time. For mode == 'risk' the served
+    # placement may have been SOLVED under an earlier identity/tick — the
+    # twin re-priced it against this one before serving.
+    key: Tuple[str, str]
+    # Risk-aware mode only: the served placement's twin p95 latency and
+    # whether the twin preferred a candidate over the fresh solve.
+    twin_p95_s: Optional[float] = None
+    risk_selected: bool = False
 
 
 class WarmPool:
@@ -88,6 +116,11 @@ class WarmPool:
             self._metrics.inc("pool_hit" if hit else "pool_miss")
         return planner, hit
 
+    def items(self):
+        """(key, replanner) pairs, LRU order — the risk-aware candidate scan
+        reads cached incumbents without touching recency or hit counters."""
+        return list(self._pool.items())
+
 
 class Scheduler:
     """Event-driven replanning daemon over one fleet + model.
@@ -114,6 +147,10 @@ class Scheduler:
         solve_on_init: bool = False,
         metrics: Optional[SchedulerMetrics] = None,
         cold_start: bool = False,
+        risk_aware: bool = False,
+        risk_samples: int = 256,
+        risk_seed: int = 0,
+        risk_mc: Optional[dict] = None,
     ):
         self.fleet = FleetState(list(devices), model)
         self.mip_gap = mip_gap
@@ -124,6 +161,28 @@ class Scheduler:
         # events, but every tick solves from scratch — the baseline against
         # which warm/margin/iterate reuse is measured.
         self.cold_start = cold_start
+        # Risk-aware serving (`serve --risk-aware`): every tick scores the
+        # fresh solve AND the warm pool's cached incumbents on the digital
+        # twin (Monte-Carlo p95 + feasibility-violation penalty, seeded so
+        # replays are deterministic) and publishes the lowest-risk
+        # candidate — instead of serving the freshest placement on
+        # staleness alone. Solver warm state is untouched: risk selection
+        # changes what is SERVED, never what seeds the next solve.
+        self.risk_aware = risk_aware
+        self.risk_samples = risk_samples
+        self.risk_seed = risk_seed
+        # Perturbation-model overrides forwarded to the twin (sigma_*,
+        # dropout_p, dropout_slowdown, degrade). The serving default leans
+        # on the straggler channel: DEFAULT_RISK_MC's dropout scenario is
+        # what separates placements that concentrate layers from ones that
+        # spread them — symmetric small jitter alone rarely reorders.
+        self.risk_mc = dict(DEFAULT_RISK_MC if risk_mc is None else risk_mc)
+        # Per-k candidate cache: the enumeration is a COLD per-k sweep, so
+        # drift ticks reuse the placements enumerated at the current
+        # problem identity (the twin re-prices them against the live
+        # profiles anyway); only an identity change re-enumerates.
+        self._risk_per_k: list = []
+        self._risk_per_k_key: Optional[tuple] = None
         self.k_candidates = list(k_candidates) if k_candidates else None
         self.metrics = metrics if metrics is not None else SchedulerMetrics()
         self.pool = WarmPool(
@@ -210,17 +269,150 @@ class Scheduler:
             )
         if structural and not result.certified:
             self.metrics.inc("structural_uncertified")
+        served, twin_p95, switched = result, None, False
+        if self.risk_aware:
+            served, twin_p95, switched = self._risk_select(devs, result, planner)
         self._published = PlacementView(
-            result=result,
+            result=served,
             seq=self.fleet.seq,
             fleet_seq=self.fleet.seq,
             events_behind=0,
             age_s=0.0,
-            mode=mode,
+            # A switched tick serves a placement this tick did NOT produce;
+            # 'risk' keeps the mode field honest (see PlacementView).
+            mode="risk" if switched else mode,
             key=key,
+            twin_p95_s=twin_p95,
+            risk_selected=switched,
         )
         self._published_at = time.monotonic()
         return self._published
+
+    def _risk_select(self, devs, fresh: HALDAResult, planner):
+        """Score the fresh solve + cached pool incumbents on the twin.
+
+        Candidates are every pooled replanner's last placement that is
+        structurally executable on the CURRENT fleet (right device count,
+        window sums, offload/expert cover — ``twin.placement_applicable``);
+        each is priced by Monte-Carlo p95 plus a feasibility-violation
+        penalty under one seeded perturbation model, so the comparison is
+        paired (same draws) and deterministic per tick. Load-aware MoE
+        ticks score at the replanner's realized per-device load factors —
+        the same prices the fresh solve's y-units were solved at. Returns
+        ``(served, twin_p95_s, switched)``; any twin failure falls back to
+        the fresh placement (serving must never break on scoring).
+        """
+        try:
+            from ..twin import (
+                applicable_candidates,
+                build_twin_arrays,
+                twin_p95_score,
+            )
+
+            factors = getattr(planner, "_load_factors", None)
+            if factors is not None and len(factors) != len(devs):
+                factors = None
+            arrays = build_twin_arrays(
+                devs, self.fleet.model, kv_bits=self.kv_bits, moe=self.moe,
+                load_factors=factors,
+            )
+            seen = {self._placement_key(fresh)}
+            candidates = [fresh]
+            for cached in self._risk_candidates(devs, factors):
+                pk = self._placement_key(cached)
+                if pk in seen:
+                    continue
+                seen.add(pk)
+                candidates.append(cached)
+            candidates = applicable_candidates(arrays, candidates)
+            if fresh not in candidates:  # paranoia: fresh must stay eligible
+                candidates.insert(0, fresh)
+            self.metrics.inc("risk_eval")
+            self.metrics.inc("risk_candidates", len(candidates))
+            scores = [
+                twin_p95_score(
+                    devs,
+                    self.fleet.model,
+                    c,
+                    samples=self.risk_samples,
+                    seed=self.risk_seed,
+                    kv_bits=self.kv_bits,
+                    moe=self.moe,
+                    arrays=arrays,
+                    **self.risk_mc,
+                )
+                for c in candidates
+            ]
+            best = min(range(len(candidates)), key=lambda i: scores[i]["score"])
+            served = candidates[best]
+            switched = served is not fresh
+            if switched:
+                self.metrics.inc("risk_switch")
+            self.metrics.observe("twin_p95", scores[best]["p95_s"] * 1e3)
+            return served, scores[best]["p95_s"], switched
+        except Exception as e:  # scoring is advisory; serving must survive
+            self.metrics.inc("risk_error")
+            self._last_error = f"risk_select {type(e).__name__}: {e}"
+            return fresh, None, False
+
+    def _risk_candidates(self, devs, load_factors=None):
+        """Alternative placements worth scoring against the fresh solve.
+
+        Two sources: (1) every pooled replanner's cached incumbent — a
+        fleet identity seen before keeps its placement alive in the warm
+        pool, and risk scoring is what justifies serving it over the
+        fresh one; (2) the solver-enumerated k-candidates of the current
+        problem identity (``halda_solve_per_k``): the objective ranks
+        pipeline depths within mip-gap-scale margins, but their risk
+        profiles differ structurally (deeper pipelines ride the bottleneck
+        cycle (k-1) times; shallower ones concentrate layers), so the twin
+        regularly prefers a runner-up k. The per-k sweep is COLD and
+        therefore cached per identity: drift ticks reuse the enumeration
+        (the twin re-prices every candidate against the live profiles;
+        ``placement_applicable`` drops any that stop fitting), only a
+        structural identity change re-enumerates. jax-backend only and
+        best-effort: a failure costs candidates, never the tick.
+        """
+        out = []
+        for _, planner in self.pool.items():
+            if planner.last is not None:
+                out.append(planner.last)
+        if self.backend != "jax":
+            return out
+        # Cache key is the drift-invariant problem identity: load factors
+        # may drift between ticks, but stale per-k placements stay valid
+        # CANDIDATES (the twin re-prices them at the live factors).
+        key = self.fleet.key()
+        if key != self._risk_per_k_key:
+            from ..solver import halda_solve_per_k
+
+            try:
+                self._risk_per_k = halda_solve_per_k(
+                    devs,
+                    self.fleet.model,
+                    k_candidates=self.k_candidates,
+                    mip_gap=self.mip_gap,
+                    kv_bits=self.kv_bits,
+                    moe=self.moe,
+                    load_factors=load_factors,
+                )
+                self._risk_per_k_key = key
+            except (RuntimeError, ValueError, NotImplementedError):
+                self.metrics.inc("risk_per_k_failed")
+                self._risk_per_k = []
+                self._risk_per_k_key = None
+        out.extend(self._risk_per_k)
+        return out
+
+    @staticmethod
+    def _placement_key(result: HALDAResult) -> tuple:
+        """Assignment identity for candidate dedup (pool keys alias)."""
+        return (
+            result.k,
+            tuple(result.w),
+            tuple(result.n),
+            tuple(result.y) if result.y is not None else None,
+        )
 
     # -- the read side -----------------------------------------------------
 

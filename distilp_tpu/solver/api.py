@@ -14,11 +14,12 @@ from __future__ import annotations
 
 from typing import Iterable, List, Optional, Sequence, Tuple
 
+from ..axon_guard import force_cpu_if_env_requested
 from ..common import DeviceProfile, ModelProfile, kv_bits_to_factor
 from .assemble import assemble
 from .backend_cpu import Infeasible, solve_fixed_k_cpu
 from .coeffs import assign_sets, build_coeffs, valid_factors_of_L
-from .moe import adjust_model, build_moe_arrays, model_has_moe_components
+from .moe import adjust_model, build_moe_arrays, resolve_moe
 from .result import HALDAResult, ILPResult
 
 Backend = str  # 'cpu' | 'jax'
@@ -64,12 +65,13 @@ def _build_instance(
 ):
     """Shared validation + instance assembly of the sync and async paths:
     (Ks, sets, coeffs, arrays). Any change here reaches both."""
-    use_moe = model_has_moe_components(model) if moe is None else bool(moe)
-    if use_moe and not model_has_moe_components(model):
-        raise ValueError(
-            "moe=True requires a profile with MoE component metrics "
-            "(bytes_per_expert, flops_per_active_expert_per_token, ...)"
-        )
+    # Arm the axon-wedge guard on the LIBRARY path: every halda_solve*
+    # variant funnels through here before its first backend contact, so a
+    # plain `JAX_PLATFORMS=cpu halda_solve(backend='jax')` user gets the
+    # same protection as the CLI entry points instead of wedging on a dead
+    # tunneled-TPU plugin (VERDICT round-5 finding 2; see axon_guard).
+    force_cpu_if_env_requested()
+    use_moe = resolve_moe(model, moe)
     if use_moe and batch_size != 1:
         raise ValueError(
             "batch_size pricing is dense-only: the MoE expert busy model "

@@ -125,6 +125,21 @@ def model_has_moe_components(model: ModelProfile) -> bool:
     )
 
 
+def resolve_moe(model: ModelProfile, moe) -> bool:
+    """The ONE moe-mode resolution rule: ``None`` auto-detects from the
+    profile's component metrics, ``True`` requires them, ``False`` forces
+    dense. Shared by the solver instance builder and the twin so a
+    placement is always evaluated under the same interpretation it was
+    solved with."""
+    use_moe = model_has_moe_components(model) if moe is None else bool(moe)
+    if use_moe and not model_has_moe_components(model):
+        raise ValueError(
+            "moe=True requires a profile with MoE component metrics "
+            "(bytes_per_expert, flops_per_active_expert_per_token, ...)"
+        )
+    return use_moe
+
+
 def _moe_mean(d: Optional[dict], default: float = 0.0) -> float:
     if not d:
         return default

@@ -42,6 +42,10 @@ class StreamingReplanner:
     >>> placement = planner.step(devs, model)       # warm re-solve
     """
 
+    # JAX-backend search-budget overrides a replanner may carry across its
+    # ticks (None entries fall back to backend_jax.default_search_params).
+    _SEARCH_KEYS = ("max_rounds", "beam", "ipm_iters", "ipm_warm_iters", "node_cap")
+
     def __init__(
         self,
         mip_gap: float = 1e-3,
@@ -49,7 +53,14 @@ class StreamingReplanner:
         backend: str = "jax",
         moe: Optional[bool] = None,
         cold_start: bool = False,
+        search: Optional[dict] = None,
     ) -> None:
+        # Library users build a replanner and call step() in a loop; arm the
+        # axon-wedge guard here too so the FIRST tick's backend init cannot
+        # wedge under JAX_PLATFORMS=cpu (same contract as halda_solve*).
+        from ..axon_guard import force_cpu_if_env_requested
+
+        force_cpu_if_env_requested()
         self.mip_gap = mip_gap
         self.kv_bits = kv_bits
         self.backend = backend
@@ -59,6 +70,19 @@ class StreamingReplanner:
         # IPM iterates, no margin chain. Results must agree with warm ticks
         # within mip_gap; the wall-clock delta is the warm-start win.
         self.cold_start = cold_start
+        # Search-budget overrides (`beam`, `ipm_iters`, `ipm_warm_iters`,
+        # `max_rounds`, `node_cap`) applied to EVERY tick — the streaming
+        # analogue of passing the knobs to halda_solve directly. A tick
+        # near the default budget's certification edge (README
+        # "Search-budget knobs") raises them here once instead of on each
+        # call site.
+        self.search = dict(search or {})
+        bad = set(self.search) - set(self._SEARCH_KEYS)
+        if bad:
+            raise ValueError(
+                f"unknown search override(s) {sorted(bad)}; "
+                f"valid keys: {list(self._SEARCH_KEYS)}"
+            )
         self.last: Optional[HALDAResult] = None
         self.last_mapping = None  # ExpertMapping of the last load-aware tick
         # Observability (see distilp_tpu.sched.metrics): an optional sink
@@ -132,6 +156,7 @@ class StreamingReplanner:
             load_factors=factors,
             timings=timings,
             margin_state=None if self.cold_start else self._margin_state,
+            **self.search,
         )
         result = self._certify_or_fallback(
             result, devs, model, k_candidates, factors, warm, timings
@@ -198,6 +223,7 @@ class StreamingReplanner:
                 load_factors=factors,
                 timings=timings,
                 margin_state=self._margin_state,
+                **self.search,
             )
             # The retry's own report is irrelevant here (the anchor was
             # dropped, so it cannot be a margin tick); keep the key clean.
@@ -215,6 +241,7 @@ class StreamingReplanner:
                 load_factors=factors,
                 timings=timings,
                 margin_state=self._margin_state,
+                **self.search,
             )
             self._margin_state.pop("used", None)
         self.last_tick_mode = (
@@ -285,6 +312,7 @@ class StreamingReplanner:
             warm=warm,
             load_factors=factors,
             margin_state=None if self.cold_start else self._margin_state,
+            **self.search,
         )
         # Snapshot the fleet AND the model: streaming callers mutate both in
         # place between ticks (t_comm drifts, expert_loads refresh), and

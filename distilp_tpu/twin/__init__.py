@@ -1,0 +1,54 @@
+"""Digital-twin evaluation of HALDA placements.
+
+The solver optimizes an analytic proxy for per-token latency; this package
+*executes* placements against that proxy's own physics and stress-tests
+them under device drift:
+
+- ``model``  — deterministic pipeline-execution model (host numpy oracle);
+- ``engine`` — vmapped Monte-Carlo perturbation engine (one JAX dispatch
+  per robustness report; lazy jax import);
+- ``report`` — pydantic report schemas (importable without a backend);
+- ``api``    — ``evaluate_placement`` / ``robustness_report`` /
+  ``rank_agreement`` / ``twin_p95_score``.
+
+Used by ``solver evaluate`` (CLI), the scheduler's risk-aware serving mode
+(``sched.scheduler``), and the ``twin_*`` bench section.
+"""
+
+from .api import (
+    applicable_candidates,
+    evaluate_placement,
+    rank_agreement,
+    robustness_report,
+    twin_p95_score,
+)
+from .model import (
+    TwinArrays,
+    build_twin_arrays,
+    placement_applicable,
+    placement_vectors,
+    simulate_placement,
+)
+from .report import (
+    DeviceSensitivity,
+    DeviceTwinRow,
+    RobustnessReport,
+    TwinEvaluation,
+)
+
+__all__ = [
+    "evaluate_placement",
+    "robustness_report",
+    "rank_agreement",
+    "twin_p95_score",
+    "applicable_candidates",
+    "TwinArrays",
+    "build_twin_arrays",
+    "placement_applicable",
+    "placement_vectors",
+    "simulate_placement",
+    "RobustnessReport",
+    "TwinEvaluation",
+    "DeviceTwinRow",
+    "DeviceSensitivity",
+]

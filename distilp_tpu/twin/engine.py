@@ -1,0 +1,187 @@
+"""Vmapped Monte-Carlo perturbation engine for the digital twin.
+
+One jitted JAX program evaluates EVERYTHING a robustness report needs in a
+single dispatch (MPAX-style batched math programming, arXiv:2412.09734):
+
+- ``samples`` log-normal perturbation draws (seeded ``jax.random``, mean-1
+  multiplicative jitter on per-device compute throughput, link time, disk
+  rate and memory headroom, plus optional straggler/dropout scenarios),
+- one deterministic sensitivity probe per device (that device alone
+  degraded by a fixed factor),
+- the unperturbed base run,
+
+all stacked on one batch axis and pushed through ``jax.vmap`` of the same
+pipeline-execution math as ``twin.model.simulate_placement`` (the host
+numpy oracle the engine is tested against). The placement enters as
+precomputed per-device vectors, so every candidate placement of one fleet
+shape reuses one compiled program — the risk-aware scheduler prices many
+candidates per tick against a single compile.
+
+jax imports live inside functions: the twin layer is lazy (dlint DLP013),
+so reports and schemas stay importable without a backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .model import PlacementVectors
+
+# The jitted kernel, built on first use (lazy jax import). jit's own cache
+# handles per-shape (M, R) specialization behind this single callable.
+_KERNEL = None
+
+
+def _get_kernel():
+    global _KERNEL
+    if _KERNEL is not None:
+        return _KERNEL
+
+    import jax
+    import jax.numpy as jnp
+
+    def _eval_one(data, comp, comm, disk, mem):
+        """Latency + feasibility of one perturbed execution (traced)."""
+        comp_s = data["compute0"] * comp
+        comm_s = data["comm0"] * comm
+        off_s = data["off0"] * comm
+        # Capacity drift shrinks/grows positive headroom; rows already in
+        # deficit (rhs <= 0) and inactive rows (huge rhs) keep their value.
+        ram_rhs = jnp.where(
+            data["ram_rhs"] > 0.0, data["ram_rhs"] * mem, data["ram_rhs"]
+        )
+        cuda_rhs = jnp.where(
+            data["cuda_rhs"] > 0.0, data["cuda_rhs"] * mem, data["cuda_rhs"]
+        )
+        metal_rhs = jnp.where(
+            data["metal_rhs"] > 0.0, data["metal_rhs"] * mem, data["metal_rhs"]
+        )
+        bp = data["bp"]
+        s_need = jnp.maximum(
+            0.0,
+            jnp.ceil(jnp.maximum(0.0, data["ram_lhs0"] - ram_rhs) / bp - 1e-12),
+        )
+        vram_deficit = jnp.maximum(
+            jnp.maximum(0.0, data["cuda_lhs0"] - cuda_rhs),
+            jnp.maximum(0.0, data["metal_lhs0"] - metal_rhs),
+        )
+        t_need = jnp.maximum(0.0, jnp.ceil(vram_deficit / bp - 1e-12))
+        violation = jnp.any(s_need > data["s_cap"] + 1e-9) | jnp.any(
+            t_need > data["t_cap"] + 1e-9
+        )
+        s_used = jnp.minimum(s_need, data["s_cap"])
+        t_used = jnp.minimum(t_need, data["t_cap"])
+        disk_s = (data["pen_set"] * s_used + data["pen_vram"] * t_used) * disk
+        busy = comp_s + disk_s + off_s + comm_s
+        cycle = jnp.max(busy + 0.5 * data["prefetch0"] * disk)
+        latency = (
+            jnp.sum(comp_s + disk_s)
+            + data["kfac"] * cycle
+            + jnp.sum(comm_s)
+            + jnp.sum(off_s)
+            + data["kappa"]
+        )
+        return latency, violation
+
+    def _mc(data, seed, sigmas, dropout_p, dropout_slowdown, degrade, samples):
+        """(latencies, violations) over [samples | M sensitivity | base]."""
+        M = data["compute0"].shape[0]
+        key = jax.random.key(seed)
+        k_norm, k_drop = jax.random.split(key)
+        z = jax.random.normal(k_norm, (4, samples, M))
+        # Mean-1 log-normal: exp(sigma z - sigma^2/2); sigma=0 -> exactly 1.
+        sig = sigmas.reshape(4, 1, 1)
+        jit = jnp.exp(sig * z - 0.5 * sig * sig)
+        comp, comm, disk, mem = jit[0], jit[1], jit[2], jit[3]
+        straggler = jax.random.bernoulli(k_drop, dropout_p, (samples, M))
+        comp = comp * jnp.where(straggler, dropout_slowdown, 1.0)
+
+        ones_m = jnp.ones((M, M))
+        sens = 1.0 + (degrade - 1.0) * jnp.eye(M)  # row j: device j degraded
+        one = jnp.ones((1, M))
+        comp_all = jnp.concatenate([comp, sens, one])
+        comm_all = jnp.concatenate([comm, sens, one])
+        disk_all = jnp.concatenate([disk, ones_m, one])
+        mem_all = jnp.concatenate([mem, ones_m, one])
+        return jax.vmap(_eval_one, in_axes=(None, 0, 0, 0, 0))(
+            data, comp_all, comm_all, disk_all, mem_all
+        )
+
+    _KERNEL = jax.jit(_mc, static_argnames=("samples",))
+    return _KERNEL
+
+
+def _device_data(vec: PlacementVectors) -> dict:
+    """The placement's vectors as a dict of arrays for the jitted kernel."""
+    return {
+        "compute0": np.asarray(vec.compute0),
+        "comm0": np.asarray(vec.comm0),
+        "off0": np.asarray(vec.off0),
+        "prefetch0": np.asarray(vec.prefetch0),
+        "pen_set": np.asarray(vec.pen_set),
+        "pen_vram": np.asarray(vec.pen_vram),
+        "ram_lhs0": np.asarray(vec.ram_lhs0),
+        "ram_rhs": np.asarray(vec.ram_rhs),
+        "cuda_lhs0": np.asarray(vec.cuda_lhs0),
+        "cuda_rhs": np.asarray(vec.cuda_rhs),
+        "metal_lhs0": np.asarray(vec.metal_lhs0),
+        "metal_rhs": np.asarray(vec.metal_rhs),
+        "s_cap": np.asarray(vec.s_cap),
+        "t_cap": np.asarray(vec.t_cap),
+        "bp": np.float64(vec.bp),
+        "kfac": np.float64(vec.k - 1),
+        "kappa": np.float64(vec.kappa),
+    }
+
+
+def run_monte_carlo(
+    vec: PlacementVectors,
+    samples: int = 1024,
+    seed: int = 0,
+    sigma_compute: float = 0.08,
+    sigma_comm: float = 0.15,
+    sigma_disk: float = 0.10,
+    sigma_mem: float = 0.0,
+    dropout_p: float = 0.0,
+    dropout_slowdown: float = 8.0,
+    degrade: float = 1.25,
+) -> dict:
+    """One dispatch: MC samples + per-device sensitivity probes + base run.
+
+    Returns plain numpy: ``latencies`` (samples,), ``violations`` (samples,)
+    bool, ``sens_latencies`` (M,), ``base_latency`` float, ``base_violation``
+    bool. Deterministic for a fixed seed (seeded ``jax.random``; the chunk
+    order inside the one program is fixed).
+    """
+    if samples < 1:
+        raise ValueError("samples must be >= 1")
+    kernel = _get_kernel()
+    data = _device_data(vec)
+    sigmas = np.asarray(
+        [sigma_compute, sigma_comm, sigma_disk, sigma_mem], dtype=np.float64
+    )
+    lat, viol = kernel(
+        data,
+        np.uint32(seed),
+        sigmas,
+        np.float64(dropout_p),
+        np.float64(dropout_slowdown),
+        np.float64(degrade),
+        samples=int(samples),
+    )
+    lat = np.asarray(lat)
+    viol = np.asarray(viol)
+    M = vec.compute0.shape[0]
+    return {
+        "latencies": lat[:samples],
+        "violations": viol[:samples],
+        "sens_latencies": lat[samples : samples + M],
+        "base_latency": float(lat[-1]),
+        "base_violation": bool(viol[-1]),
+    }
+
+
+def reset_kernel_cache() -> None:
+    """Drop the jitted program (tests use this to count retraces)."""
+    global _KERNEL
+    _KERNEL = None

@@ -139,10 +139,50 @@ def main() -> int:
     # ------------------------------------------------------------------
     from distilp_tpu.solver import halda_solve_per_k
 
-    for r in halda_solve_per_k(devs, model, kv_bits="8bit", mip_gap=1e-3):
+    per_k = halda_solve_per_k(devs, model, kv_bits="8bit", mip_gap=1e-3)
+    for r in per_k:
         print(
             f"[7] k={r.k}: obj={r.obj_value:.4f} certified={r.certified} "
             f"y={r.y}"
+        )
+
+    # ------------------------------------------------------------------
+    # 8. Digital twin: execute the placement instead of trusting the
+    #    proxy — deterministic simulated run (must agree with the
+    #    objective), then a 512-sample vmapped Monte-Carlo robustness
+    #    report (latency tail under device drift + stragglers, memory
+    #    feasibility, worst-device sensitivity), one JAX dispatch.
+    # ------------------------------------------------------------------
+    from distilp_tpu.twin import evaluate_placement, rank_agreement, robustness_report
+
+    # Evaluate the k-curve winner: it was solved against the CURRENT
+    # profiles (steps 4-5 drifted t_comm since step 3's solve, and the
+    # twin prices whatever the profiles say now).
+    best = min(per_k, key=lambda r: r.obj_value)
+    ev = evaluate_placement(devs, model, best, kv_bits="8bit")
+    print(
+        f"[8] twin: latency={ev.latency_s:.4f}s vs objective="
+        f"{ev.objective_s:.4f}s (rel err {ev.rel_err:.1e}), "
+        f"bottleneck={ev.bottleneck}"
+    )
+    rep = robustness_report(
+        devs, model, best, samples=512, seed=0, kv_bits="8bit",
+        dropout_p=0.05,
+    )
+    print(
+        f"[8] robustness: p50={rep.p50_s:.4f}s p95={rep.p95_s:.4f}s "
+        f"p99={rep.p99_s:.4f}s P(mem violation)={rep.p_violation:.3f}"
+    )
+    print(
+        f"[8] most latency-critical device: {rep.sensitivity[0].name} "
+        f"(+{rep.sensitivity[0].delta_s:.4f}s under a 1.25x slowdown)"
+    )
+    if len(per_k) >= 2:
+        ra = rank_agreement(devs, model, per_k, kv_bits="8bit")
+        print(
+            f"[8] twin-vs-objective rank agreement over the k-curve: "
+            f"spearman={ra['spearman']:.3f} "
+            f"({ra['pairwise_inversions']} inversions)"
         )
     return 0
 

@@ -514,3 +514,141 @@ def test_solver_cli_serve_rejects_bad_inputs(tmp_path):
         ]
     )
     assert rc == 2
+
+
+def test_solver_cli_evaluate_renders_twin_report(tmp_path, capsys):
+    """`solver evaluate` solves a golden fixture and renders both twin
+    reports; --json output must validate against the report schemas and be
+    deterministic under --check-determinism (the `make smoke-twin` gate)."""
+    from distilp_tpu.cli.solver_cli import main
+    from distilp_tpu.twin import RobustnessReport, TwinEvaluation
+
+    rc = main(
+        [
+            "evaluate",
+            "--profile",
+            str(PROFILES / "llama_3_70b" / "online"),
+            "--backend",
+            "cpu",
+            "--samples",
+            "64",
+            "--seed",
+            "7",
+            "--dropout-p",
+            "0.05",
+            "--check-determinism",
+            "--json",
+        ]
+    )
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    ev = TwinEvaluation.model_validate(payload["evaluation"])
+    rep = RobustnessReport.model_validate(payload["robustness"])
+    # The twin executed the solver's optimum: the cross-check must agree.
+    assert ev.rel_err is not None and ev.rel_err < 1e-9
+    assert rep.samples == 64 and rep.seed == 7
+    assert rep.p50_s <= rep.p95_s <= rep.p99_s
+    assert len(rep.sensitivity) == 2
+
+
+def test_solver_cli_evaluate_saved_solution_and_bad_inputs(tmp_path, capsys):
+    from distilp_tpu.cli.solver_cli import main
+
+    # Solve once, save, then evaluate the saved placement.
+    sol = tmp_path / "sol.json"
+    rc = main(
+        [
+            "--profile",
+            str(PROFILES / "llama_3_70b" / "online"),
+            "--backend",
+            "cpu",
+            "--kv-bits",
+            "4bit",
+            "--save-solution",
+            str(sol),
+        ]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(
+        [
+            "evaluate",
+            "--profile",
+            str(PROFILES / "llama_3_70b" / "online"),
+            "--solution",
+            str(sol),
+            "--samples",
+            "32",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Digital-twin execution" in out
+    assert "Robustness report" in out
+
+    # Bad inputs: missing folder, unreadable solution.
+    assert main(["evaluate", "--profile", str(tmp_path / "nope")]) == 2
+    assert (
+        main(
+            [
+                "evaluate",
+                "--profile",
+                str(PROFILES / "llama_3_70b" / "online"),
+                "--solution",
+                str(tmp_path / "missing.json"),
+            ]
+        )
+        == 2
+    )
+    # Structurally invalid solution (window sums don't divide L): the
+    # applicability gate must reject it instead of mispricing it.
+    bad = tmp_path / "bad.json"
+    bad.write_text(
+        json.dumps({"k": 2, "w": [13, 26], "n": [13, 26],
+                    "obj_value": 1.0, "sets": {"M1": [], "M2": [0, 1], "M3": []}})
+    )
+    rc = main(
+        [
+            "evaluate",
+            "--profile",
+            str(PROFILES / "llama_3_70b" / "online"),
+            "--solution",
+            str(bad),
+        ]
+    )
+    assert rc == 2
+
+
+def test_solver_cli_serve_risk_aware_flag(tmp_path, capsys):
+    """`serve --risk-aware` publishes risk metrics and demonstrably changes
+    warm-pool selection on the bundled churn trace (tick 1 serves the
+    shallower k=8 runner-up instead of the k=10 objective winner)."""
+    from distilp_tpu.cli.solver_cli import main
+
+    trace = Path(__file__).resolve().parent / "traces" / "scheduler_smoke_20.jsonl"
+    # One-event prefix keeps the test fast; the switch happens on tick 1.
+    short = tmp_path / "short.jsonl"
+    short.write_text(trace.read_text().strip().splitlines()[0] + "\n")
+    rc = main(
+        [
+            "serve",
+            "--trace",
+            str(short),
+            "--profile",
+            str(PROFILES / "llama_3_70b" / "online"),
+            "--synthetic-fleet",
+            "4",
+            "--fleet-seed",
+            "11",
+            "--k-candidates",
+            "8,10",
+            "--risk-aware",
+            "--quiet",
+        ]
+    )
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["risk"]["evals"] == 1
+    assert summary["risk"]["switches"] >= 1
+    assert summary["risk"]["errors"] == 0
+    assert summary["metrics"]["latency"]["twin_p95"]["count"] == 1

@@ -1,0 +1,350 @@
+"""Digital-twin evaluation tests (distilp_tpu.twin).
+
+The twin's conformance contract: deterministically executing a placement
+must reproduce the HALDA objective of that placement exactly (same
+coefficient vocabulary, optimal stall/spill completion in closed form), so
+twin latency and solver objective must RANK candidate placements
+identically. Pinned here on all four golden fixtures plus the 16-device
+north star, over solver-enumerated k-candidates.
+
+The Monte-Carlo engine is pinned for: base-row agreement with the host
+numpy oracle, determinism under a fixed PRNG seed, finite per-device
+totals, feasibility-violation detection, sensitivity ranking, and the
+risk-aware scheduler wiring (served placement changes on the bundled churn
+trace).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distilp_tpu.common import (
+    DeviceProfile,
+    ModelProfile,
+    load_from_profile_folder,
+    load_model_profile,
+)
+from distilp_tpu.solver import HALDAResult, halda_solve
+from distilp_tpu.solver.api import _build_instance
+from distilp_tpu.solver.backend_cpu import Infeasible, solve_fixed_k_cpu
+from distilp_tpu.twin import (
+    build_twin_arrays,
+    evaluate_placement,
+    placement_applicable,
+    rank_agreement,
+    robustness_report,
+    simulate_placement,
+    twin_p95_score,
+)
+from distilp_tpu.utils import make_synthetic_fleet
+
+GOLDEN_FOLDERS = [
+    "hermes_70b",
+    "llama_3_70b/4bit",
+    "llama_3_70b/online",
+    "qwen3_32b/bf16",
+]
+
+
+def _per_k_cpu(devs, model, kv_bits="4bit", k_candidates=None, moe=False):
+    """Certified per-k optima via the HiGHS oracle (fast, exact)."""
+    Ks, sets, _, arrays = _build_instance(
+        devs, model, k_candidates, kv_bits, moe, None
+    )
+    out = []
+    for k in Ks:
+        try:
+            res = solve_fixed_k_cpu(arrays, k, model.L // k, mip_gap=1e-6)
+        except Infeasible:
+            continue
+        out.append(
+            HALDAResult(
+                w=res.w, n=res.n, k=res.k, y=res.y, obj_value=res.obj_value,
+                sets={name: list(v) for name, v in sets.items()},
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------------
+# twin-vs-objective agreement (the satellite's golden contract)
+
+
+@pytest.mark.parametrize("folder", GOLDEN_FOLDERS)
+def test_twin_matches_objective_on_golden_fixtures(profiles_dir, folder):
+    devs, model = load_from_profile_folder(profiles_dir / folder)
+    result = halda_solve(devs, model, mip_gap=1e-4, kv_bits="4bit", backend="cpu")
+    ev = evaluate_placement(devs, model, result, kv_bits="4bit")
+    assert ev.rel_err is not None and ev.rel_err < 1e-9
+    assert ev.feasible
+    assert ev.k == result.k
+    # Per-device totals must be finite and the breakdown must sum to the
+    # busy time the cycle bound reads.
+    for row in ev.devices:
+        assert np.isfinite(row.busy_s)
+        assert row.busy_s == pytest.approx(
+            row.compute_s + row.disk_s + row.comm_s + row.offload_s
+        )
+
+
+@pytest.mark.parametrize("folder", GOLDEN_FOLDERS)
+def test_twin_ranks_k_candidates_like_objective(profiles_dir, folder):
+    devs, model = load_from_profile_folder(profiles_dir / folder)
+    per_k = _per_k_cpu(devs, model)
+    assert len(per_k) >= 2
+    ra = rank_agreement(devs, model, per_k, kv_bits="4bit")
+    assert ra["pairwise_inversions"] == 0
+    assert ra["spearman"] == pytest.approx(1.0)
+    assert all(np.isfinite(x) for x in ra["twin_latencies"])
+
+
+def test_twin_matches_objective_and_ranks_moe():
+    """The MoE branches (g_raw/k·y compute, expert-byte memory rows,
+    s<=w / t<=n slack caps) carry the same conformance contract as the
+    dense path: exact objective agreement and rank agreement over the
+    per-k optima — pinned on the Mixtral-8x7B analytic profile via the
+    HiGHS oracle (no jax MoE compile needed)."""
+    from distilp_tpu.profiler.api import profile_model
+
+    split = profile_model(
+        "tests/configs/mixtral_8x7b.json", batch_sizes=[1], sequence_length=128
+    )
+    model = split.to_model_profile()
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    per_k = _per_k_cpu(
+        devs, model, kv_bits="8bit", k_candidates=[2, 4, 8], moe=True
+    )
+    assert len(per_k) >= 2
+    best = min(per_k, key=lambda r: r.obj_value)
+    assert best.y is not None and sum(best.y) == model.n_routed_experts
+    ev = evaluate_placement(devs, model, best, kv_bits="8bit", moe=True)
+    assert ev.rel_err is not None and ev.rel_err < 1e-9
+    ra = rank_agreement(devs, model, per_k, kv_bits="8bit", moe=True)
+    assert ra["pairwise_inversions"] == 0
+    assert ra["spearman"] == pytest.approx(1.0)
+    # The MC engine prices the expert rows too: deterministic + finite.
+    rep = robustness_report(
+        devs, model, best, samples=32, seed=0, kv_bits="8bit", moe=True
+    )
+    assert rep.base_latency_s == pytest.approx(ev.latency_s, rel=1e-5)
+    assert np.isfinite(rep.p95_s)
+
+
+def test_twin_ranks_north_star_like_objective():
+    model = load_model_profile(
+        "tests/profiles/llama_3_70b/online/model_profile.json"
+    )
+    devs = make_synthetic_fleet(16, seed=123)
+    per_k = _per_k_cpu(devs, model)
+    assert len(per_k) >= 2  # W >= M leaves k in {1, 2, 4, 5}
+    ra = rank_agreement(devs, model, per_k, kv_bits="4bit")
+    assert ra["pairwise_inversions"] == 0
+    assert ra["spearman"] == pytest.approx(1.0)
+
+
+# --------------------------------------------------------------------------
+# Monte-Carlo engine: oracle agreement, determinism, finiteness
+
+
+@pytest.fixture(scope="module")
+def online_solved():
+    devs, model = load_from_profile_folder("tests/profiles/llama_3_70b/online")
+    result = halda_solve(devs, model, mip_gap=1e-4, kv_bits="4bit", backend="cpu")
+    return devs, model, result
+
+
+def test_engine_base_row_matches_numpy_oracle(online_solved):
+    devs, model, result = online_solved
+    rep = robustness_report(devs, model, result, samples=64, seed=0, kv_bits="4bit")
+    ev = evaluate_placement(devs, model, result, kv_bits="4bit")
+    # f32 device math vs f64 host oracle: agreement to f32 resolution.
+    assert rep.base_latency_s == pytest.approx(ev.latency_s, rel=1e-5)
+
+
+def test_mc_report_deterministic_for_fixed_key(online_solved):
+    devs, model, result = online_solved
+    kw = dict(samples=128, kv_bits="4bit", dropout_p=0.05, sigma_mem=0.05)
+    a = robustness_report(devs, model, result, seed=11, **kw)
+    b = robustness_report(devs, model, result, seed=11, **kw)
+    assert a.model_dump() == b.model_dump()
+    c = robustness_report(devs, model, result, seed=12, **kw)
+    assert c.p95_s != a.p95_s
+    for rep in (a, c):
+        for v in (rep.mean_s, rep.p50_s, rep.p95_s, rep.p99_s, rep.worst_s):
+            assert np.isfinite(v)
+        assert rep.p50_s <= rep.p95_s <= rep.p99_s <= rep.worst_s
+        assert 0.0 <= rep.p_violation <= 1.0
+        assert len(rep.sensitivity) == len(devs)
+
+
+def test_sensitivity_ranks_bottleneck_first():
+    # Device 0 is made the overwhelming bottleneck (a dominating link
+    # cost): degrading it must cost more latency than degrading the other.
+    devs = make_synthetic_fleet(2, seed=3)
+    devs[0].t_comm = 0.5
+    model = load_model_profile(
+        "tests/profiles/llama_3_70b/online/model_profile.json"
+    )
+    result = halda_solve(devs, model, mip_gap=1e-3, kv_bits="4bit", backend="cpu")
+    rep = robustness_report(devs, model, result, samples=32, seed=0, kv_bits="4bit")
+    assert rep.sensitivity[0].name == devs[0].name
+    assert rep.sensitivity[0].delta_s > rep.sensitivity[1].delta_s
+    assert rep.sensitivity[0].share > 0.5
+
+
+def _tiny_overflow_instance():
+    dev = DeviceProfile(
+        name="tiny",
+        os_type="linux",
+        is_head=True,
+        scpu={"F16": {"b_1": 1e9}},
+        T_cpu=1e9,
+        s_disk=1e6,
+        d_avail_ram=1,
+        c_cpu=0,
+    )
+    model = ModelProfile(
+        L=4, hk=8, ek=128, hv=8, ev=128, n_kv=1 << 20, e_embed=1024, V=1000,
+        b_layer=1 << 30, b_in=0, b_out=0, f_q={"b_1": 1.0}, f_out={"b_1": 1.0},
+        Q="F16",
+    )
+    return dev, model
+
+
+def test_ram_overflow_spills_but_stays_feasible():
+    # All layers overflow 1 byte of RAM; the slack capacity (W layers) can
+    # absorb the spill, so the twin charges disk and stays feasible — same
+    # semantics as the MILP's slack variables.
+    dev, model = _tiny_overflow_instance()
+    result = halda_solve([dev], model, kv_bits="8bit", backend="cpu")
+    ev = evaluate_placement([dev], model, result, kv_bits="8bit")
+    assert ev.feasible
+    assert ev.devices[0].spill_layers == ev.devices[0].w
+    assert ev.rel_err is not None and ev.rel_err < 1e-9
+
+
+def test_infeasible_placement_flags_violation():
+    # Hand the twin a placement whose expert bytes CANNOT fit: a MoE-free
+    # trick is impossible (dense spill always fits W), so force it by
+    # shrinking the slack cap: w=2 layers but spill needs 4 (k=2 -> W=2
+    # per segment against 4 overflowing layers is fine; instead check the
+    # violation channel through memory jitter collapsing capacity).
+    dev, model = _tiny_overflow_instance()
+    result = halda_solve([dev], model, kv_bits="8bit", backend="cpu")
+    arrays = build_twin_arrays([dev], model, kv_bits="8bit")
+    # Monkeyed cap: pretend the device may stream at most 0 layers. The
+    # MILP bound (W) is placement-level; the twin must flag exceeding it.
+    vec_ev = simulate_placement(arrays, result.w, result.n, k=result.k)
+    assert vec_ev.feasible
+    arrays.ram_rhs[:] = -1e18  # capacity collapses far beyond slack reach?
+    # ram deficit grows, but spill cap W still absorbs ceil(deficit/bp)
+    # only up to W layers; a deficit beyond W*bp means violation.
+    ev2 = simulate_placement(arrays, result.w, result.n, k=result.k)
+    assert not ev2.feasible
+    rep = robustness_report(
+        [dev], model, result, samples=16, seed=0, kv_bits="8bit", arrays=arrays
+    )
+    assert rep.p_violation == pytest.approx(1.0)
+
+
+def test_placement_applicable_filters():
+    devs, model = load_from_profile_folder("tests/profiles/llama_3_70b/online")
+    arrays = build_twin_arrays(devs, model, kv_bits="4bit")
+    assert placement_applicable(arrays, [13, 27], [13, 27], k=2)
+    assert not placement_applicable(arrays, [13, 27, 1], [13, 27, 0], k=2)  # M
+    assert not placement_applicable(arrays, [13, 27], [14, 27], k=2)  # n > w
+    assert not placement_applicable(arrays, [13, 26], [13, 26], k=2)  # sum w
+    assert not placement_applicable(arrays, [0, 40], [0, 40], k=2)  # w >= 1
+    assert not placement_applicable(arrays, [13, 27], [13, 27], k=2, y=[1, 0])
+
+
+def test_twin_p95_score_prefers_feasible(online_solved):
+    devs, model, result = online_solved
+    ok = twin_p95_score(devs, model, result, samples=32, seed=0, kv_bits="4bit")
+    arrays = build_twin_arrays(devs, model, kv_bits="4bit")
+    arrays.ram_rhs[:] = -1e18
+    bad = twin_p95_score(
+        devs, model, result, samples=32, seed=0, kv_bits="4bit", arrays=arrays
+    )
+    assert bad["p_violation"] == pytest.approx(1.0)
+    assert bad["score"] > ok["score"] + 100.0  # violation penalty dominates
+    # The penalty has a fixed step at p_violation > 0 (not just a graded
+    # term): ANY violating candidate must lose to every violation-free one.
+    from distilp_tpu.twin.api import VIOLATION_PENALTY_S
+
+    assert bad["score"] >= bad["p95_s"] + VIOLATION_PENALTY_S
+
+
+# --------------------------------------------------------------------------
+# risk-aware scheduler: serving changes on the bundled churn trace
+
+
+def test_risk_aware_changes_served_placement_on_bundled_trace():
+    from distilp_tpu.sched import Scheduler, read_trace
+
+    model = load_model_profile(
+        "tests/profiles/llama_3_70b/online/model_profile.json"
+    )
+    # The first event of the bundled smoke trace is enough: the switch
+    # happens on the very first tick (the objective prefers k=10 by a
+    # hair; the twin's straggler channel prefers the shallower k=8). One
+    # event also keeps tier-1 lean — only the M=4 fleet shape compiles.
+    events = read_trace("tests/traces/scheduler_smoke_20.jsonl")[:1]
+    served = {}
+    metrics = {}
+    for risk in (False, True):
+        devs = make_synthetic_fleet(4, seed=11)
+        sched = Scheduler(
+            devs, model, mip_gap=1e-3, kv_bits="4bit", backend="jax",
+            k_candidates=[8, 10], risk_aware=risk,
+        )
+        views = [sched.handle(ev) for ev in events]
+        served[risk] = [(v.result.k, tuple(v.result.w)) for v in views]
+        metrics[risk] = sched.metrics.counters
+        if risk:
+            assert all(v.twin_p95_s is not None for v in views)
+            assert any(v.risk_selected for v in views)
+    assert served[True] != served[False]
+    assert metrics[True]["risk_eval"] == len(events)
+    assert metrics[True]["risk_switch"] >= 1
+    assert metrics[True]["risk_error"] == 0
+    assert "risk_eval" not in metrics[False]
+
+
+def test_risk_aware_deterministic_replay():
+    from distilp_tpu.sched import Scheduler, read_trace
+
+    model = load_model_profile(
+        "tests/profiles/llama_3_70b/online/model_profile.json"
+    )
+    events = read_trace("tests/traces/scheduler_smoke_20.jsonl")[:1]
+
+    def run():
+        devs = make_synthetic_fleet(4, seed=11)
+        sched = Scheduler(
+            devs, model, mip_gap=1e-3, kv_bits="4bit", backend="jax",
+            k_candidates=[8, 10], risk_aware=True,
+        )
+        return [
+            (v.result.k, tuple(v.result.w), v.risk_selected, v.twin_p95_s)
+            for v in (sched.handle(ev) for ev in events)
+        ]
+
+    assert run() == run()
+
+
+def test_risk_mc_override_plumbs_through():
+    from distilp_tpu.sched import Scheduler
+    from distilp_tpu.sched.scheduler import DEFAULT_RISK_MC
+
+    model = load_model_profile(
+        "tests/profiles/llama_3_70b/online/model_profile.json"
+    )
+    devs = make_synthetic_fleet(2, seed=5)
+    sched = Scheduler(
+        devs, model, kv_bits="4bit", backend="cpu", risk_aware=True,
+        risk_mc={"sigma_compute": 0.5, "dropout_p": 0.0},
+    )
+    assert sched.risk_mc == {"sigma_compute": 0.5, "dropout_p": 0.0}
+    assert DEFAULT_RISK_MC["dropout_p"] > 0  # serving default keeps stragglers

@@ -41,6 +41,9 @@ LAZY_JAX_PREFIXES = (
     "distilp_tpu/profiler/",
     "distilp_tpu/cli/",
     "distilp_tpu/sched/",
+    # The twin layer's report schemas must parse without a backend; the
+    # engine lazy-imports jax inside its kernel builder.
+    "distilp_tpu/twin/",
 )
 LAZY_JAX_MODULES = {
     "distilp_tpu/__init__.py",
@@ -60,6 +63,17 @@ LAZY_JAX_MODULES = {
 ENTRY_POINT_PREFIXES = ("distilp_tpu/cli/", "tools/", "examples/")
 ENTRY_POINT_FILES = {"bench.py", "__graft_entry__.py"}
 
+# Library modules that dispatch backend work on behalf of plain library
+# users (no CLI in between): they must arm the guard themselves, because
+# `JAX_PLATFORMS=cpu halda_solve(backend='jax')` wedging on a dead tunnel
+# is exactly the trap VERDICT round 5 (finding 2) documented. DLP015
+# treats these like entry points.
+GUARDED_LIBRARY_FILES = {
+    "distilp_tpu/solver/api.py",
+    "distilp_tpu/solver/streaming.py",
+    "distilp_tpu/twin/api.py",
+}
+
 # Modules whose IMPORT eagerly loads jax (top-level `import jax` in the
 # module or its package __init__); a lazy layer importing one of these at
 # module level defeats its own laziness just as surely as `import jax`.
@@ -76,6 +90,7 @@ BACKEND_TOUCHING_PREFIXES = (
     "distilp_tpu.ops",
     "distilp_tpu.parallel",
     "distilp_tpu.sched",
+    "distilp_tpu.twin",
     "distilp_tpu.utils",
     "distilp_tpu.profiler.device",
     "distilp_tpu.profiler.topology",
@@ -621,14 +636,20 @@ class UnguardedBackendEntryPoint(Rule):
         "route through distilp_tpu.axon_guard first: the sitecustomize on "
         "this image registers the tunneled-TPU PJRT plugin in every "
         "interpreter and a dead tunnel wedges backend init forever — "
-        "JAX_PLATFORMS=cpu alone does NOT help (axon_guard.py docstring)."
+        "JAX_PLATFORMS=cpu alone does NOT help (axon_guard.py docstring). "
+        "The same applies to the guarded LIBRARY dispatch modules "
+        "(GUARDED_LIBRARY_FILES: solver/api.py, solver/streaming.py, "
+        "twin/api.py) — plain halda_solve/twin users get no CLI shim to "
+        "arm the guard for them (VERDICT round-5 finding 2)."
     )
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         if ctx.is_test:
             return
-        is_entry = ctx.relpath in ENTRY_POINT_FILES or any(
-            ctx.relpath.startswith(p) for p in ENTRY_POINT_PREFIXES
+        is_entry = (
+            ctx.relpath in ENTRY_POINT_FILES
+            or ctx.relpath in GUARDED_LIBRARY_FILES
+            or any(ctx.relpath.startswith(p) for p in ENTRY_POINT_PREFIXES)
         )
         if not is_entry:
             return
