@@ -18,7 +18,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin
+test: lint-strict smoke-twin smoke-chaos
 	python -m pytest tests/ -q
 
 .PHONY: bench
@@ -69,6 +69,26 @@ smoke-twin: lint-strict
 # the daemon on the CPU platform (no slow tests, no accelerator needed);
 # any structural tick missing its optimality certificate fails the target.
 # Chained behind lint-strict so the smoke path can't drift from the gate.
+# Chaos soak: the bundled churn trace replayed under a seeded fault plan
+# (solver exceptions incl. a breaker-opening consecutive pair, a latency
+# spike, NaN-poisoned and malformed events, a device-dropout burst) with
+# the hardened serving knobs on. --chaos-check exits 1 unless every tick
+# served a structurally valid placement, every poisoned/malformed event
+# was quarantined and accounted in the counters, and the service returned
+# to 'healthy' within the recovery budget. The deadline is deliberately
+# generous (the point here is exercising the worker-thread solve path,
+# not winning a race against this box's compile times); tight-deadline
+# misses are pinned deterministically in tests/test_faults.py.
+.PHONY: smoke-chaos
+smoke-chaos: lint-strict
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+		--trace tests/traces/scheduler_smoke_20.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--synthetic-fleet 4 --fleet-seed 11 --k-candidates 8,10 \
+		--fault-plan tests/traces/chaos_plan.json \
+		--deadline-ms 60000 --max-retries 2 --breaker-threshold 2 \
+		--chaos-check --quiet
+
 .PHONY: smoke-sched
 smoke-sched: lint-strict
 	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \

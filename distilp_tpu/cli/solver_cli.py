@@ -101,8 +101,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--per-k", action="store_true",
         help="solve EVERY feasible segment count to its own certificate "
-        "and print the full k-curve with assignments (jax backend; "
-        "default: report only the winner, losing k's as objectives)",
+        "and print the full k-curve with assignments (jax backend: one "
+        "batched dispatch; cpu backend: one HiGHS solve per k; default: "
+        "report only the winner, losing k's as objectives)",
     )
     return p
 
@@ -182,6 +183,55 @@ def build_serve_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="exit 1 if any structural event's placement misses its "
         "optimality certificate",
+    )
+    # Fault-hardened serving (see README "Degraded-mode semantics"). All
+    # default OFF so a plain `serve` replay is byte-identical to the
+    # pre-chaos service.
+    p.add_argument(
+        "--deadline-ms",
+        type=float,
+        default=None,
+        help="per-tick wall-clock solve deadline: an overrunning solve is "
+        "abandoned and the last-known-good placement is served with "
+        "mode='stale' (the first-ever solve is exempt — there is nothing "
+        "to serve instead)",
+    )
+    p.add_argument(
+        "--max-retries",
+        type=int,
+        default=0,
+        help="solve retries per tick with bounded exponential backoff "
+        "before the tick counts as failed",
+    )
+    p.add_argument(
+        "--breaker-threshold",
+        type=int,
+        default=None,
+        help="consecutive solve failures that open the circuit breaker "
+        "(serve degraded, then half-open-probe back; default 5; 0 "
+        "disables)",
+    )
+    p.add_argument(
+        "--fault-plan",
+        default=None,
+        help="chaos mode: a FaultPlan JSON (see sched.faults) injected "
+        "over the replay — solver exceptions, latency spikes, NaN "
+        "poisoning, malformed events, dropout bursts",
+    )
+    p.add_argument(
+        "--fault-seed",
+        type=int,
+        default=None,
+        help="override the fault plan's seed (same seed = same injected "
+        "schedule and same served placements)",
+    )
+    p.add_argument(
+        "--chaos-check",
+        action="store_true",
+        help="exit 1 unless the chaos soak contract holds: a structurally "
+        "valid placement served on every tick, every poisoned/malformed "
+        "event quarantined and accounted, and health back to 'healthy' "
+        "within the recovery budget",
     )
     p.add_argument(
         "--metrics-out",
@@ -432,6 +482,35 @@ def serve_main(argv=None) -> int:
     if args.k_candidates:
         k_candidates = [int(x) for x in args.k_candidates.split(",") if x.strip()]
 
+    plan = None
+    if args.fault_plan:
+        from ..sched import FaultPlan
+
+        try:
+            plan = FaultPlan.from_json(args.fault_plan)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load --fault-plan: {e}", file=sys.stderr)
+            return 2
+        if args.fault_seed is not None:
+            plan = plan.model_copy(update={"seed": args.fault_seed})
+
+    # The hardening knobs appear in the scheduler (and the summary) only
+    # when asked for: a plain `serve` replay stays byte-identical to the
+    # pre-chaos service, fault machinery and all.
+    hardened = (
+        plan is not None
+        or args.deadline_ms is not None
+        or args.max_retries
+        or args.breaker_threshold is not None
+    )
+    harden_kw = {}
+    if args.deadline_ms is not None:
+        harden_kw["solve_deadline_s"] = args.deadline_ms / 1e3
+    if args.max_retries:
+        harden_kw["max_retries"] = args.max_retries
+    if args.breaker_threshold is not None:
+        harden_kw["breaker_threshold"] = args.breaker_threshold
+
     sched = Scheduler(
         devices,
         model,
@@ -444,6 +523,7 @@ def serve_main(argv=None) -> int:
         risk_aware=args.risk_aware,
         risk_samples=args.risk_samples,
         risk_seed=args.risk_seed,
+        **harden_kw,
     )
 
     def log_event(ev, view, ms):
@@ -462,17 +542,30 @@ def serve_main(argv=None) -> int:
             f"obj={r.obj_value:.6f} {ms:8.1f} ms{risk}"
         )
 
+    chaos = None
     try:
-        report = replay(sched, events, on_event=log_event)
+        if plan is not None:
+            from ..sched import chaos_replay
+
+            chaos = chaos_replay(sched, events, plan, on_event=log_event)
+            report = _chaos_to_replay_report(chaos, sched)
+        else:
+            report = replay(sched, events, on_event=log_event)
     except (RuntimeError, ValueError) as e:
         print(f"error: replay failed: {e}", file=sys.stderr)
         return 1
+    finally:
+        sched.close()  # release the deadline worker (no-op when unused)
 
     summary = {
         "replay": report.summary(),
         "drift_warm_share": round(drift_warm_share(sched.metrics), 4),
         "metrics": sched.metrics_snapshot(),
     }
+    if hardened:
+        summary["health"] = sched.health_snapshot()
+    if chaos is not None:
+        summary["chaos"] = chaos.summary()
     if args.risk_aware:
         c = sched.metrics.counters
         summary["risk"] = {
@@ -484,6 +577,25 @@ def serve_main(argv=None) -> int:
     print(json.dumps(summary))
     if args.metrics_out:
         Path(args.metrics_out).write_text(json.dumps(summary, indent=2))
+    if args.chaos_check:
+        if chaos is None:
+            print(
+                "error: --chaos-check needs --fault-plan (the soak "
+                "contract is defined over an injected fault schedule)",
+                file=sys.stderr,
+            )
+            return 2
+        violations = chaos.violations(sched.fleet.model.L)
+        if violations:
+            for v in violations:
+                print(f"chaos violation: {v}", file=sys.stderr)
+            return 1
+        print(
+            f"chaos soak OK: {chaos.injected.get('injected_total', 0)} "
+            f"fault(s) injected, {chaos.summary()['quarantined']} "
+            f"quarantined, healthy after {chaos.ticks_to_healthy} clean "
+            "tick(s)"
+        )
     if args.fail_uncertified and (
         report.structural_uncertified or report.failed_ticks
     ):
@@ -495,6 +607,40 @@ def serve_main(argv=None) -> int:
         )
         return 1
     return 0
+
+
+def _chaos_to_replay_report(chaos, sched):
+    """Adapt a ChaosReport to the ReplayReport summary the serve CLI
+    prints, so the chaos path reuses the same summary/exit plumbing.
+
+    Latency stats cover the TRACE events only — injected quarantine
+    round-trips and recovery ticks are near-zero and would flatter the
+    percentiles relative to a plain replay of the same trace (the
+    injected/recovery side lives in the summary's "chaos" block instead).
+    """
+    from ..sched import STRUCTURAL_KINDS, ReplayReport
+    from ..sched.metrics import _quantile
+
+    trace_recs = [r for r in chaos.records if r.source == "trace"]
+    lat = [r.ms for r in trace_recs]
+    srt = sorted(lat)
+    uncert = sum(
+        1
+        for r in trace_recs
+        if r.kind in STRUCTURAL_KINDS
+        and r.view.events_behind == 0
+        and not r.view.result.certified
+    )
+    total_s = sum(r.ms for r in chaos.records) / 1e3
+    return ReplayReport(
+        views=chaos.views,
+        latencies_ms=lat,
+        events_per_sec=len(lat) / total_s if total_s > 0 else 0.0,
+        p50_ms=_quantile(srt, 0.50),
+        p99_ms=_quantile(srt, 0.99),
+        structural_uncertified=uncert,
+        failed_ticks=sched.metrics.counters["tick_failed"],
+    )
 
 
 def main(argv=None) -> int:
@@ -586,10 +732,10 @@ def main(argv=None) -> int:
             return 2
 
     if args.per_k:
-        if args.backend != "jax" or expert_loads is not None or warm is not None:
+        if expert_loads is not None or warm is not None:
             print(
-                "error: --per-k needs --backend jax and cannot combine "
-                "with --expert-loads or --warm-from",
+                "error: --per-k cannot combine with --expert-loads or "
+                "--warm-from",
                 file=sys.stderr,
             )
             return 2
@@ -602,6 +748,7 @@ def main(argv=None) -> int:
                 k_candidates=k_candidates,
                 mip_gap=args.mip_gap,
                 kv_bits=args.kv_bits,
+                backend=args.backend,
                 moe={"auto": None, "on": True, "off": False}[args.moe],
                 max_rounds=args.max_rounds,
                 beam=args.beam,
@@ -609,6 +756,7 @@ def main(argv=None) -> int:
                 ipm_warm_iters=args.ipm_warm_iters,
                 node_cap=args.node_cap,
                 batch_size=args.batch_size,
+                time_limit=args.time_limit,
                 debug=args.debug,
                 plot=args.plot,
             )

@@ -66,7 +66,13 @@ def measure_interconnect(
         slices = {0: list(devs)}
         info.num_slices = 1
     if len(devs) < 2:
-        return info
+        return info  # provenance stays "unmeasured": nothing was probed
+
+    # Provenance: collectives over host-platform virtual devices time the
+    # host's memory system, not any interconnect — mark them so the saved
+    # profile can never pass virtual numbers off as a measured link.
+    platform = str(getattr(devs[0], "platform", "") or "")
+    info.provenance = "virtual" if platform == "cpu" else "measured"
 
     # ICI: collectives inside ONE slice (the largest with >=2 devices);
     # with a single slice that is simply all devices.

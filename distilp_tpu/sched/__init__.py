@@ -9,8 +9,12 @@ one call at a time; nothing owns a fleet over time. This package does:
   (fleet, model) identity in a bounded LRU pool, drift events riding warm/
   margin ticks, structural events re-solving (warm when the identity was
   seen before, cold otherwise), latest certified placement always served;
-- ``metrics``   — per-tick counters + latency histograms as a plain dict;
-- ``sim``       — deterministic churn scenario generator + trace replay.
+- ``metrics``   — per-tick counters + latency histograms as a plain dict,
+  plus the health-state vocabulary (healthy/degraded/broken);
+- ``sim``       — deterministic churn scenario generator + trace replay;
+- ``faults``    — seeded fault injection (solver exceptions, latency
+  spikes, NaN poisoning, malformed events, dropout bursts) and the
+  chaos-replay soak that certifies the degraded-serving path.
 
 The design target is the restarted-PDHG observation (arXiv:2407.16144)
 packaged as infrastructure (arXiv:2412.09734): repeated nearby solves
@@ -18,6 +22,15 @@ should keep their warm state alive across invocations, which only a
 long-lived process can do.
 """
 
+from .faults import (
+    FAULT_KINDS,
+    ChaosReport,
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    InjectedSolverFault,
+    chaos_replay,
+)
 from .events import (
     DRIFT_KINDS,
     STRUCTURAL_KINDS,
@@ -33,7 +46,14 @@ from .events import (
     write_trace,
 )
 from .fleet import FleetState
-from .metrics import LatencyHist, SchedulerMetrics
+from .metrics import (
+    HEALTH_BROKEN,
+    HEALTH_DEGRADED,
+    HEALTH_HEALTHY,
+    HEALTH_STATES,
+    LatencyHist,
+    SchedulerMetrics,
+)
 from .scheduler import PlacementView, Scheduler, WarmPool, drift_warm_share
 from .sim import ReplayReport, generate_trace, replay
 
@@ -53,6 +73,10 @@ __all__ = [
     "FleetState",
     "SchedulerMetrics",
     "LatencyHist",
+    "HEALTH_HEALTHY",
+    "HEALTH_DEGRADED",
+    "HEALTH_BROKEN",
+    "HEALTH_STATES",
     "Scheduler",
     "WarmPool",
     "drift_warm_share",
@@ -60,4 +84,11 @@ __all__ = [
     "ReplayReport",
     "generate_trace",
     "replay",
+    "FAULT_KINDS",
+    "FaultSpec",
+    "FaultPlan",
+    "FaultInjector",
+    "InjectedSolverFault",
+    "ChaosReport",
+    "chaos_replay",
 ]

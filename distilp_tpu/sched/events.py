@@ -19,6 +19,7 @@ path were built for (see ``solver.streaming``).
 from __future__ import annotations
 
 import json
+import math
 from pathlib import Path
 from typing import Annotated, Dict, List, Literal, Optional, Sequence, Union
 
@@ -126,3 +127,87 @@ def read_trace(path: str | Path) -> List:
             if line:
                 events.append(event_from_dict(json.loads(line)))
     return events
+
+
+# -- input validation: the quarantine gate ---------------------------------
+#
+# Pydantic accepts float('nan')/inf in float fields, so a NaN-poisoned
+# profile or a contradictory scale survives schema validation and would
+# reach the solver's coefficient builders, where one non-finite entry
+# poisons every bound in the sweep. The scheduler calls validate_event()
+# on every event BEFORE mutating its fleet; a non-None return quarantines
+# the event (counted, recorded, fleet untouched).
+
+
+def non_finite_path(value, path: str = "") -> Optional[str]:
+    """Dotted path of the first non-finite float inside a dumped payload.
+
+    Walks dicts/lists/tuples of plain JSON-able values (the shape
+    ``model_dump()`` produces); bools are ints in Python and always fine.
+    """
+    if isinstance(value, bool):
+        return None
+    if isinstance(value, (int, float)):
+        if not math.isfinite(value):
+            return path or "<value>"
+        return None
+    if isinstance(value, dict):
+        for k, v in value.items():
+            hit = non_finite_path(v, f"{path}.{k}" if path else str(k))
+            if hit is not None:
+                return hit
+    elif isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            hit = non_finite_path(v, f"{path}[{i}]")
+            if hit is not None:
+                return hit
+    return None
+
+
+def validate_event(event) -> Optional[str]:
+    """Reason this event must be quarantined, or None when it is sane.
+
+    Catches what the pydantic schema cannot: non-finite floats anywhere in
+    the payload and contradictory values (non-positive multiplicative
+    scales, empty/degenerate load vectors). Structural contradictions
+    against the LIVE fleet (leave of an unknown device, duplicate join)
+    are ``FleetState.apply``'s job — it raises, and the scheduler treats
+    that raise as a quarantine too.
+    """
+    if isinstance(event, DeviceDegrade):
+        for fld in ("t_comm_scale", "bandwidth_scale", "mem_scale"):
+            v = getattr(event, fld)
+            if not math.isfinite(v):
+                return f"degrade.{fld} is non-finite ({v!r})"
+            if v <= 0 and fld != "mem_scale":
+                return f"degrade.{fld} must be > 0 (got {v!r})"
+        if event.mem_scale < 0:
+            return f"degrade.mem_scale must be >= 0 (got {event.mem_scale!r})"
+    elif isinstance(event, LoadTick):
+        for name, f in event.t_comm_jitter.items():
+            if not math.isfinite(f) or f <= 0:
+                return f"load.t_comm_jitter[{name!r}] invalid ({f!r})"
+        if event.expert_loads is not None:
+            if not event.expert_loads:
+                return "load.expert_loads is empty"
+            for i, v in enumerate(event.expert_loads):
+                if not math.isfinite(v) or v < 0:
+                    return f"load.expert_loads[{i}] invalid ({v!r})"
+            if sum(event.expert_loads) <= 0:
+                return "load.expert_loads sums to zero"
+    elif isinstance(event, DeviceJoin):
+        if not event.device.name:
+            return "join carries an unnamed device"
+        hit = non_finite_path(event.device.model_dump())
+        if hit is not None:
+            return f"join.device.{hit} is non-finite"
+    elif isinstance(event, ModelSwap):
+        if event.model.L <= 0:
+            return f"model_swap.model.L must be > 0 (got {event.model.L})"
+        hit = non_finite_path(event.model.model_dump())
+        if hit is not None:
+            return f"model_swap.model.{hit} is non-finite"
+    elif isinstance(event, DeviceLeave):
+        if not event.name:
+            return "leave names no device"
+    return None

@@ -112,17 +112,57 @@ class FleetState:
                     if cap is not None:
                         setattr(dev, pool, int(cap * s))
         elif isinstance(event, LoadTick):
+            # Check-THEN-mutate: the scheduler's quarantine path promises a
+            # rejected event left the fleet untouched, so every jitter name
+            # must be validated before the first t_comm (or expert_loads)
+            # write — raising halfway through would leave a partially
+            # applied event behind a "fleet untouched" record.
+            unknown = [n for n in event.t_comm_jitter if n not in self.devices]
+            if unknown:
+                raise ValueError(
+                    f"load jitter on unknown device {unknown[0]!r}"
+                )
             if event.expert_loads is not None:
                 self.model.expert_loads = list(event.expert_loads)
             for name, factor in event.t_comm_jitter.items():
-                dev = self.devices.get(name)
-                if dev is None:
-                    raise ValueError(f"load jitter on unknown device {name!r}")
-                dev.t_comm = max(0.0, dev.t_comm * factor)
+                self.devices[name].t_comm = max(
+                    0.0, self.devices[name].t_comm * factor
+                )
         else:
             raise TypeError(f"not a fleet event: {type(event).__name__}")
         self.seq += 1
         return is_structural(event)
+
+    # -- solvability guard (the quarantine gate's second layer) -----------
+
+    # Scalar fields the coefficient builders consume directly: one NaN here
+    # poisons every bound of the sweep. The per-tick check scans ONLY these
+    # scalars (not the throughput tables — those enter via join/model_swap
+    # events, whose full payloads are walked once at event validation), so
+    # it is O(M) cheap enough to run unconditionally.
+    _SCALAR_FIELDS = (
+        "t_comm", "comm_latency", "comm_bandwidth", "T_cpu", "s_disk",
+        "t_kvcpy_cpu", "t_kvcpy_gpu", "t_ram2vram", "t_vram2ram",
+    )
+
+    def non_finite_reason(self) -> str | None:
+        """First non-finite solver-consumed scalar, or None when clean.
+
+        The scheduler refuses to solve (and serves its last-known-good
+        placement) when this returns a reason: a poisoned fleet state must
+        never reach ``build_coeffs``.
+        """
+        import math
+
+        for name, dev in self.devices.items():
+            for fld in self._SCALAR_FIELDS:
+                v = getattr(dev, fld)
+                if v is not None and not math.isfinite(v):
+                    return f"device {name!r} field {fld} is non-finite ({v!r})"
+        loads = self.model.expert_loads
+        if loads is not None and any(not math.isfinite(v) for v in loads):
+            return "model.expert_loads contains a non-finite entry"
+        return None
 
     def _ensure_head(self) -> None:
         """Exactly one head device, and it is the first in ring order.

@@ -18,6 +18,44 @@ from typing import Dict, List
 
 TICK_MODES = ("cold", "warm", "margin")
 
+# Service health, coarsest first. The scheduler owns the transitions
+# (scheduler._note_fault / _on_clean_tick); this module owns the vocabulary
+# so metrics consumers and the serve CLI agree on the strings.
+#
+# - ``healthy``  — recent ticks solved fresh, no outstanding faults;
+# - ``degraded`` — serving, but on stale/fallback answers (quarantined
+#   input, deadline miss, failed or retried solves) until a clean streak
+#   clears it;
+# - ``broken``   — the circuit breaker is open: solves are suspended and
+#   every tick serves the last-known-good placement until the half-open
+#   probe succeeds.
+HEALTH_HEALTHY = "healthy"
+HEALTH_DEGRADED = "degraded"
+HEALTH_BROKEN = "broken"
+HEALTH_STATES = (HEALTH_HEALTHY, HEALTH_DEGRADED, HEALTH_BROKEN)
+
+# Counter names the fault-hardened serving path increments; listed here so
+# dashboards (and the chaos harness's accounting pass) can enumerate them
+# without grepping the scheduler. Injection-side ``fault_injected_*`` /
+# ``fault_fired_*`` counters come from sched.faults with the kind appended.
+FAULT_COUNTERS = (
+    "events_quarantined",  # events rejected before touching the fleet
+    "quarantine_fleet",  # non-finite fleet state refused a solve
+    "deadline_missed",  # solve abandoned at the wall-clock deadline
+    "deadline_backlog",  # tick skipped: an abandoned solve still running
+    "abandoned_solves_drained",  # overrun solves that finished and were discarded
+    "solve_retries",  # retry attempts after a solve exception
+    "solve_retry_success",  # ticks saved by a retry
+    "breaker_open",  # breaker transitions to open
+    "breaker_short_circuit",  # ticks served degraded with the breaker open
+    "breaker_half_open_probe",  # probe solves attempted from half-open
+    "breaker_close",  # probe succeeded; breaker closed
+    "breaker_reopen",  # probe failed; breaker re-opened
+    "served_stale",  # views served as mode='stale'
+    "served_degraded",  # views served as mode='degraded'
+    "health_recovered",  # degraded/broken -> healthy transitions
+)
+
 
 def _quantile(sorted_vals: List[float], q: float) -> float:
     """Nearest-rank quantile on an already-sorted list (no numpy needed)."""

@@ -22,14 +22,75 @@ escalation ladder's job and its outcome is recorded per tick.
 from __future__ import annotations
 
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Callable, NamedTuple, Optional, Sequence, Tuple
 
 from ..common import DeviceProfile, ModelProfile
 from ..solver.result import HALDAResult
 from ..solver.streaming import StreamingReplanner
+from .events import validate_event
 from .fleet import FleetState
-from .metrics import SchedulerMetrics
+from .metrics import (
+    HEALTH_BROKEN,
+    HEALTH_DEGRADED,
+    HEALTH_HEALTHY,
+    SchedulerMetrics,
+)
+
+
+class _DeadlineMiss(Exception):
+    """Internal: the tick's solve overran its wall-clock deadline (or an
+    earlier abandoned solve is still occupying the worker)."""
+
+
+class _SolveWorker:
+    """One DAEMON thread executing solve attempts for the deadline path.
+
+    A deadline-abandoned solve cannot be interrupted (it is deep inside
+    jit'd device code); it keeps running here and is discarded. The thread
+    is a daemon precisely so an abandoned solve never blocks process exit
+    (a ThreadPoolExecutor's non-daemon workers are joined at interpreter
+    shutdown — a CLI would 'finish' and then hang for the rest of the
+    abandoned compile). Single worker on purpose: solves on one scheduler
+    are serialized, so an abandoned solve has always COMPLETED before the
+    next one starts and the planner's warm state is never written by two
+    solves at once.
+    """
+
+    def __init__(self) -> None:
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="sched-solve"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, box, done = item
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # dlint: disable=DLP017 not swallowed: re-raised by _attempt_deadline from the box
+                box["exc"] = e
+            finally:
+                done.set()
+
+    def submit(self, fn):
+        """-> (box, done): ``done.wait(timeout)`` then read the box."""
+        import threading
+
+        box: dict = {}
+        done = threading.Event()
+        self._q.put((fn, box, done))
+        return box, done
+
+    def stop(self) -> None:
+        self._q.put(None)
 
 
 # Serving-side perturbation model for risk-aware candidate scoring: modest
@@ -60,7 +121,10 @@ class PlacementView(NamedTuple):
     age_s: float  # wall-clock seconds since publication
     # 'cold' | 'warm' | 'margin' tick that produced it; 'risk' when the
     # risk-aware selector served a candidate OTHER than that tick's fresh
-    # solve (a cached incumbent or per-k alternative).
+    # solve (a cached incumbent or per-k alternative). Under degraded
+    # serving the field is REWRITTEN on the published view: 'stale' when a
+    # deadline miss (or poisoned fleet state) re-served the last-known-good
+    # placement, 'degraded' while the open circuit breaker skips solves.
     mode: str
     # Problem identity at publication time. For mode == 'risk' the served
     # placement may have been SOLVED under an earlier identity/tick — the
@@ -151,6 +215,14 @@ class Scheduler:
         risk_samples: int = 256,
         risk_seed: int = 0,
         risk_mc: Optional[dict] = None,
+        solve_deadline_s: Optional[float] = None,
+        max_retries: int = 0,
+        retry_backoff_s: float = 0.05,
+        retry_backoff_max_s: float = 1.0,
+        breaker_threshold: int = 5,
+        breaker_cooldown: int = 3,
+        healthy_after: int = 3,
+        fault_hook: Optional[Callable[[int], None]] = None,
     ):
         self.fleet = FleetState(list(devices), model)
         self.mip_gap = mip_gap
@@ -188,6 +260,34 @@ class Scheduler:
         self.pool = WarmPool(
             warm_pool_size, self._make_replanner, metrics=self.metrics
         )
+        # -- fault-hardened serving (see README "Degraded-mode semantics").
+        # All knobs default OFF/neutral: with no deadline, no retries and no
+        # injected faults the tick path below is bit-for-bit the old one —
+        # the chaos machinery must be zero-cost when disabled.
+        self.solve_deadline_s = solve_deadline_s
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_max_s = retry_backoff_max_s
+        # Breaker: opens after `breaker_threshold` CONSECUTIVE solve
+        # failures (exceptions after retries, or deadline misses); while
+        # open, `breaker_cooldown` ticks serve degraded without solving at
+        # all, then one half-open probe solve decides close vs re-open.
+        # threshold <= 0 disables the breaker entirely.
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        self.healthy_after = healthy_after
+        # Test/chaos seam: called (with the 0-based attempt index) before
+        # every solve attempt; raising injects a solve failure, sleeping
+        # injects a latency spike. None in production.
+        self.fault_hook = fault_hook
+        self.health = HEALTH_HEALTHY
+        self.quarantined: "deque[tuple]" = deque(maxlen=100)
+        self._consec_failures = 0
+        self._clean_streak = 0
+        self._breaker_open = False
+        self._breaker_cooldown_left = 0
+        self._executor = None  # lazy; only a deadline needs the worker
+        self._abandoned = None  # future of a deadline-abandoned solve
         self._published: Optional[PlacementView] = None
         self._published_at: float = 0.0
         if solve_on_init:
@@ -215,27 +315,84 @@ class Scheduler:
         (no feasible placement for the mutated fleet) keeps the previous
         placement published and is visible as ``tick_failed`` + a growing
         ``events_behind`` on ``latest()``.
+
+        Input quarantine: an event carrying non-finite or contradictory
+        values (``events.validate_event``), or one the strict
+        ``FleetState.apply`` rejects (unknown device, duplicate join, ...),
+        never mutates the fleet — it is counted, recorded on
+        ``self.quarantined``, and the last-known-good placement stays
+        served. Before any placement exists a poisoned event is still an
+        error: there is nothing safe to serve instead.
         """
-        structural = self.fleet.apply(event)
+        reason = validate_event(event)
+        if reason is not None:
+            return self._quarantine(event, reason)
+        try:
+            structural = self.fleet.apply(event)
+        except (ValueError, TypeError) as e:
+            return self._quarantine(event, f"{type(e).__name__}: {e}")
         self.metrics.inc("events_total")
         self.metrics.inc(f"event_{event.kind}")
         self.metrics.inc("structural_events" if structural else "drift_events")
         return self._tick(structural=structural)
 
+    def _quarantine(self, event, reason: str) -> PlacementView:
+        """Record a rejected event and keep serving the last-known-good."""
+        kind = getattr(event, "kind", type(event).__name__)
+        self.metrics.inc("events_quarantined")
+        self.metrics.inc(f"quarantine_{kind}")
+        self.quarantined.append((self.fleet.seq, kind, reason))
+        self._last_error = f"quarantined {kind}: {reason}"
+        self._note_fault()
+        if self._published is None:
+            raise ValueError(
+                f"poisoned {kind} event before any placement was published "
+                f"({reason}); nothing safe to serve"
+            )
+        return self.latest()
+
     def _tick(self, structural: Optional[bool]) -> PlacementView:
         """One replan; ``structural=None`` marks the eventless init solve
         (it times and mode-counts like any tick but belongs to neither
         routing class, so the per-class counters keep summing to events)."""
+        # Second quarantine layer: a poisoned fleet state (however it got
+        # here) must never reach build_coeffs. Cheap O(M) scalar scan.
+        # Both short-circuits run BEFORE pool.get: a tick that will not
+        # solve must not mint (or LRU-evict) warm planners, nor skew the
+        # pool hit-rate counters.
+        bad = self.fleet.non_finite_reason()
+        if bad is not None:
+            self.metrics.inc("quarantine_fleet")
+            self._last_error = f"fleet state quarantined: {bad}"
+            self._note_fault()
+            if self._published is None:
+                raise ValueError(f"fleet state is poisoned: {bad}")
+            return self._serve_stale("stale")
+        # Circuit breaker: while open, cooldown ticks serve degraded with
+        # no solve at all; the tick after cooldown falls through as the
+        # half-open probe.
+        probing = False
+        if self._breaker_open:
+            if self._breaker_cooldown_left > 0:
+                self._breaker_cooldown_left -= 1
+                self.metrics.inc("breaker_short_circuit")
+                return self._serve_stale("degraded")
+            probing = True
+            self.metrics.inc("breaker_half_open_probe")
         key = self.fleet.key()
         planner, _hit = self.pool.get(key)
         devs = self.fleet.device_list()
         t0 = time.perf_counter()
         tick_tm: dict = {}
         try:
-            result = planner.step(
-                devs, self.fleet.model, k_candidates=self.k_candidates,
-                timings=tick_tm,
+            result = self._solve_with_guards(planner, devs, tick_tm)
+        except _DeadlineMiss:
+            self.metrics.inc("deadline_missed")
+            self._last_error = (
+                f"solve deadline ({self.solve_deadline_s:.3f}s) missed"
             )
+            self._solve_failed(probing)
+            return self._serve_stale("stale")
         except (RuntimeError, ValueError, NotImplementedError) as e:
             self.metrics.inc("tick_failed")
             if structural is not None:
@@ -244,9 +401,11 @@ class Scheduler:
                     else "tick_failed_drift"
                 )
             self._last_error = f"{type(e).__name__}: {e}"
+            self._solve_failed(probing)
             if self._published is None:
                 raise
             return self.latest()
+        self._on_clean_solve(probing)
         ms = (time.perf_counter() - t0) * 1e3
         self.metrics.observe("event_to_placement", ms)
         # Device-program work accounting (JAX backend): how many Mehrotra
@@ -257,6 +416,11 @@ class Scheduler:
             self.metrics.observe(
                 "ipm_iters_executed", tick_tm["ipm_iters_executed"]
             )
+        # The in-solver certification ladder (halda_solve retrying an
+        # uncertified dense solve at the MoE-class budget) reports through
+        # the timings dict; count it so escalation storms are visible.
+        if tick_tm.get("escalated"):
+            self.metrics.inc("solver_escalations")
         mode = getattr(planner, "last_tick_mode", None) or "cold"
         if structural is not None:
             self.metrics.observe(
@@ -287,6 +451,178 @@ class Scheduler:
         )
         self._published_at = time.monotonic()
         return self._published
+
+    # -- fault-hardened solve path ----------------------------------------
+
+    def _solve_with_guards(self, planner, devs, tick_tm: dict):
+        """One tick's solve under the reliability policy: optional fault
+        hook, bounded exponential-backoff retries, wall-clock deadline.
+
+        With every knob at its default (no deadline, no retries, no hook)
+        this is exactly ``planner.step(...)`` — one call, no threads, no
+        copies. The first-ever solve is exempt from the deadline: with
+        nothing published there is no last-known-good to serve instead,
+        so abandoning the solve could only turn a slow start into an
+        outage.
+        """
+        deadline = self.solve_deadline_s if self._published is not None else None
+        attempts = max(1, self.max_retries + 1)
+        last_exc: Optional[Exception] = None
+        for attempt in range(attempts):
+            if attempt:
+                self.metrics.inc("solve_retries")
+                time.sleep(
+                    min(
+                        self.retry_backoff_s * (2 ** (attempt - 1)),
+                        self.retry_backoff_max_s,
+                    )
+                )
+            try:
+                if deadline is None:
+                    result = self._attempt(planner, devs, self.fleet.model,
+                                           tick_tm, attempt)
+                else:
+                    result = self._attempt_deadline(planner, devs, tick_tm,
+                                                    attempt, deadline)
+            except _DeadlineMiss:
+                raise  # a miss is a tick-level outcome, not retryable
+            except (RuntimeError, ValueError, NotImplementedError) as e:
+                self.metrics.inc("solve_attempt_failed")
+                last_exc = e
+                continue
+            if attempt:
+                self.metrics.inc("solve_retry_success")
+            return result
+        raise last_exc  # every attempt failed
+
+    def _attempt(self, planner, devs, model, tick_tm: dict, attempt: int):
+        if self.fault_hook is not None:
+            self.fault_hook(attempt)
+        return planner.step(
+            devs, model, k_candidates=self.k_candidates, timings=tick_tm
+        )
+
+    def _attempt_deadline(self, planner, devs, tick_tm, attempt, deadline):
+        """Run the attempt on the daemon worker, bounded by the deadline.
+
+        An overrun solve cannot be interrupted (it is deep inside jit'd
+        device code); it is *abandoned*: the service serves stale and the
+        worker finishes in the background (daemon thread — it can never
+        block process exit). The next tick first drains the abandoned
+        attempt (bounded by one more deadline) before dispatching fresh
+        work — one solve in flight, ever, so planner warm state is never
+        written by two solves at once. Device/model profiles are
+        deep-copied for the worker because later events mutate them in
+        place while an abandoned solve may still be reading them. Known
+        skew, accepted: an abandoned solve that eventually finishes still
+        reports its tick through the shared metrics sink (record_tick)
+        even though its result is discarded — the drain counter
+        (``abandoned_solves_drained``) bounds how many ticks that can be.
+        """
+        if self._executor is None:
+            self._executor = _SolveWorker()
+        if self._abandoned is not None:
+            box, done = self._abandoned
+            if not done.wait(timeout=deadline):
+                self.metrics.inc("deadline_backlog")
+                raise _DeadlineMiss()
+            # Finished (result or failure): either way it was already
+            # billed as a deadline miss; discard and move on.
+            self.metrics.inc("abandoned_solves_drained")
+            self._abandoned = None
+        devs_snap = [d.model_copy(deep=True) for d in devs]
+        model_snap = self.fleet.model.model_copy(deep=True)
+        box, done = self._executor.submit(
+            lambda: self._attempt(planner, devs_snap, model_snap, tick_tm,
+                                  attempt)
+        )
+        if not done.wait(timeout=deadline):
+            self._abandoned = (box, done)
+            raise _DeadlineMiss()
+        if "exc" in box:
+            raise box["exc"]
+        return box["result"]
+
+    def _solve_failed(self, probing: bool) -> None:
+        """Consecutive-failure + breaker bookkeeping after a failed tick."""
+        self._consec_failures += 1
+        self._note_fault()
+        if probing:
+            # Half-open probe failed: straight back to open, full cooldown.
+            self.metrics.inc("breaker_reopen")
+            self._breaker_cooldown_left = self.breaker_cooldown
+            return
+        if (
+            self.breaker_threshold > 0
+            and not self._breaker_open
+            and self._consec_failures >= self.breaker_threshold
+        ):
+            self._breaker_open = True
+            self._breaker_cooldown_left = self.breaker_cooldown
+            self.metrics.inc("breaker_open")
+            self.health = HEALTH_BROKEN
+
+    def _on_clean_solve(self, probing: bool) -> None:
+        """A solve succeeded: close the breaker (if probing) and advance
+        the recovery streak toward healthy."""
+        self._consec_failures = 0
+        if probing:
+            self._breaker_open = False
+            self._breaker_cooldown_left = 0
+            self.metrics.inc("breaker_close")
+            self.health = HEALTH_DEGRADED  # until the streak clears it
+        self._clean_streak += 1
+        if (
+            self.health != HEALTH_HEALTHY
+            and not self._breaker_open
+            and self._clean_streak >= self.healthy_after
+        ):
+            self.health = HEALTH_HEALTHY
+            self.metrics.inc("health_recovered")
+
+    def _note_fault(self) -> None:
+        """Any fault (quarantine, miss, failure) degrades health and resets
+        the clean streak; an open breaker pins health at broken."""
+        self._clean_streak = 0
+        self.health = HEALTH_BROKEN if self._breaker_open else HEALTH_DEGRADED
+
+    def _serve_stale(self, mode: str) -> PlacementView:
+        """Re-serve the last-known-good placement under a degraded mode.
+
+        The published view's ``mode`` is rewritten ('stale' | 'degraded')
+        so readers of ``latest()`` see HOW the current answer is being
+        served, not how it was once produced; ``seq``/``events_behind``
+        already carry how far behind it is.
+        """
+        if self._published is None:
+            raise RuntimeError(
+                "no placement published yet; cannot serve a stale answer"
+            )
+        if self._published.mode != mode:
+            self._published = self._published._replace(mode=mode)
+        self.metrics.inc(f"served_{mode}")
+        return self.latest()
+
+    def health_snapshot(self) -> dict:
+        """Plain-dict health view for the serve CLI / metrics endpoint."""
+        return {
+            "state": self.health,
+            "breaker_open": self._breaker_open,
+            "breaker_cooldown_left": self._breaker_cooldown_left,
+            "consecutive_failures": self._consec_failures,
+            "clean_streak": self._clean_streak,
+            "quarantined_events": len(self.quarantined),
+            "last_error": self._last_error,
+        }
+
+    def close(self) -> None:
+        """Release the deadline worker (no-op when never used). The worker
+        is a daemon thread, so even without close() an abandoned solve
+        cannot block process exit."""
+        if self._executor is not None:
+            self._executor.stop()
+            self._executor = None
+            self._abandoned = None
 
     def _risk_select(self, devs, fresh: HALDAResult, planner):
         """Score the fresh solve + cached pool incumbents on the twin.

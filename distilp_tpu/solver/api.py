@@ -181,6 +181,16 @@ def halda_solve(
     Returns the assignment minimizing the modeled per-round latency, with
     ``certified``/``gap`` reporting the optimality certificate; raises
     ``RuntimeError`` if no candidate k admits a feasible assignment.
+
+    Certification escalation (JAX backend): a dense solve that misses the
+    mip-gap certificate while EVERY search knob above is None retries once
+    at the MoE-class budget (cap 256 / beam 16 / 26 IPM iterations),
+    warm-seeded from the uncertified incumbent, before returning — so
+    one-shot callers get the same ladder ``StreamingReplanner`` always
+    had, without knowing the knobs. ``timings['escalated']`` reports it;
+    passing any explicit budget disables it (the caller owns the
+    trade-off). An escalated retry that still misses returns honestly
+    uncertified.
     """
     import time as _time
 
@@ -218,6 +228,55 @@ def halda_solve(
             timings=timings,
             margin_state=margin_state,
         )
+        # In-solver certification escalation (the ladder one-shot callers
+        # could never reach while it lived only in StreamingReplanner,
+        # VERDICT r5 item 4): a DENSE solve that missed its certificate at
+        # the class-default budgets retries ONCE at the MoE-class budget —
+        # the largest budget the backend ships — warm-seeded from the
+        # uncertified incumbent so the retry prunes from round one. Only
+        # when every search knob was left at None: explicit budgets mean
+        # the caller owns the trade-off, and the MoE class already runs
+        # the full budget (re-running it would just double the cost).
+        defaults_used = all(
+            v is None
+            for v in (max_rounds, beam, ipm_iters, ipm_warm_iters, node_cap)
+        )
+        if (
+            best is not None
+            and not best.certified
+            and defaults_used
+            and arrays.moe is None
+        ):
+            from .backend_jax import BEAM, IPM_ITERS, MAX_ROUNDS, NODE_CAP
+
+            if debug:
+                print(
+                    f"  escalating: gap {best.gap} uncertified at default "
+                    f"budgets; retrying at cap={NODE_CAP} beam={BEAM} "
+                    f"iters={IPM_ITERS}"
+                )
+            results2, best2 = solve_sweep_jax(
+                arrays,
+                [(k, model.L // k) for k in Ks],
+                mip_gap=mip_gap if mip_gap is not None else 1e-4,
+                coeffs=coeffs,
+                debug=debug,
+                warm=best,
+                max_rounds=MAX_ROUNDS,
+                beam=BEAM,
+                ipm_iters=IPM_ITERS,
+                # Disable the warm-iteration truncation too: the escalated
+                # attempt is the last line of defense before an honest
+                # uncertified return, so it gets the full cold budget
+                # everywhere.
+                ipm_warm_iters=IPM_ITERS,
+                node_cap=NODE_CAP,
+                timings=timings,
+            )
+            if best2 is not None:
+                results, best = results2, best2
+            if timings is not None:
+                timings["escalated"] = 1
         for k, res in zip(Ks, results):
             per_k_objs.append((k, res.obj_value if res is not None else None))
             if debug:
@@ -431,6 +490,7 @@ def halda_solve_per_k(
     k_candidates: Optional[Iterable[int]] = None,
     mip_gap: Optional[float] = 1e-4,
     kv_bits: str = "8bit",
+    backend: Backend = "jax",
     moe: Optional[bool] = None,
     max_rounds: Optional[int] = None,
     beam: Optional[int] = None,
@@ -439,11 +499,12 @@ def halda_solve_per_k(
     node_cap: Optional[int] = None,
     load_factors: Optional[Sequence[float]] = None,
     batch_size: int = 1,
+    time_limit: Optional[float] = 3600.0,
     debug: bool = False,
     plot: bool = False,
     timings: Optional[dict] = None,
 ) -> List[HALDAResult]:
-    """Certified optimum for EVERY feasible k, in one device dispatch.
+    """Certified optimum for EVERY feasible k.
 
     ``halda_solve`` answers "what is THE best placement" — losing segment
     counts prune early against the global incumbent and report objectives
@@ -456,20 +517,50 @@ def halda_solve_per_k(
 
     Structurally infeasible k's (fewer layers per segment than devices) and
     k's proven infeasible by the search are omitted from the returned list.
-    JAX backend only.
+
+    ``backend='jax'`` solves the whole family in one device dispatch;
+    ``backend='cpu'`` loops the scipy/HiGHS oracle over the k grid (exact
+    per-k optima, ``time_limit`` seconds each; the search knobs are JAX
+    knobs and are ignored) so ``--per-k`` works on installs without the
+    JAX backend.
     """
+    Ks, sets, coeffs, arrays = _build_instance(
+        devs, model, k_candidates, kv_bits, moe, load_factors, batch_size
+    )
+
+    if backend == "cpu":
+        out: List[HALDAResult] = []
+        for k in Ks:
+            try:
+                res = solve_fixed_k_cpu(
+                    arrays, k, model.L // k, time_limit=time_limit,
+                    mip_gap=mip_gap,
+                )
+            except Infeasible:
+                if debug:
+                    print(f"  k={k:<4d}  obj=infeasible")
+                continue
+            if debug:
+                print(f"  k={k:<4d}  obj={res.obj_value:.6f}")
+            out.append(_best_to_result(res, sets))
+        if plot and out:
+            from .plotter import plot_k_curve
+
+            plot_k_curve(
+                [(r.k, r.obj_value) for r in out],
+                k_star=min(out, key=lambda r: r.obj_value).k,
+            )
+        return out
+    if backend != "jax":
+        raise ValueError(f"Unknown backend {backend!r}; expected 'cpu' or 'jax'")
+
     try:
         from .backend_jax import solve_sweep_jax
     except ImportError as e:
         raise NotImplementedError(
             "The JAX backend is not available in this build "
-            f"(import failed: {e}); per-k optima need it (the CPU backend "
-            "can loop solve_fixed_k_cpu directly)."
+            f"(import failed: {e}); use halda_solve_per_k(backend='cpu')."
         ) from e
-
-    Ks, sets, coeffs, arrays = _build_instance(
-        devs, model, k_candidates, kv_bits, moe, load_factors, batch_size
-    )
     results, _ = solve_sweep_jax(
         arrays,
         [(k, model.L // k) for k in Ks],

@@ -30,6 +30,7 @@ frontier overflow is tracked honestly via ``dropped_bound``.
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass
 from functools import partial
@@ -42,6 +43,43 @@ import jax
 # Certificates (bounds, incumbents, thresholds) are float64; the search and
 # IPM iterations are float32. x64 must be enabled for the f64 half.
 jax.config.update("jax_enable_x64", True)
+
+
+def _configure_compile_cache() -> None:
+    """Env-gated persistent compilation cache (VERDICT r5 item 3).
+
+    A fresh process pays seconds of jit compilation per static layout; for
+    a "real-time re-placement" service that must survive restarts, that is
+    the restart cost. ``DISTILP_COMPILE_CACHE=<dir>`` points JAX's
+    persistent compilation cache at a directory so a restarted process
+    reloads compiled programs in milliseconds instead. Opt-in (the cache
+    trades disk + a hash lookup per compile), configured here because this
+    module is the first backend contact of every solve path and the config
+    must land before the first trace. Failures degrade to uncached
+    compiles, never to a broken solver.
+    """
+    cache_dir = os.environ.get("DISTILP_COMPILE_CACHE")
+    if not cache_dir:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache every program: the solver's jit'd programs are small but
+        # slow to build, exactly the shape the default thresholds skip.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # pragma: no cover - jax-version dependent
+        import warnings
+
+        warnings.warn(
+            f"DISTILP_COMPILE_CACHE={cache_dir!r} could not be applied "
+            f"({type(e).__name__}: {e}); continuing without a persistent "
+            "compilation cache",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+
+
+_configure_compile_cache()
 
 import jax.numpy as jnp  # noqa: E402
 

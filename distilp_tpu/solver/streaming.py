@@ -318,9 +318,17 @@ class StreamingReplanner:
         # place between ticks (t_comm drifts, expert_loads refresh), and
         # collect()'s fallback re-solve plus the MoE mapping must price THIS
         # tick's state, not whatever the profiles have drifted to by redeem
-        # time.
-        devs_snap = [d.model_copy(deep=True) for d in devs]
-        model_snap = model.model_copy(deep=True)
+        # time. SHALLOW copies (VERDICT r5 item 5): a pydantic model_copy()
+        # re-binds every top-level field, which freezes exactly what the
+        # streaming drift idiom touches — scalar fields are mutated in
+        # place (t_comm *= ...), container fields are REPLACED (expert_loads
+        # = [...]) — without duplicating the model's per-layer arrays and
+        # throughput tables every tick (that deep copy was most of the
+        # off-tunnel pipelined-vs-sync regression). A caller that mutates a
+        # nested container in place between submit and collect leaks into
+        # the snapshot; no solver or sched path does.
+        devs_snap = [d.model_copy() for d in devs]
+        model_snap = model.model_copy()
         self._in_flight.append(
             (pending, shape, devs_snap, model_snap, loads, k_candidates,
              factors, warm)

@@ -35,7 +35,7 @@ def findings_for(code, relpath, src):
 def test_registry_has_all_rule_codes():
     expected = {
         "DLP001", "DLP002", "DLP010", "DLP011",
-        "DLP012", "DLP013", "DLP014", "DLP015", "DLP016",
+        "DLP012", "DLP013", "DLP014", "DLP015", "DLP016", "DLP017",
     }
     assert expected <= set(RULES)
     for code, rule in RULES.items():
@@ -644,6 +644,65 @@ def test_fixed_scan_without_cholesky_ok():
 
             out, _ = jax.lax.scan(body, vals, None, length=M)
             return out
+        """)
+    assert out == []
+
+
+# --------------------------------------------------------------------------
+# DLP017 — no silent except handlers in the scheduler service layer
+
+
+def test_silent_except_in_sched_flagged():
+    out = findings_for("DLP017", "distilp_tpu/sched/newpart.py", """\
+        def tick(self):
+            try:
+                self.solve()
+            except RuntimeError:
+                pass
+        """)
+    assert len(out) == 1 and "metrics sink" in out[0].message
+
+
+def test_except_recording_through_metrics_ok():
+    out = findings_for("DLP017", "distilp_tpu/sched/newpart.py", """\
+        def tick(self):
+            try:
+                self.solve()
+            except RuntimeError:
+                self.metrics.inc("tick_failed")
+        """)
+    assert out == []
+
+
+def test_except_reraising_ok():
+    out = findings_for("DLP017", "distilp_tpu/sched/newpart.py", """\
+        def tick(self):
+            try:
+                self.solve()
+            except RuntimeError as e:
+                raise ValueError("bad tick") from e
+        """)
+    assert out == []
+
+
+def test_except_delegating_to_quarantine_recorder_ok():
+    out = findings_for("DLP017", "distilp_tpu/sched/scheduler2.py", """\
+        def handle(self, event):
+            try:
+                self.fleet.apply(event)
+            except ValueError as e:
+                return self._quarantine(event, str(e))
+        """)
+    assert out == []
+
+
+def test_silent_except_outside_sched_not_flagged():
+    out = findings_for("DLP017", "distilp_tpu/solver/x.py", """\
+        def f(self):
+            try:
+                self.solve()
+            except RuntimeError:
+                pass
         """)
     assert out == []
 

@@ -564,3 +564,143 @@ def test_scenario_batched_moe_warm_with_duals(profiles_dir):
         assert sum(w.y) == model.n_routed_experts
         tol = 2 * gap * abs(c.obj_value) + 1e-9
         assert abs(w.obj_value - c.obj_value) <= tol
+
+
+def test_per_k_cpu_backend_matches_jax(profiles_dir):
+    """halda_solve_per_k(backend='cpu'): the HiGHS loop must return the
+    same k set with matching objectives as the one-dispatch JAX sweep
+    (VERDICT r5 item 7 — --per-k without a JAX install)."""
+    from distilp_tpu.common import load_model_profile
+    from distilp_tpu.solver.api import halda_solve_per_k
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(4, seed=11)
+    gap = 1e-3
+    ks = [4, 8, 10]
+    via_jax = halda_solve_per_k(
+        devs, model, k_candidates=ks, mip_gap=gap, kv_bits="4bit"
+    )
+    via_cpu = halda_solve_per_k(
+        devs, model, k_candidates=ks, mip_gap=gap, kv_bits="4bit",
+        backend="cpu",
+    )
+    assert [r.k for r in via_cpu] == [r.k for r in via_jax]
+    for c, j in zip(via_cpu, via_jax):
+        assert c.certified  # HiGHS optima are exact
+        assert sum(c.w) * c.k == model.L
+        tol = 2 * gap * abs(c.obj_value) + 1e-9
+        assert abs(j.obj_value - c.obj_value) <= tol, (
+            f"k={c.k}: cpu {c.obj_value} vs jax {j.obj_value}"
+        )
+    with pytest.raises(ValueError, match="backend"):
+        halda_solve_per_k(devs, model, k_candidates=ks, backend="nope")
+
+
+def test_halda_solve_escalates_uncertified_dense_defaults(profiles_dir, monkeypatch):
+    """The in-solver certification ladder (VERDICT r5 item 4): a dense
+    solve that misses its certificate at the class-default budgets retries
+    once at the MoE-class budget before returning. Starving the DENSE
+    defaults (frontier beam 1, 2 IPM iterations — well past the documented
+    beam-4/6-iters edges) makes the first attempt miss deterministically;
+    plain halda_solve must come back certified anyway, reporting the
+    escalation, while explicit caller budgets stay honest (no silent
+    override of an owner's trade-off)."""
+    import numpy as np
+
+    import distilp_tpu.solver.backend_jax as bj
+    from distilp_tpu.common import load_model_profile
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(6, seed=11)
+    rng = np.random.default_rng(11)
+    for d in devs:
+        d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.3, 3.0)))
+
+    orig = bj.default_search_params
+    monkeypatch.setattr(
+        bj,
+        "default_search_params",
+        lambda moe, n_k: (max(10, n_k), 1, 2) if not moe else orig(moe, n_k),
+    )
+    gap = 1e-3
+    tm: dict = {}
+    got = halda_solve(
+        devs, model, mip_gap=gap, kv_bits="4bit", backend="jax", timings=tm
+    )
+    assert got.certified
+    assert tm.get("escalated") == 1
+    ref = halda_solve(devs, model, mip_gap=gap, kv_bits="4bit", backend="cpu")
+    tol = 2 * gap * abs(ref.obj_value) + 1e-9
+    assert abs(got.obj_value - ref.obj_value) <= tol
+
+    # Explicit budgets: the caller owns the trade-off — no escalation,
+    # honest certificate either way.
+    tm2: dict = {}
+    explicit = halda_solve(
+        devs, model, mip_gap=gap, kv_bits="4bit", backend="jax",
+        node_cap=10, beam=1, ipm_iters=2, timings=tm2,
+    )
+    assert tm2.get("escalated") is None
+    if not explicit.certified:
+        assert explicit.gap is None or explicit.gap > gap  # honest miss
+
+
+def test_fuzz_dense_defaults_always_certify(profiles_dir):
+    """No dense fuzz instance may return uncertified through plain
+    halda_solve at default budgets — the documented budget edges are now
+    backstopped by the in-solver escalation ladder, so the honest-but-
+    uncertified window at defaults is closed."""
+    import numpy as np
+
+    from distilp_tpu.common import load_model_profile
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    for seed in (11, 23, 37):
+        rng = np.random.default_rng(seed)
+        M = int(rng.choice([3, 5, 8]))
+        devs = make_synthetic_fleet(M, seed=seed)
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.3, 3.0)))
+            d.s_disk = max(1e6, d.s_disk * float(rng.uniform(0.3, 3.0)))
+            d.d_avail_ram = max(
+                int(1e9), int(d.d_avail_ram * rng.uniform(0.5, 2.0))
+            )
+        got = halda_solve(
+            devs, model, mip_gap=1e-3, kv_bits="4bit", backend="jax"
+        )
+        assert got.certified, f"seed {seed} (M={M}) uncertified at defaults"
+        assert sum(got.w) * got.k == model.L
+
+
+def test_compile_cache_env_gate(tmp_path):
+    """DISTILP_COMPILE_CACHE (VERDICT r5 item 3) must point JAX's
+    persistent compilation cache at the directory — checked in a fresh
+    subprocess because the config must land at first backend import, and
+    this process has long since imported jax."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["DISTILP_COMPILE_CACHE"] = str(tmp_path)
+    env["JAX_PLATFORMS"] = "cpu"
+    src = (
+        "import distilp_tpu.solver.backend_jax, jax; "
+        "print('CACHE', jax.config.jax_compilation_cache_dir)"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", src],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    line = [ln for ln in out.stdout.splitlines() if ln.startswith("CACHE ")]
+    assert line and line[0].split(" ", 1)[1] == str(tmp_path)

@@ -716,3 +716,54 @@ class UnguardedBackendEntryPoint(Rule):
                     if self._touches_backend(f"{mod}.{a.name}"):
                         return node.lineno
         return None
+
+
+@register
+class SilentExceptInScheduler(Rule):
+    code = "DLP017"
+    name = "silent-except-in-sched"
+    rationale = (
+        "The scheduler service is the layer that PROMISES observability "
+        "under faults (README degraded-mode semantics: every fault is "
+        "counted, health is derived from counters). A `try/except` in "
+        "distilp_tpu/sched/ that neither re-raises nor records through the "
+        "metrics sink swallows exactly the signal the chaos soak audits — "
+        "a fault recovers 'successfully' while the counters (and therefore "
+        "HealthState and every dashboard) claim nothing happened."
+    )
+
+    _PATH_PREFIX = "distilp_tpu/sched/"
+    # Attribute calls that count as recording through the metrics sink.
+    # `_quarantine` is the scheduler's fault recorder (it increments the
+    # quarantine counters and the health state); delegating to it from a
+    # handler IS the accounting.
+    _SINK_METHODS = {"inc", "observe", "record_tick", "_quarantine"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.relpath.startswith(self._PATH_PREFIX) or ctx.is_test:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if self._handler_accounts(node):
+                continue
+            yield Finding(
+                ctx.relpath,
+                node.lineno,
+                self.code,
+                "except handler in sched/ neither re-raises nor records "
+                "through the metrics sink (.inc/.observe/.record_tick); "
+                "silent recovery hides faults from HealthState and the "
+                "chaos soak's accounting",
+            )
+
+    def _handler_accounts(self, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if node.func.attr in self._SINK_METHODS:
+                    return True
+        return False
