@@ -93,6 +93,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="frontier capacity; overflow floors the certificate (jax backend)",
     )
     p.add_argument(
+        "--lp-backend", choices=["ipm", "pdhg", "auto"], default="auto",
+        help="LP relaxation engine (jax backend): ipm = batched "
+        "interior-point (dense Cholesky per node — fastest on small "
+        "fleets), pdhg = matrix-free restarted Halpern PDHG (no "
+        "factorizations — the only engine that fits M=512-4096 fleets), "
+        "auto = pdhg at fleet scale, ipm below (default). The chosen "
+        "engine lands in timings/metrics",
+    )
+    p.add_argument(
+        "--pdhg-iters", type=int, default=None,
+        help="first-order iterations per LP relaxation (pdhg engine; "
+        "default 2000 scaled up with fleet size, a quarter of it for warm "
+        "rounds — truncation only loosens bounds, never the certificate's "
+        "validity)",
+    )
+    p.add_argument(
+        "--pdhg-restart-tol", type=float, default=None,
+        help="Halpern restart sufficient-decay factor in (0, 1) (pdhg "
+        "engine; default 0.2 — smaller restarts less often)",
+    )
+    p.add_argument(
         "--batch-size", type=int, default=1,
         help="price dense compute at the profiles' b_N throughput column "
         "(default 1 = reference parity; the model profile must carry the "
@@ -158,6 +179,21 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "seed, Lagrangian duals, root IPM iterates, margin chain) so each "
         "tick solves from scratch; compare against a warm run to measure "
         "the reuse win",
+    )
+    p.add_argument(
+        "--lp-backend", choices=["ipm", "pdhg", "auto"], default="auto",
+        help="LP relaxation engine per tick (jax backend): ipm | pdhg | "
+        "auto (default: pdhg at fleet scale, ipm below); the engine each "
+        "tick ran is counted in the metrics snapshot "
+        "(lp_backend_ipm/lp_backend_pdhg)",
+    )
+    p.add_argument(
+        "--pdhg-iters", type=int, default=None,
+        help="first-order iterations per LP relaxation (pdhg engine)",
+    )
+    p.add_argument(
+        "--pdhg-restart-tol", type=float, default=None,
+        help="Halpern restart sufficient-decay factor (pdhg engine)",
     )
     p.add_argument(
         "--risk-aware",
@@ -520,6 +556,9 @@ def serve_main(argv=None) -> int:
         k_candidates=k_candidates,
         warm_pool_size=args.warm_pool,
         cold_start=args.cold_start,
+        lp_backend=args.lp_backend,
+        pdhg_iters=args.pdhg_iters,
+        pdhg_restart_tol=args.pdhg_restart_tol,
         risk_aware=args.risk_aware,
         risk_samples=args.risk_samples,
         risk_seed=args.risk_seed,
@@ -755,6 +794,9 @@ def main(argv=None) -> int:
                 ipm_iters=args.ipm_iters,
                 ipm_warm_iters=args.ipm_warm_iters,
                 node_cap=args.node_cap,
+                lp_backend=args.lp_backend,
+                pdhg_iters=args.pdhg_iters,
+                pdhg_restart_tol=args.pdhg_restart_tol,
                 batch_size=args.batch_size,
                 time_limit=args.time_limit,
                 debug=args.debug,
@@ -802,6 +844,9 @@ def main(argv=None) -> int:
                 ipm_iters=args.ipm_iters,
                 ipm_warm_iters=args.ipm_warm_iters,
                 node_cap=args.node_cap,
+                lp_backend=args.lp_backend,
+                pdhg_iters=args.pdhg_iters,
+                pdhg_restart_tol=args.pdhg_restart_tol,
                 batch_size=args.batch_size,
             )
         else:
@@ -822,6 +867,9 @@ def main(argv=None) -> int:
                 ipm_iters=args.ipm_iters,
                 ipm_warm_iters=args.ipm_warm_iters,
                 node_cap=args.node_cap,
+                lp_backend=args.lp_backend,
+                pdhg_iters=args.pdhg_iters,
+                pdhg_restart_tol=args.pdhg_restart_tol,
                 batch_size=args.batch_size,
             )
     except ValueError as e:

@@ -1,5 +1,13 @@
 """Numerical kernels (JAX/XLA; Pallas where XLA fusion is not enough)."""
 
 from .ipm import IPMResult, IPMWarmState, LPBatch, ipm_solve_batch
+from .pdhg import PDHGWarmState, pdhg_solve_batch
 
-__all__ = ["LPBatch", "IPMResult", "IPMWarmState", "ipm_solve_batch"]
+__all__ = [
+    "LPBatch",
+    "IPMResult",
+    "IPMWarmState",
+    "PDHGWarmState",
+    "ipm_solve_batch",
+    "pdhg_solve_batch",
+]

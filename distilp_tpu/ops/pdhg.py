@@ -1,0 +1,422 @@
+"""Batched restarted Halpern PDHG for box-constrained LPs — the matrix-free
+fleet-scale sibling of :mod:`distilp_tpu.ops.ipm`.
+
+Same problem family, same batch layout (:class:`~distilp_tpu.ops.ipm.LPBatch`),
+same result contract (:class:`~distilp_tpu.ops.ipm.IPMResult`):
+
+    min c'v   s.t.  A v = b,   l <= v <= u
+
+The IPM factorizes a dense (m, m) normal matrix per iteration per batch
+element — O(B·m²) memory and O(B·m³) FLOPs per round — which caps practical
+fleet size at tens of devices (M=2048 dense HALDA has m≈12k rows: one f32
+normal matrix is ~600 MB, and a beam of them does not fit anywhere). This
+kernel is the first-order alternative the MPAX line of work (arXiv
+2412.09734) shows is natural in JAX: primal-dual hybrid gradient with
+Halpern anchoring and adaptive restarts (r²HPDHG, arXiv 2407.16144; HPR-LP,
+arXiv 2408.12179). Every iteration is two operator applications (A x and
+A' y) — no factorization, no fill-in, O(m·n) shared work per iteration with
+O(B·(m+n)) per-element state. Dense-mode batches share ONE (m, n) A across
+every branch-and-bound node, so fleet-scale memory is the matrix once plus
+vectors per node.
+
+Design notes, mirroring the IPM kernel so the two engines are drop-in
+interchangeable behind ``backend_jax``:
+
+- **Same coordinates.** The internal iteration is column-equilibrated by the
+  box width (shifted to x in [0, 1]^n); warm states carry ORIGINAL
+  coordinates and re-scale on entry, so :class:`PDHGWarmState` and
+  ``IPMWarmState`` are field-for-field interchangeable — the SearchState
+  node-iterate plumbing, the streaming root-warm path and ``HALDAResult.
+  ipm_state`` persistence carry either engine's iterates unchanged.
+- **Same certificate.** The rigorous float64 Lagrangian bound
+  ``L(y) = b'y + sum_j r_j min(0, (c - A'y)_j)`` is valid for ANY dual
+  vector, exactly as in the IPM — branch-and-bound certification logic
+  consumes the result without knowing which engine produced it. The box
+  duals reported for warm-state persistence are the sign-split of the
+  reduced costs (``z - f = c - A'y`` with z, f >= 0), which is what an
+  optimal PDHG dual implies and what the IPM accepts as a warm seed.
+- **Same control flow.** The iteration budget is spent in ``chunk``-sized
+  pieces of a ``lax.while_loop`` whose exit test is the batch-wide
+  convergence flag; ``skip`` freezes elements immediately; a stalled or
+  non-finite element degrades (bound -inf, converged False), never corrupts.
+- **Halpern + restart.** Each step computes the plain PDHG operator T(z)
+  and takes the Halpern average ``z+ = (t+1)/(t+2) T(z) + 1/(t+2) z_anchor``
+  — the anchored sequence converges at the accelerated O(1/t) fixed-point
+  rate. The normalized fixed-point residual ||z - T(z)|| (in the
+  tau/sigma-weighted norm) doubles as the restart criterion: when it decays
+  below ``restart_tol`` times the residual at the current anchor (or first
+  exceeds it — the no-progress guard), the anchor is reset to the current
+  iterate and the Halpern counter restarts. Step sizes are diagonal
+  (Pock-Chambolle): ``tau_j = 0.9 / Σ_i |Ā_ij|``, ``sigma_i = 0.9 /
+  Σ_j |Ā_ij|`` — valid for any matrix with no spectral-norm estimate, and
+  far faster on HALDA's mixed-density rows than a scalar step throttled by
+  the densest (cycle/memory) rows.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+
+# Same rationale as ops/ipm.py: the f64 certificate evaluation below is
+# meaningless if x64 silently downcasts. Enable here, not only in importers.
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from .ipm import BOUND_DTYPE, IPMResult, LPBatch  # noqa: E402
+
+def _default_tol_pdhg(dtype) -> float:
+    """First-order exit tolerance. The IPM's 1e-9 (f64) is a few Newton
+    steps; for PDHG it is ~orders of magnitude more iterations spent long
+    after the bound stopped moving at certification scale (mip_gap is
+    1e-3/1e-4). 1e-7 relative leaves two decades of slack below the
+    tightest gap anyone certifies at; f32 keeps the shared 1e-5 floor."""
+    import jax.numpy as _jnp
+
+    return 1e-7 if dtype == _jnp.float64 else 1e-5
+
+
+# Sufficient-decay factor of the adaptive restart (arXiv 2407.16144 uses
+# beta_sufficient ≈ 0.2): restart when the weighted fixed-point residual
+# drops below restart_tol × the residual at the current anchor.
+DEFAULT_RESTART_TOL = 0.2
+
+
+class PDHGWarmState(NamedTuple):
+    """Warm-start iterate in ORIGINAL coordinates — field-for-field the same
+    contract as :class:`distilp_tpu.ops.ipm.IPMWarmState`, so the two
+    engines' warm states are interchangeable everywhere the solver carries
+    one (B&B node iterates, streaming root warm state, ``ipm_state``
+    persistence). ``z``/``f`` (box duals) are accepted for compatibility —
+    PDHG re-derives its dual geometry from ``v``/``y`` alone — and are
+    emitted on exit as the reduced-cost sign-split so an IPM consumer gets
+    a usable barrier seed. ``ok`` gates each element; any non-finite
+    component falls back to the cold start wholesale."""
+
+    v: jax.Array  # (B, n) primal point (original coordinates)
+    y: jax.Array  # (B, m) row duals (scale-invariant)
+    z: jax.Array  # (B, n) lower-box duals, original units
+    f: jax.Array  # (B, n) upper-box duals, original units
+    ok: jax.Array  # (B,) bool — element carries a usable iterate
+
+
+def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
+                 skip=None, chunk: int = 32):
+    """Restarted Halpern PDHG on one boxed LP. Runs under vmap.
+
+    Mirrors ``_ipm_single``'s contract: ``warm`` seeds from a previous
+    solve's point (projected into THIS box), ``skip`` freezes the element
+    immediately, the budget is spent ``chunk`` iterations at a time under a
+    while loop whose exit is the batch-wide convergence flag, and the
+    returned bound is the f64 Lagrangian bound — valid for whatever dual
+    the iteration reached.
+    """
+    dtype = A.dtype
+    n = A.shape[1]
+    m = A.shape[0]
+
+    r_raw = u - l
+    active = r_raw > 0  # fixed (collapsed-box) columns leave the system
+    b_hat = b - A @ l  # fold lower bounds (incl. fixed values) into the RHS
+
+    # Column equilibration by box width — identical to the IPM kernel, for
+    # the identical reason: branch-and-bound boxes span orders of magnitude
+    # and an unscaled first-order method stalls on the induced anisotropy.
+    # Fixed columns get scale 0 so they contribute nothing to any product.
+    col_s = jnp.where(active, r_raw, 1.0)
+    cs_a = jnp.where(active, r_raw, 0.0)
+    r = jnp.ones_like(r_raw)
+    act = active.astype(dtype)
+    cm = jnp.where(active, c * col_s, 0.0)
+
+    # Row re-equilibration. The assembler's rows arrive max-normalized, but
+    # the box-width column scaling above re-spreads them (a w column scaled
+    # by W ~ 10^3 drags its rows with it): first-order steps — unlike the
+    # IPM's normal equations — see that anisotropy directly as a huge,
+    # lopsided ||A|| and crawl. One inf-norm row pass restores unit-scale
+    # rows. The scaled dual y_s relates to the ORIGINAL-units dual (the one
+    # the f64 certificate, the warm-state contract and the reduced costs
+    # use) by y = row_s · y_s — applied at the warm entry and the exit.
+    # (The abs·scale product fuses into the row reduction — nothing (m, n)
+    # is materialized.)
+    row_s = 1.0 / jnp.maximum(jnp.max(jnp.abs(A) * cs_a[None, :], axis=1), 1e-12)
+    b_s = b_hat * row_s
+
+    # THE fleet-scale invariant: both scalings stay VECTORS and A is only
+    # ever touched through these two operator applications. A per-element
+    # scaled copy (A · col_s · row_s) would be a (B, m, n) tensor — at
+    # M=2048 with a beam of 6 that is ~8 GB, i.e. the exact memory wall
+    # this engine exists to avoid — and it would also turn the batched
+    # matvec into B separate A-streams. With A shared and unbatched under
+    # vmap, XLA batches every opA/opAT into ONE (m, n) × (n, B) product:
+    # the matrix streams once per application for the whole node batch.
+    def opA(x):
+        return row_s * (A @ (cs_a * x))
+
+    def opAT(y):
+        return cs_a * (A.T @ (row_s * y))
+
+    # Diagonal (Pock-Chambolle) step sizes on the scaled operator Ā:
+    # tau_j = θ / Σ_i |Ā_ij|, sigma_i = θ / Σ_j |Ā_ij| with θ = 0.9 — the
+    # induced ||Σ^½ Ā T^½|| is ≤ 1 for ANY matrix, so the PDHG step-size
+    # contract holds with no spectral-norm estimate, and each coordinate
+    # moves at the pace its own coupling allows. On the HALDA LPs this is
+    # the difference between converging and crawling: a scalar 0.9/||Ā||
+    # step is throttled by the densest row (the cycle/memory rows touch
+    # every device) while most columns are nearly decoupled. The 1-norms
+    # are two reductions over |A| — shared across the batch like every
+    # other touch of A, nothing per-element materialized.
+    absA = jnp.abs(A)
+    row_1n = row_s * (absA @ cs_a)
+    col_1n = cs_a * (absA.T @ row_s)
+    # Decoupled coordinates (fixed columns; rows whose every column is
+    # fixed) get step 0, not 0.9/eps: a huge pseudo-step on a zero-coupling
+    # lane would just amplify roundoff (or overflow f32 on an inconsistent
+    # empty row) without moving anything that matters.
+    tau = jnp.where(col_1n > 1e-12, 0.9 / jnp.maximum(col_1n, 1e-12), 0.0)
+    tau = jnp.where(active, tau, 0.0)
+    sigma = jnp.where(row_1n > 1e-12, 0.9 / jnp.maximum(row_1n, 1e-12), 0.0)
+
+    # Cold start: mid-box primal, zero dual (the IPM's start, minus the
+    # barrier interior it does not need).
+    x0 = 0.5 * r
+    y0 = jnp.zeros(m, dtype)
+
+    b_scale = 1.0 + jnp.max(jnp.abs(b_s))
+    c_scale = 1.0 + jnp.max(jnp.abs(cm))
+
+    def T(x, y):
+        """One plain PDHG step: primal projected-gradient, dual ascent at
+        the extrapolated primal. Two operator applications total."""
+        x_new = jnp.clip(x - tau * (cm - opAT(y)), 0.0, r)
+        y_new = y + sigma * (b_s - opA(2.0 * x_new - x))
+        return x_new, y_new
+
+    def weighted_res(dx, dy):
+        # Fixed-point residual in the (diagonal) PDHG norm: Σ dx²/tau +
+        # Σ dy²/sigma with the cross term dropped — the standard restart
+        # gauge. Zero-step lanes never move (dx = dy = 0 there), so they
+        # are excluded rather than divided by zero.
+        qx = jnp.sum(jnp.where(tau > 0, dx * dx, 0.0) / jnp.maximum(tau, 1e-30))
+        qy = jnp.sum(jnp.where(sigma > 0, dy * dy, 0.0) / jnp.maximum(sigma, 1e-30))
+        return jnp.sqrt(qx + qy)
+
+    def conv_of(x, y):
+        """Convergence = primal feasibility + relative duality gap at the
+        CURRENT iterate, both in iteration precision. The f64 certificate
+        is evaluated once at exit, like the IPM's."""
+        rp = b_s - opA(x)
+        obj = jnp.vdot(cm, x)
+        red = cm - opAT(y)
+        lag = jnp.vdot(b_s, y) + jnp.vdot(act, jnp.minimum(0.0, red))
+        gap = jnp.abs(obj - lag)
+        return (jnp.max(jnp.abs(rp)) < tol * b_scale) & (
+            gap < tol * (b_scale + c_scale + jnp.abs(obj))
+        )
+
+    def step(state, _):
+        x, y, xa, ya, res_a, t, done, it = state
+        live = done <= 0.5
+        it = it + live.astype(jnp.int32)
+
+        Tx, Ty = T(x, y)
+        res = weighted_res(Tx - x, Ty - y)
+
+        # Halpern anchoring toward the restart anchor.
+        t_f = t.astype(dtype)
+        w_new = (t_f + 1.0) / (t_f + 2.0)
+        x_h = w_new * Tx + (1.0 - w_new) * xa
+        y_h = w_new * Ty + (1.0 - w_new) * ya
+
+        # Adaptive restart: sufficient decay of the weighted fixed-point
+        # residual vs the anchor (or a blow-up past it — the stall guard).
+        do_restart = (res <= restart_tol * res_a) | (res > res_a)
+        x_n = jnp.where(do_restart, Tx, x_h)
+        y_n = jnp.where(do_restart, Ty, y_h)
+        xa = jnp.where(do_restart, Tx, xa)
+        ya = jnp.where(do_restart, Ty, ya)
+        res_a = jnp.where(do_restart, res, res_a)
+        t = jnp.where(do_restart, 0, t + 1)
+
+        # Non-finite safety: a blown-up step keeps the previous iterate
+        # (the element stalls honestly; the f64 bound of a stalled dual is
+        # still valid, and a NaN dual reports -inf downstream).
+        finite = jnp.all(jnp.isfinite(x_n)) & jnp.all(jnp.isfinite(y_n))
+        x_n = jnp.where(finite, x_n, x)
+        y_n = jnp.where(finite, y_n, y)
+
+        # Freeze converged/skipped elements with a select (0·inf = NaN).
+        frozen = ~live
+        x = jnp.where(frozen, x, x_n)
+        y = jnp.where(frozen, y, y_n)
+        return (x, y, xa, ya, res_a, t, done, it), None
+
+    if warm is not None:
+        # Warm gating, the first-order way. The IPM clips any finite warm
+        # point into the barrier interior and recovers; PDHG has no such
+        # taming — from a dual 1e5 away the O(1/t) Halpern rate needs ~1e5
+        # iterations just to travel home. So the entry test is BEST-OF-TWO:
+        # evaluate the weighted fixed-point residual at the (projected)
+        # warm point and at the cold start, and keep whichever is closer to
+        # a fixed point. A near-optimal carried iterate wins by orders of
+        # magnitude; a stale/absurd one loses and costs exactly two extra
+        # operator applications, never the solve. ok=False or ANY
+        # non-finite component skips straight to cold, as in the IPM. z/f
+        # ride along for plumbing compatibility but carry no PDHG state.
+        v_w, y_w, z_w, f_w, ok_w = warm
+        fin = (
+            ok_w
+            & jnp.all(jnp.isfinite(v_w))
+            & jnp.all(jnp.isfinite(y_w))
+            & jnp.all(jnp.isfinite(z_w))
+            & jnp.all(jnp.isfinite(f_w))
+        )
+        x_w = (jnp.clip(v_w.astype(dtype), l, u) - l) / col_s
+        x_w = jnp.clip(x_w, 0.0, 1.0)
+        y_w = y_w.astype(dtype) / row_s
+        Txw, Tyw = T(x_w, y_w)
+        res_w = weighted_res(Txw - x_w, Tyw - y_w)
+        Txc, Tyc = T(x0, y0)
+        res_c = weighted_res(Txc - x0, Tyc - y0)
+        res_w = jnp.where(jnp.isfinite(res_w), res_w, jnp.inf)
+        use_w = fin & (res_w <= res_c)
+        x0 = jnp.where(use_w, x_w, x0)
+        y0 = jnp.where(use_w, y_w, y0)
+
+    done0 = jnp.zeros((), dtype)
+    if skip is not None:
+        done0 = jnp.where(skip, jnp.ones((), dtype), done0)
+    res0 = weighted_res(*(lambda p: (p[0] - x0, p[1] - y0))(T(x0, y0)))
+    init = (
+        x0, y0, x0, y0, jnp.maximum(res0, 1e-30),
+        jnp.zeros((), jnp.int32), done0, jnp.zeros((), jnp.int32),
+    )
+
+    chunk = max(1, min(int(chunk), iters))
+    n_chunks = -(-iters // chunk)
+
+    def chunk_cond(carry):
+        state, ci = carry
+        return (ci < n_chunks) & (state[6] <= 0.5)
+
+    def chunk_body(carry):
+        state, ci = carry
+        # convergence gate: the fixed-length inner scan is bounded by the
+        # enclosing while_loop's batch-wide done test above. Convergence is
+        # tested ONCE per chunk, not per step — the test itself is two
+        # operator applications, the same price as a whole iteration, so a
+        # per-step test would double the engine's cost for the privilege of
+        # exiting at most chunk-1 iterations earlier. Live elements may run
+        # up to one chunk past convergence; over-iteration is harmless by
+        # the same frozen-solution argument as the IPM's (pinned in tests).
+        state, _ = jax.lax.scan(step, state, None, length=chunk)
+        x, y, xa, ya, res_a, t, done, it = state
+        done = jnp.maximum(done, conv_of(x, y).astype(dtype))
+        return ((x, y, xa, ya, res_a, t, done, it), ci + 1)
+
+    (x, y, _, _, _, _, done, it), _ = jax.lax.while_loop(
+        chunk_cond, chunk_body, (init, jnp.zeros((), jnp.int32))
+    )
+
+    # Final residuals (iteration dtype, diagnostics only; scaled units).
+    rp = b_s - opA(x)
+    red32 = cm - opAT(y)
+    rd = red32 - jnp.minimum(0.0, red32) * act  # dual infeas. of the split
+    mu = jnp.abs(jnp.vdot(cm, x) - (
+        jnp.vdot(b_s, y) + jnp.vdot(act, jnp.minimum(0.0, red32))
+    )) / (b_scale + c_scale)
+    # Back to the original-units dual for the certificate and the warm
+    # state (see the row re-equilibration note above).
+    y = y * row_s
+
+    # The rigorous f64 Lagrangian bound in ORIGINAL units — the SAME formula
+    # and the same soundness argument as the IPM kernel: valid for any y,
+    # so first-order dual quality moves bound tightness, never validity.
+    # f64 ACCUMULATION without an f64 copy of A: `preferred_element_type`
+    # widens the dot products over the f32 matrix in place — the f32 values
+    # ARE the problem data (same as the IPM's cast; the rounding happened
+    # upstream in the pack), and duplicating a fleet-scale A in f64 would
+    # cost more memory than the whole iteration state.
+    y64 = y.astype(BOUND_DTYPE)
+    r64 = (r_raw * act).astype(BOUND_DTYPE)
+    l64 = l.astype(BOUND_DTYPE)
+    c64 = c.astype(BOUND_DTYPE)
+    bh64 = b.astype(BOUND_DTYPE) - jnp.matmul(
+        A, l, preferred_element_type=BOUND_DTYPE
+    )
+    reduced = c64 - jnp.matmul(A.T, y, preferred_element_type=BOUND_DTYPE)
+    bound = bh64 @ y64 + jnp.sum(r64 * jnp.minimum(0.0, reduced))
+    bound = jnp.where(jnp.isfinite(bound), bound, -jnp.inf)
+    shift = c64 @ l64
+    v = l + jnp.where(active, col_s * x, 0.0)
+
+    # Box duals for warm-state persistence: the sign-split of the reduced
+    # costs (z - f = c - A'y, z·f-complementary by construction) in
+    # ORIGINAL units — exactly what the IPM emits at optimality and accepts
+    # (clipped into the barrier interior) as a warm seed.
+    red_orig = reduced.astype(dtype)
+    z_dual = jnp.where(active, jnp.maximum(red_orig, 0.0), 0.0)
+    f_dual = jnp.where(active, jnp.maximum(-red_orig, 0.0), 0.0)
+
+    return IPMResult(
+        v=v,
+        bound=bound + shift,
+        obj=c @ v,
+        rp_norm=jnp.max(jnp.abs(rp)),
+        rd_norm=jnp.max(jnp.abs(rd)),
+        mu=mu,
+        converged=done > 0,
+        reduced=reduced,
+        y_dual=y,
+        z_dual=z_dual,
+        f_dual=f_dual,
+        iters_run=it,
+    )
+
+
+@partial(jax.jit, static_argnames=("iters", "chunk"))
+def pdhg_solve_batch(
+    batch: LPBatch,
+    iters: int = 1000,
+    tol: Optional[float] = None,
+    restart_tol: Optional[float] = None,
+    warm: Optional[PDHGWarmState] = None,
+    skip: Optional[jax.Array] = None,
+    chunk: int = 32,
+) -> IPMResult:
+    """Solve a batch of boxed LPs matrix-free (shared (m, n) or per-instance
+    (B, m, n) A) — the call-compatible first-order sibling of
+    :func:`distilp_tpu.ops.ipm.ipm_solve_batch`.
+
+    Returns the same :class:`IPMResult` contract: per-element primal points,
+    objectives, rigorous float64 Lagrangian lower bounds, and final iterates
+    in original coordinates for cross-solve warm starting. ``warm`` accepts
+    either a :class:`PDHGWarmState` or an ``IPMWarmState`` (identical
+    fields). ``iters`` is the per-element budget, spent ``chunk`` iterations
+    at a time with a batch-wide convergence test between chunks;
+    ``restart_tol`` is the Halpern restart's sufficient-decay factor.
+    """
+    dtype = batch.A.dtype
+    tol_v = _default_tol_pdhg(dtype) if tol is None else tol
+    rt_v = DEFAULT_RESTART_TOL if restart_tol is None else restart_tol
+
+    def single(A, b, c, l, u, wm, sk):
+        return _pdhg_single(
+            A, b, c, l, u, iters, tol_v, rt_v, warm=wm, skip=sk, chunk=chunk
+        )
+
+    # Full f32 accumulation for the same reason as the IPM kernel: a bf16
+    # dual wrecks the Lagrangian bound quality that certification prices.
+    with jax.default_matmul_precision("highest"):
+        a_axis = 0 if batch.A.ndim == 3 else None
+        axes = (
+            a_axis, 0, 0, 0, 0,
+            None if warm is None else 0,
+            None if skip is None else 0,
+        )
+        return jax.vmap(single, in_axes=axes)(
+            batch.A, batch.b, batch.c, batch.l, batch.u, warm, skip
+        )

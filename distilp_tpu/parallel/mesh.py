@@ -81,6 +81,9 @@ def solve_sweep_sharded(
     node_cap: Optional[int] = None,
     per_k: bool = False,
     ipm_warm_iters: Optional[int] = None,
+    lp_backend: Optional[str] = None,
+    pdhg_iters: Optional[int] = None,
+    pdhg_restart_tol: Optional[float] = None,
 ):
     """Run the fused B&B sweep with the frontier sharded across ``mesh``.
 
@@ -126,9 +129,10 @@ def solve_sweep_sharded(
     # spilled node floors its k's certificate), then mesh-align: cap and
     # beam round up to a multiple of the mesh size so every device solves
     # the same number of frontier rows.
-    cap, d_beam, d_iters, d_warm_iters, _ = _resolve_search_params(
+    cap, d_beam, d_iters, d_warm_iters, _, engine = _resolve_search_params(
         sf.moe, len(sf.ks), node_cap, beam, ipm_iters, max_rounds,
         per_k=per_k, ipm_warm_iters=ipm_warm_iters,
+        lp_backend=lp_backend, pdhg_iters=pdhg_iters, M=M,
     )
     cap = pad_cap_to_mesh(max(cap, 2 * len(sf.ks)), mesh)
     beam = min(pad_cap_to_mesh(d_beam, mesh), cap)
@@ -165,6 +169,9 @@ def solve_sweep_sharded(
     data = jax.tree.map(lambda x: jax.device_put(x, replicated), data)
 
     with mesh:
+        fused_kw = {}
+        if pdhg_restart_tol is not None:
+            fused_kw["pdhg_restart_tol"] = pdhg_restart_tol
         state = _solve_fused(
             data,
             state,
@@ -176,5 +183,7 @@ def solve_sweep_sharded(
             per_k=per_k,
             ipm_warm_iters=d_warm_iters,
             root_beam=root_beam,
+            lp_backend=engine,
+            **fused_kw,
         )
     return state, sf

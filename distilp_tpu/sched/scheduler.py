@@ -211,6 +211,9 @@ class Scheduler:
         solve_on_init: bool = False,
         metrics: Optional[SchedulerMetrics] = None,
         cold_start: bool = False,
+        lp_backend: str = "auto",
+        pdhg_iters: Optional[int] = None,
+        pdhg_restart_tol: Optional[float] = None,
         risk_aware: bool = False,
         risk_samples: int = 256,
         risk_seed: int = 0,
@@ -233,6 +236,14 @@ class Scheduler:
         # events, but every tick solves from scratch — the baseline against
         # which warm/margin/iterate reuse is measured.
         self.cold_start = cold_start
+        # LP relaxation engine (`serve --lp-backend`): 'auto' stays on the
+        # IPM for the small fleets this daemon historically served and
+        # flips to matrix-free PDHG at fleet scale; every minted replanner
+        # inherits it, and the engine each tick actually ran is counted
+        # (`lp_backend_ipm`/`lp_backend_pdhg`) next to the tick modes.
+        self.lp_backend = lp_backend
+        self.pdhg_iters = pdhg_iters
+        self.pdhg_restart_tol = pdhg_restart_tol
         # Risk-aware serving (`serve --risk-aware`): every tick scores the
         # fresh solve AND the warm pool's cached incumbents on the digital
         # twin (Monte-Carlo p95 + feasibility-violation penalty, seeded so
@@ -295,12 +306,18 @@ class Scheduler:
             self._tick(structural=None)
 
     def _make_replanner(self) -> StreamingReplanner:
+        search = {"lp_backend": self.lp_backend}
+        if self.pdhg_iters is not None:
+            search["pdhg_iters"] = self.pdhg_iters
+        if self.pdhg_restart_tol is not None:
+            search["pdhg_restart_tol"] = self.pdhg_restart_tol
         planner = StreamingReplanner(
             mip_gap=self.mip_gap,
             kv_bits=self.kv_bits,
             backend=self.backend,
             moe=self.moe,
             cold_start=self.cold_start,
+            search=search,
         )
         planner.metrics = self.metrics  # tick modes funnel into one snapshot
         return planner
@@ -416,6 +433,11 @@ class Scheduler:
             self.metrics.observe(
                 "ipm_iters_executed", tick_tm["ipm_iters_executed"]
             )
+        # LP engine echo: which relaxation engine the tick's solve actually
+        # ran ('auto' resolves per fleet size) — the observable for the
+        # ipm/pdhg crossover in production, next to the tick-mode counters.
+        if "lp_backend" in tick_tm:
+            self.metrics.inc(f"lp_backend_{tick_tm['lp_backend']}")
         # The in-solver certification ladder (halda_solve retrying an
         # uncertified dense solve at the MoE-class budget) reports through
         # the timings dict; count it so escalation storms are visible.
@@ -731,6 +753,12 @@ class Scheduler:
                     kv_bits=self.kv_bits,
                     moe=self.moe,
                     load_factors=load_factors,
+                    # The enumeration honors the same engine pin as the
+                    # tick solves — an operator who pinned away from an
+                    # engine must not get candidates from it.
+                    lp_backend=self.lp_backend,
+                    pdhg_iters=self.pdhg_iters,
+                    pdhg_restart_tol=self.pdhg_restart_tol,
                 )
                 self._risk_per_k_key = key
             except (RuntimeError, ValueError, NotImplementedError):

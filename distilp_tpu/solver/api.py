@@ -130,6 +130,9 @@ def halda_solve(
     load_factors: Optional[Sequence[float]] = None,
     batch_size: int = 1,
     margin_state: Optional[dict] = None,
+    lp_backend: str = "auto",
+    pdhg_iters: Optional[int] = None,
+    pdhg_restart_tol: Optional[float] = None,
 ) -> HALDAResult:
     """Pick the best (k, w, n[, y]) placement over all candidate segment counts.
 
@@ -166,6 +169,17 @@ def halda_solve(
       case: more rounds), never the certificate's validity. Set equal to
       ``ipm_iters`` to disable the truncation.
     - ``node_cap``: frontier capacity (overflow floors the certificate).
+    - ``lp_backend``: LP relaxation engine — ``'ipm'`` (batched
+      interior-point, the small-fleet default), ``'pdhg'`` (matrix-free
+      restarted Halpern PDHG, the fleet-scale engine: no factorizations,
+      so M=512-4096 fleets fit where the IPM's normal matrices cannot), or
+      ``'auto'`` (default; pdhg at or above
+      ``backend_jax.PDHG_AUTO_M`` devices). Both engines share the
+      warm-start plumbing and the f64 Lagrangian certificate; the chosen
+      engine is echoed in ``timings['lp_backend']``.
+    - ``pdhg_iters`` / ``pdhg_restart_tol``: first-order budget per LP
+      relaxation and the Halpern restart's sufficient-decay factor
+      (pdhg engine only; see ``ops/pdhg.py``).
 
     ``timings``: pass a dict to receive the JAX backend's wall-clock
     breakdown (build/pack/upload/solve+fetch milliseconds, see
@@ -213,6 +227,10 @@ def halda_solve(
                 f"(import failed: {e}); use backend='cpu'."
             ) from e
 
+        # One timings dict always exists internally: the escalation ladder
+        # below reads the resolved lp_backend echo out of it even when the
+        # caller passed None.
+        tm = timings if timings is not None else {}
         results, best = solve_sweep_jax(
             arrays,
             [(k, model.L // k) for k in Ks],
@@ -225,8 +243,11 @@ def halda_solve(
             ipm_iters=ipm_iters,
             ipm_warm_iters=ipm_warm_iters,
             node_cap=node_cap,
-            timings=timings,
+            timings=tm,
             margin_state=margin_state,
+            lp_backend=lp_backend,
+            pdhg_iters=pdhg_iters,
+            pdhg_restart_tol=pdhg_restart_tol,
         )
         # In-solver certification escalation (the ladder one-shot callers
         # could never reach while it lived only in StreamingReplanner,
@@ -239,7 +260,10 @@ def halda_solve(
         # the full budget (re-running it would just double the cost).
         defaults_used = all(
             v is None
-            for v in (max_rounds, beam, ipm_iters, ipm_warm_iters, node_cap)
+            for v in (
+                max_rounds, beam, ipm_iters, ipm_warm_iters, node_cap,
+                pdhg_iters,
+            )
         )
         if (
             best is not None
@@ -247,14 +271,35 @@ def halda_solve(
             and defaults_used
             and arrays.moe is None
         ):
-            from .backend_jax import BEAM, IPM_ITERS, MAX_ROUNDS, NODE_CAP
+            from .backend_jax import (
+                BEAM, IPM_ITERS, MAX_ROUNDS, NODE_CAP, default_pdhg_iters,
+            )
 
+            engine = tm.get("lp_backend", "ipm")
             if debug:
                 print(
                     f"  escalating: gap {best.gap} uncertified at default "
                     f"budgets; retrying at cap={NODE_CAP} beam={BEAM} "
-                    f"iters={IPM_ITERS}"
+                    f"engine={engine}"
                 )
+            # Per-engine escalated budgets: the IPM gets the MoE-class
+            # interior-point budget with the warm-iteration truncation
+            # disabled (the escalated attempt is the last line of defense
+            # before an honest uncertified return, so every IPM round gets
+            # the full cold budget); a PDHG solve gets 4x its first-order
+            # budget (its knobs are a different unit — 26 Mehrotra steps
+            # is never what a first-order escalation means). The 4x is on
+            # top of the RESOLVED size-aware default (which already scales
+            # with fleet size, see _resolve_search_params) — a flat
+            # 4·PDHG_ITERS would be a budget CUT at fleet scale — and its
+            # warm rounds derive as a quarter of it (ipm_warm_iters is an
+            # IPM knob the pdhg path ignores), i.e. each escalated warm
+            # round runs the ORIGINAL full cold budget.
+            esc_kw = (
+                {"pdhg_iters": 4 * default_pdhg_iters(len(devs))}
+                if engine == "pdhg"
+                else {"ipm_iters": IPM_ITERS, "ipm_warm_iters": IPM_ITERS}
+            )
             results2, best2 = solve_sweep_jax(
                 arrays,
                 [(k, model.L // k) for k in Ks],
@@ -264,19 +309,15 @@ def halda_solve(
                 warm=best,
                 max_rounds=MAX_ROUNDS,
                 beam=BEAM,
-                ipm_iters=IPM_ITERS,
-                # Disable the warm-iteration truncation too: the escalated
-                # attempt is the last line of defense before an honest
-                # uncertified return, so it gets the full cold budget
-                # everywhere.
-                ipm_warm_iters=IPM_ITERS,
                 node_cap=NODE_CAP,
-                timings=timings,
+                timings=tm,
+                lp_backend=engine,
+                pdhg_restart_tol=pdhg_restart_tol,
+                **esc_kw,
             )
             if best2 is not None:
                 results, best = results2, best2
-            if timings is not None:
-                timings["escalated"] = 1
+            tm["escalated"] = 1
         for k, res in zip(Ks, results):
             per_k_objs.append((k, res.obj_value if res is not None else None))
             if debug:
@@ -352,6 +393,9 @@ def halda_solve_async(
     load_factors: Optional[Sequence[float]] = None,
     batch_size: int = 1,
     margin_state: Optional[dict] = None,
+    lp_backend: str = "auto",
+    pdhg_iters: Optional[int] = None,
+    pdhg_restart_tol: Optional[float] = None,
 ) -> PendingHalda:
     """Dispatch a HALDA solve and return without waiting for the result.
 
@@ -388,6 +432,9 @@ def halda_solve_async(
         node_cap=node_cap,
         collect=False,
         margin_state=margin_state,
+        lp_backend=lp_backend,
+        pdhg_iters=pdhg_iters,
+        pdhg_restart_tol=pdhg_restart_tol,
     )
     if not isinstance(pending, PendingSweep):
         # Plain (results, None) tuple: structurally infeasible sweep
@@ -413,6 +460,9 @@ def halda_solve_scenarios(
     load_factors_list: Optional[Sequence[Optional[Sequence[float]]]] = None,
     timings: Optional[dict] = None,
     batch_size: int = 1,
+    lp_backend: str = "auto",
+    pdhg_iters: Optional[int] = None,
+    pdhg_restart_tol: Optional[float] = None,
 ) -> List[HALDAResult]:
     """Solve S what-if variants of one fleet in a single device dispatch.
 
@@ -474,6 +524,9 @@ def halda_solve_scenarios(
         ipm_warm_iters=ipm_warm_iters,
         node_cap=node_cap,
         timings=timings,
+        lp_backend=lp_backend,
+        pdhg_iters=pdhg_iters,
+        pdhg_restart_tol=pdhg_restart_tol,
     )
 
     results: List[HALDAResult] = []
@@ -503,6 +556,9 @@ def halda_solve_per_k(
     debug: bool = False,
     plot: bool = False,
     timings: Optional[dict] = None,
+    lp_backend: str = "auto",
+    pdhg_iters: Optional[int] = None,
+    pdhg_restart_tol: Optional[float] = None,
 ) -> List[HALDAResult]:
     """Certified optimum for EVERY feasible k.
 
@@ -574,6 +630,9 @@ def halda_solve_per_k(
         debug=debug,
         timings=timings,
         per_k_optima=True,
+        lp_backend=lp_backend,
+        pdhg_iters=pdhg_iters,
+        pdhg_restart_tol=pdhg_restart_tol,
     )
     out = [
         _best_to_result(res, sets)

@@ -84,6 +84,7 @@ _configure_compile_cache()
 import jax.numpy as jnp  # noqa: E402
 
 from ..ops.ipm import IPMWarmState, LPBatch, ipm_solve_batch  # noqa: E402
+from ..ops.pdhg import DEFAULT_RESTART_TOL, pdhg_solve_batch  # noqa: E402
 from .assemble import INACTIVE_RHS, MilpArrays, VarLayout  # noqa: E402
 from .coeffs import HaldaCoeffs  # noqa: E402
 from .result import ILPResult  # noqa: E402
@@ -125,6 +126,46 @@ MOE_LOCAL_MOVES_WARM = 2
 DECOMP_STEPS_COLD = 300
 DECOMP_STEPS_WARM = 0
 
+# -- LP relaxation engines (see ops/ipm.py and ops/pdhg.py) ----------------
+# 'ipm'  — batched Mehrotra predictor-corrector: dense (m, m) normal-matrix
+#          Cholesky per iteration. Fastest to a high-accuracy dual on the
+#          small fleets (M up to ~tens) it was built for; memory O(B·m²).
+# 'pdhg' — matrix-free restarted Halpern PDHG: two operator applications
+#          per iteration, no factorization. Memory O(m·n) shared + per-node
+#          vectors, which is what admits M=512-4096 fleets the IPM cannot
+#          touch. Needs more (cheap) iterations per LP.
+# 'auto' — pdhg at or above PDHG_AUTO_M devices, ipm below. The threshold
+#          is a conservative build-time default; `bench.py`'s fleet_scale
+#          section measures the actual crossover on a given box.
+LP_BACKENDS = ("ipm", "pdhg", "auto")
+PDHG_AUTO_M = 128
+# First-order iteration budgets. A PDHG iteration costs two matvecs (vs the
+# IPM's factorization), so budgets are ~2 orders of magnitude larger for
+# comparable dual quality; truncation only LOOSENS bounds, exactly like a
+# truncated IPM (the f64 Lagrangian bound is valid for any dual). Warm
+# rounds start from the parent's iterate and keep a quarter of the budget.
+PDHG_ITERS = 2000
+PDHG_WARM_FLOOR = 200
+
+
+def default_pdhg_iters(M: int) -> int:
+    """Size-aware cold first-order budget — the ONE copy of the scaling
+    rule (the escalation ladder in api.py multiplies it, so an inline
+    re-derivation there could silently drift from this resolution)."""
+    return PDHG_ITERS * max(1, M // 128)
+
+
+def _resolve_lp_backend(lp_backend: Optional[str], M: int) -> str:
+    """'ipm' or 'pdhg' from the public selector (None = 'auto')."""
+    lb = "auto" if lp_backend is None else lp_backend
+    if lb not in LP_BACKENDS:
+        raise ValueError(
+            f"unknown lp_backend {lp_backend!r}; expected one of {LP_BACKENDS}"
+        )
+    if lb == "auto":
+        return "pdhg" if M >= PDHG_AUTO_M else "ipm"
+    return lb
+
 
 def default_search_params(moe: bool, n_k: int) -> Tuple[int, int, int]:
     """(node_cap, beam, ipm_iters) defaults by problem class.
@@ -158,10 +199,20 @@ def _resolve_search_params(
     max_rounds: Optional[int],
     per_k: bool = False,
     ipm_warm_iters: Optional[int] = None,
-) -> Tuple[int, int, int, int, int]:
-    """(cap, beam, ipm_iters, ipm_warm_iters, max_rounds): caller overrides
-    applied over the problem-class defaults — the one resolution rule for
-    every solve path (single-dispatch, async, scenario-batched).
+    lp_backend: Optional[str] = None,
+    pdhg_iters: Optional[int] = None,
+    M: int = 0,
+) -> Tuple[int, int, int, int, int, str]:
+    """(cap, beam, lp_iters, lp_warm_iters, max_rounds, lp_backend): caller
+    overrides applied over the problem-class defaults — the one resolution
+    rule for every solve path (single-dispatch, async, scenario-batched).
+
+    ``lp_backend`` (None = 'auto') selects the LP relaxation engine; the
+    returned element is the CONCRETE engine ('ipm' or 'pdhg' — 'auto'
+    resolves by fleet size ``M`` against ``PDHG_AUTO_M``). Under 'pdhg' the
+    iteration slots carry the first-order budgets (``pdhg_iters`` override,
+    else ``PDHG_ITERS``; warm rounds a quarter of it) — downstream plumbing
+    treats them as the generic per-LP budget of whichever engine runs.
 
     Per-k mode keeps EVERY k's subtree alive to its own certificate, so the
     frontier carries ~n_k concurrent searches: capacity and beam scale with
@@ -179,16 +230,36 @@ def _resolve_search_params(
     if per_k:
         d_cap = max(d_cap, 32 * n_k)
         d_beam = max(d_beam, 4 * n_k)
-    it = ipm_iters if ipm_iters is not None else d_iters
-    warm_it = (
-        ipm_warm_iters if ipm_warm_iters is not None else max(6, it // 2)
-    )
+    engine = _resolve_lp_backend(lp_backend, M)
+    if engine == "pdhg":
+        # First-order budgets: ipm_iters AND ipm_warm_iters are IPM knobs
+        # and deliberately do NOT rescale or truncate a PDHG solve (26 —
+        # or 12 warm — first-order steps is never what a caller meant; a
+        # replanner carrying IPM-era warm truncation across an 'auto'
+        # flip to pdhg would cripple every warm round); pdhg_iters is the
+        # explicit knob and the warm budget is derived from it alone. The
+        # default scales with fleet size: bound tightness at a fixed
+        # first-order iteration count degrades as the LP grows, and a too
+        # loose root bound is paid back MANY times over in extra B&B
+        # rounds (measured at M=256/gap 1e-3: 2000-iter roots grind 34
+        # rounds + an escalation, 391s; 4000-iter roots certify in 3
+        # rounds, 98s). Linear in M/PDHG_AUTO_M·... keeps the M<=128
+        # behaviour identical to the flat default.
+        it = pdhg_iters if pdhg_iters is not None else default_pdhg_iters(M)
+        warm_it = min(it, max(PDHG_WARM_FLOOR, it // 4))
+    else:
+        it = ipm_iters if ipm_iters is not None else d_iters
+        warm_it = (
+            ipm_warm_iters if ipm_warm_iters is not None else max(6, it // 2)
+        )
+        warm_it = min(warm_it, it) if ipm_warm_iters is None else warm_it
     return (
         max(node_cap, n_k) if node_cap is not None else d_cap,
         beam if beam is not None else d_beam,
         it,
-        min(warm_it, it) if ipm_warm_iters is None else warm_it,
+        warm_it,
         max_rounds if max_rounds is not None else MAX_ROUNDS,
+        engine,
     )
 
 
@@ -1258,6 +1329,8 @@ def _bnb_round(
     per_k: bool = False,
     return_res: bool = False,
     ipm_chunk: Optional[int] = None,
+    lp_backend: str = "ipm",
+    pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
 ):
     """One batched branch-and-bound round over the frontier (pure function;
     traced inside the fused solve loop or jitted standalone by callers).
@@ -1328,14 +1401,31 @@ def _bnb_round(
         f=state.node_f[:B],
         ok=state.node_warm[:B],
     )
-    chunk_kw = {} if ipm_chunk is None else {"chunk": ipm_chunk}
-    res = ipm_solve_batch(
-        LPBatch(A=A_p, b=b, c=c, l=lo_p, u=hi_p),
-        iters=ipm_iters,
-        warm=warm,
-        skip=~active_p,
-        **chunk_kw,
-    )
+    lp_batch = LPBatch(A=A_p, b=b, c=c, l=lo_p, u=hi_p)
+    if lp_backend == "pdhg":
+        # Matrix-free engine, same warm-state and result contract (see
+        # ops/pdhg.py). The IPM's full-length-chunk cold-root optimization
+        # (ipm_chunk=iters) is deliberately NOT forwarded: a first-order
+        # budget is 2 orders of magnitude larger and where inside it an
+        # element converges is unknown even cold, so the kernel-default
+        # chunking (batch-wide early exit every few dozen matvecs) is
+        # always the right granularity.
+        res = pdhg_solve_batch(
+            lp_batch,
+            iters=ipm_iters,
+            restart_tol=pdhg_restart_tol,
+            warm=warm,
+            skip=~active_p,
+        )
+    else:
+        chunk_kw = {} if ipm_chunk is None else {"chunk": ipm_chunk}
+        res = ipm_solve_batch(
+            lp_batch,
+            iters=ipm_iters,
+            warm=warm,
+            skip=~active_p,
+            **chunk_kw,
+        )
     bound = res.bound + obj_const
     # A diverged IPM instance reports -inf (see ops/ipm.py); fall back to the
     # inherited parent bound so the node keeps exploring instead of being
@@ -1861,7 +1951,8 @@ _RD_VEC_FIELDS = (
 _PACKED_STATIC_ARGS = (
     "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
     "has_warm", "w_max", "e_max", "decomp_steps", "has_duals", "per_k",
-    "has_margin", "ipm_warm_iters", "has_root_warm",
+    "has_margin", "ipm_warm_iters", "has_root_warm", "lp_backend",
+    "pdhg_restart_tol",
 )
 
 
@@ -1886,6 +1977,8 @@ def _solve_packed_impl(
     has_margin: bool = False,
     ipm_warm_iters: Optional[int] = None,
     has_root_warm: bool = False,
+    lp_backend: str = "ipm",
+    pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
 ) -> jax.Array:
     """One-dispatch sweep: unpack the two blobs (``_pack_static`` stays
     device-resident across streaming ticks; ``_pack_dynamic`` is the per-tick
@@ -2134,6 +2227,8 @@ def _solve_packed_impl(
         ipm_warm_iters=ipm_warm_iters,
         collect_root=True,
         root_warm_chunk=has_root_warm,
+        lp_backend=lp_backend,
+        pdhg_restart_tol=pdhg_restart_tol,
     )
 
     parts = [
@@ -2327,6 +2422,8 @@ def _solve_scenarios_packed(
     has_margin: bool = False,
     ipm_warm_iters: Optional[int] = None,
     has_root_warm: bool = False,
+    lp_backend: str = "ipm",
+    pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
 ) -> jax.Array:
     return jax.vmap(
         lambda dyn: _solve_packed_impl(
@@ -2335,7 +2432,8 @@ def _solve_scenarios_packed(
             has_warm=has_warm, w_max=w_max, e_max=e_max,
             decomp_steps=decomp_steps, has_duals=has_duals, per_k=per_k,
             has_margin=has_margin, ipm_warm_iters=ipm_warm_iters,
-            has_root_warm=has_root_warm,
+            has_root_warm=has_root_warm, lp_backend=lp_backend,
+            pdhg_restart_tol=pdhg_restart_tol,
         )
     )(dyn_blobs)
 
@@ -2386,6 +2484,8 @@ def _run_bnb_loop(
     collect_root: bool = False,
     root_warm_chunk: bool = False,
     root_beam: Optional[int] = None,
+    lp_backend: str = "ipm",
+    pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
 ):
     """B&B rounds with the mip-gap test on-device. The single shared
     definition of the search loop (traced by both the packed single-dispatch
@@ -2447,6 +2547,7 @@ def _run_bnb_loop(
                 data, st, mip_gap, ipm_iters=ipm_iters, beam=B0,
                 moe=moe, per_k=per_k, return_res=True,
                 ipm_chunk=None if root_warm_chunk else ipm_iters,
+                lp_backend=lp_backend, pdhg_restart_tol=pdhg_restart_tol,
             )
             return st2, (
                 ok,
@@ -2475,6 +2576,7 @@ def _run_bnb_loop(
             _bnb_round(
                 data, state, mip_gap, ipm_iters=warm_iters, beam=beam,
                 moe=moe, per_k=per_k,
+                lp_backend=lp_backend, pdhg_restart_tol=pdhg_restart_tol,
             ),
             i + 1,
         )
@@ -2489,7 +2591,7 @@ def _run_bnb_loop(
     jax.jit,
     static_argnames=(
         "ipm_iters", "max_rounds", "beam", "moe", "per_k", "ipm_warm_iters",
-        "root_beam",
+        "root_beam", "lp_backend", "pdhg_restart_tol",
     ),
 )
 def _solve_fused(
@@ -2503,6 +2605,8 @@ def _solve_fused(
     per_k: bool = False,
     ipm_warm_iters: Optional[int] = None,
     root_beam: Optional[int] = None,
+    lp_backend: str = "ipm",
+    pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
 ) -> SearchState:
     """The full branch-and-bound sweep as one device program; the host does
     one dispatch and one fetch per HALDA solve."""
@@ -2517,6 +2621,8 @@ def _solve_fused(
         per_k=per_k,
         ipm_warm_iters=ipm_warm_iters,
         root_beam=root_beam,
+        lp_backend=lp_backend,
+        pdhg_restart_tol=pdhg_restart_tol,
     )
 
 
@@ -2615,8 +2721,19 @@ def solve_sweep_jax(
     per_k_optima: bool = False,
     margin_state: Optional[dict] = None,
     ipm_warm_iters: Optional[int] = None,
+    lp_backend: Optional[str] = None,
+    pdhg_iters: Optional[int] = None,
+    pdhg_restart_tol: Optional[float] = None,
 ):
     """Solve the whole k-sweep on the accelerator.
+
+    ``lp_backend`` picks the LP relaxation engine ('ipm' | 'pdhg' | 'auto',
+    None = 'auto': pdhg at or above ``PDHG_AUTO_M`` devices). Both engines
+    share the warm-start plumbing and the f64 Lagrangian certificate, so
+    everything downstream — pruning, reduced-cost tightening, per-k
+    certification — is engine-agnostic. ``pdhg_iters``/``pdhg_restart_tol``
+    are the first-order budget/restart knobs (ignored under 'ipm'); the
+    chosen engine is echoed as ``timings['lp_backend']``.
 
     ``per_k_optima=True`` switches the search to per-k pruning: every
     feasible k terminates with its OWN certified optimum and full integer
@@ -2674,10 +2791,18 @@ def solve_sweep_jax(
 
     sf = build_standard_form(arrays, coeffs, feasible)
     n_k = len(sf.ks)
-    cap, beam, ipm_iters, ipm_warm_iters, max_rounds = _resolve_search_params(
+    (
+        cap, beam, ipm_iters, ipm_warm_iters, max_rounds, engine,
+    ) = _resolve_search_params(
         sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds,
         per_k=per_k_optima, ipm_warm_iters=ipm_warm_iters,
+        lp_backend=lp_backend, pdhg_iters=pdhg_iters, M=M,
     )
+    restart_tol = (
+        DEFAULT_RESTART_TOL if pdhg_restart_tol is None else pdhg_restart_tol
+    )
+    if timings is not None:
+        timings["lp_backend"] = engine
     warm_tuple, duals_tuple, root_warm_tuple = _warm_and_duals(
         sf, arrays, warm, feasible
     )
@@ -2767,6 +2892,8 @@ def solve_sweep_jax(
         has_margin=has_margin,
         ipm_warm_iters=ipm_warm_iters,
         has_root_warm=root_warm_tuple is not None,
+        lp_backend=engine,
+        pdhg_restart_tol=restart_tol,
     )
     pending = PendingSweep(
         out=out_dev,
@@ -3179,6 +3306,9 @@ def solve_sweep_scenarios(
     node_cap: Optional[int] = None,
     timings: Optional[dict] = None,
     ipm_warm_iters: Optional[int] = None,
+    lp_backend: Optional[str] = None,
+    pdhg_iters: Optional[int] = None,
+    pdhg_restart_tol: Optional[float] = None,
 ) -> List[Tuple[List[Optional[ILPResult]], Optional[ILPResult]]]:
     """Solve S what-if scenarios of ONE fleet in a single device dispatch.
 
@@ -3234,10 +3364,18 @@ def solve_sweep_scenarios(
 
     sf = sfs[0]
     n_k = len(sf.ks)
-    cap, beam, ipm_iters, ipm_warm_iters, max_rounds = _resolve_search_params(
+    (
+        cap, beam, ipm_iters, ipm_warm_iters, max_rounds, engine,
+    ) = _resolve_search_params(
         sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds,
         ipm_warm_iters=ipm_warm_iters,
+        lp_backend=lp_backend, pdhg_iters=pdhg_iters, M=M,
     )
+    restart_tol = (
+        DEFAULT_RESTART_TOL if pdhg_restart_tol is None else pdhg_restart_tol
+    )
+    if timings is not None:
+        timings["lp_backend"] = engine
 
     pairs = [
         _warm_and_duals(
@@ -3307,6 +3445,8 @@ def solve_sweep_scenarios(
         has_duals=use_duals,
         ipm_warm_iters=ipm_warm_iters,
         has_root_warm=use_root_warm,
+        lp_backend=engine,
+        pdhg_restart_tol=restart_tol,
     )
     out_np = np.asarray(jax.device_get(out_dev))
     t3 = _time.perf_counter()

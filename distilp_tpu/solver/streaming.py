@@ -44,7 +44,14 @@ class StreamingReplanner:
 
     # JAX-backend search-budget overrides a replanner may carry across its
     # ticks (None entries fall back to backend_jax.default_search_params).
-    _SEARCH_KEYS = ("max_rounds", "beam", "ipm_iters", "ipm_warm_iters", "node_cap")
+    # lp_backend/pdhg_* select and tune the LP relaxation engine per tick
+    # ('auto' picks matrix-free PDHG at fleet scale; see README "LP
+    # backends") — streaming warm state carries over unchanged either way,
+    # because both engines share the iterate contract.
+    _SEARCH_KEYS = (
+        "max_rounds", "beam", "ipm_iters", "ipm_warm_iters", "node_cap",
+        "lp_backend", "pdhg_iters", "pdhg_restart_tol",
+    )
 
     def __init__(
         self,

@@ -1,5 +1,5 @@
 """Utilities: synthetic fleets, logging/timing helpers."""
 
-from .synthetic import make_synthetic_fleet
+from .synthetic import make_synthetic_fleet, stretch_model_for_fleet
 
-__all__ = ["make_synthetic_fleet"]
+__all__ = ["make_synthetic_fleet", "stretch_model_for_fleet"]

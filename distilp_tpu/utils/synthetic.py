@@ -110,3 +110,18 @@ def make_synthetic_fleet(
             if d.d_avail_cuda is not None:
                 d.d_avail_cuda = int(pool_bytes)
     return devices
+
+
+def stretch_model_for_fleet(model, M: int):
+    """Fleet-scale synthetic instance from a profiled model: stretch the
+    typical-layer scalars to ``L = 2·M`` layers. HALDA places every device
+    (``w_i >= 1``), so an M-device instance needs a model at least as deep
+    as the fleet; 2M keeps two k candidates feasible so the sweep still
+    searches. Per-layer columns are dropped — the typical-layer scalars
+    price every stretched layer. The ONE recipe shared by bench.py's
+    ``fleet_scale`` section and the walkthrough's fleet-scale step, so the
+    two always measure the same instance family."""
+    return model.model_copy(update=dict(
+        L=2 * M, b_layers=None, b_i_layers=None, b_o_layers=None,
+        f_q_layers=None,
+    ))

@@ -184,6 +184,38 @@ def main() -> int:
             f"spearman={ra['spearman']:.3f} "
             f"({ra['pairwise_inversions']} inversions)"
         )
+
+    # ------------------------------------------------------------------
+    # 9. Fleet scale: past ~a hundred devices the IPM's dense per-node
+    #    normal matrices stop fitting, so `lp_backend='auto'` (the default
+    #    everywhere above) switches to the matrix-free restarted Halpern
+    #    PDHG engine — same warm-start plumbing, same f64 Lagrangian
+    #    certificate, no factorizations (README "LP backends"). HALDA
+    #    places every device (w_i >= 1), so a fleet-scale instance needs a
+    #    model at least as deep as the fleet: stretch the 70B profile's
+    #    typical-layer scalars to 2M layers (the same synthetic-instance
+    #    recipe as bench.py's fleet_scale section) and solve a 160-device
+    #    fleet, engine chosen automatically and echoed in timings.
+    # ------------------------------------------------------------------
+    from distilp_tpu.common import load_model_profile
+    from distilp_tpu.utils import stretch_model_for_fleet
+
+    M_big = 160
+    big_model = stretch_model_for_fleet(load_model_profile(
+        REPO / "tests" / "profiles" / "llama_3_70b" / "online"
+        / "model_profile.json"
+    ), M_big)
+    big_fleet = make_synthetic_fleet(M_big, seed=42)
+    tm: dict = {}
+    big = halda_solve(
+        big_fleet, big_model, kv_bits="4bit", mip_gap=1e-3, backend="jax",
+        timings=tm,
+    )
+    print(
+        f"[9] fleet-scale solve (M={M_big}, L={big_model.L}): "
+        f"engine={tm['lp_backend']} k={big.k} obj={big.obj_value:.4f} "
+        f"certified={big.certified} solve={tm['solve_ms']:.0f}ms"
+    )
     return 0
 
 

@@ -6,8 +6,16 @@ from pathlib import Path
 
 import pytest
 
+from conftest import SHARD_MAP_SKIP_REASON, jax_shard_map_available
+
 CONFIGS = Path(__file__).resolve().parent / "configs"
 PROFILES = Path(__file__).resolve().parent / "profiles"
+
+# The device-profiling CLI runs profile_device, whose t_comm measurement is
+# the shard_map interconnect collectives; see SHARD_MAP_SKIP_REASON.
+requires_shard_map = pytest.mark.skipif(
+    not jax_shard_map_available(), reason=SHARD_MAP_SKIP_REASON
+)
 
 
 def test_profiler_cli_model(tmp_path, capsys):
@@ -33,6 +41,7 @@ def test_profiler_cli_model(tmp_path, capsys):
     assert "b_2" in data["f_q"]["decode"]
 
 
+@requires_shard_map
 def test_profiler_cli_device(tmp_path):
     from distilp_tpu.cli.profiler_cli import main
 
@@ -371,6 +380,7 @@ def test_solver_cli_warm_from_conflicts_and_bad_types(tmp_path):
     ) == 2
 
 
+@requires_shard_map
 def test_profiler_cli_raw_out_carries_stats(tmp_path, monkeypatch):
     """--raw-out persists the raw DeviceInfo with measurement spreads and
     capacity provenance — the observability the DeviceProfile mapping drops."""

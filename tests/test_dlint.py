@@ -648,6 +648,105 @@ def test_fixed_scan_without_cholesky_ok():
     assert out == []
 
 
+def test_fixed_scan_matrix_free_operator_flagged():
+    """The PDHG extension: a fixed-length scan whose step applies the
+    operator (`A @ x`) is the same pay-for-converged-work pattern as a
+    fixed Cholesky loop — no factorization call required to trip it."""
+    src = """\
+        import jax
+        import jax.numpy as jnp
+
+        def pdhg(A, b, x, y):
+            def step(state, _):
+                x, y = state
+                x = jnp.clip(x - 0.1 * (A.T @ y), 0.0, 1.0)
+                y = y + 0.1 * (b - A @ x)
+                return (x, y), None
+
+            out, _ = jax.lax.scan(step, (x, y), None, length=1000)
+            return out
+        """
+    out = findings_for("DLP016", "distilp_tpu/ops/firstorder.py", src)
+    assert len(out) == 1 and "matmul" in out[0].message
+    gated = src.replace(
+        "out, _ = jax.lax.scan(",
+        "# convergence gate: chunk bounded by the enclosing while_loop\n"
+        "    out, _ = jax.lax.scan(",
+    )
+    assert findings_for("DLP016", "distilp_tpu/ops/firstorder.py", gated) == []
+
+
+def test_fixed_scan_heavy_helper_resolved_through_call():
+    """Delegating the operator application to a local helper (ops/pdhg.py's
+    ``T`` idiom) must not evade the rule: the name-level call graph is
+    followed to a fixpoint."""
+    out = findings_for("DLP016", "distilp_tpu/ops/firstorder.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        def kernel(A, b, z0):
+            def T(x, y):
+                return x - 0.1 * (A.T @ y), y + 0.1 * (b - A @ x)
+
+            def halpern(x, y):
+                return T(x, y)
+
+            def step(state, _):
+                return halpern(*state), None
+
+            out, _ = jax.lax.scan(step, z0, None, length=500)
+            return out
+        """)
+    assert len(out) == 1
+
+
+def test_fixed_scan_vector_ops_stay_exempt():
+    """Cheap per-step vector arithmetic (vdot, elementwise) is not the
+    pattern: only factorizations and matrix-operator products gate."""
+    out = findings_for("DLP016", "distilp_tpu/ops/firstorder.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        def accumulate(xs, w):
+            def step(acc, x):
+                return acc + jnp.vdot(w, x) * x, None
+
+            out, _ = jax.lax.scan(step, xs[0], None, length=64)
+            return out
+        """)
+    assert out == []
+
+
+def test_host_sync_in_first_order_kernel_flagged():
+    """DLP011 coverage over the pdhg kernel shape: a host-sync float() on
+    the residual inside the traced solve is exactly the per-iteration
+    device->host round trip a matrix-free engine cannot afford."""
+    out = findings_for("DLP011", "distilp_tpu/ops/firstorder.py", """\
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("iters",))
+        def solve(A, b, x, iters):
+            res = jnp.max(jnp.abs(b - A @ x))
+            if float(res) > 1e-6:
+                x = x + 1.0
+            return x
+        """)
+    assert len(out) == 1 and "float()" in out[0].message
+    # The sound shape: return the residual and read it OUTSIDE the trace.
+    ok = findings_for("DLP011", "distilp_tpu/ops/firstorder.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        def driver(A, b, x):
+            solve = jax.jit(lambda x: (x, jnp.max(jnp.abs(b - A @ x))))
+            x, res = solve(x)
+            return x, float(res)
+        """)
+    assert ok == []
+
+
 # --------------------------------------------------------------------------
 # DLP017 — no silent except handlers in the scheduler service layer
 

@@ -150,8 +150,10 @@ def test_timings_breakdown_populated(profiles_dir):
     assert result.certified
     assert set(tm) == {
         "build_ms", "pack_ms", "upload_ms", "solve_ms", "static_hit",
-        "ipm_iters_executed", "bnb_rounds",
+        "ipm_iters_executed", "bnb_rounds", "lp_backend",
     }
+    # The LP engine echo: 'auto' on a 4-device fleet resolves to the IPM.
+    assert tm.pop("lp_backend") == "ipm"
     assert all(v >= 0 for v in tm.values())
     assert tm["build_ms"] > 0
     assert tm["solve_ms"] > 0

@@ -25,10 +25,13 @@ from .core import (
 
 # The only modules allowed to flip jax_enable_x64 (ops/ipm.py:44-51 states
 # the contract: set it before jax.numpy is imported, in the module that
-# owns the f64 certificate math). Tests are exempt from the placement half
-# (they pin their own interpreter-wide config) but not the ordering half.
+# owns the f64 certificate math — both LP engines evaluate the f64
+# Lagrangian certificate, so both kernels are sanctioned). Tests are exempt
+# from the placement half (they pin their own interpreter-wide config) but
+# not the ordering half.
 SANCTIONED_X64_MODULES = {
     "distilp_tpu/ops/ipm.py",
+    "distilp_tpu/ops/pdhg.py",
     "distilp_tpu/solver/backend_jax.py",
 }
 
@@ -551,33 +554,78 @@ class LegacyNumpyRandom(Rule):
 
 
 @register
-class FixedScanCholeskyNeedsGate(Rule):
+class FixedScanHeavyOpNeedsGate(Rule):
     code = "DLP016"
-    name = "fixed-scan-cholesky"
+    name = "fixed-scan-heavy-op"
     rationale = (
-        "A fixed-`length=` lax.scan whose body factorizes (cho_factor) pays "
-        "one Cholesky per step for the WHOLE budget, converged or not — the "
-        "pay-for-converged-work pattern the warm-started IPM rewrite "
-        "removed (ops/ipm.py: the budget is spent in chunks under a "
-        "while_loop whose exit test is batch-wide convergence). New kernels "
-        "in ops//solver/ must either gate the scan the same way or justify "
-        "the fixed length with a nearby 'convergence' comment "
-        "(or `# dlint: disable=DLP016`)."
+        "A fixed-`length=` lax.scan whose body does per-step heavy linear "
+        "algebra — a factorization (cho_factor) like the IPM's, or "
+        "matrix-operator applications (`A @ x` / matmul / einsum / "
+        "tensordot) like the matrix-free PDHG's — pays that cost for the "
+        "WHOLE budget, converged or not: the pay-for-converged-work "
+        "pattern the warm-started IPM rewrite removed and ops/pdhg.py was "
+        "born without (both kernels spend their budget in chunks under a "
+        "while_loop whose exit test is batch-wide convergence). New "
+        "kernels in ops//solver/ must either gate the scan the same way "
+        "or justify the fixed length with a nearby 'convergence' comment "
+        "(or `# dlint: disable=DLP016`). Helper calls are followed one "
+        "call-graph fixpoint deep, so hiding the matmul in a local "
+        "step-function (the PDHG operator idiom) does not evade the rule."
     )
 
     _PATH_PREFIXES = ("distilp_tpu/ops/", "distilp_tpu/solver/")
     _GATE_WORD = "convergence"
+    # Per-step costs worth gating: factorizations and matrix-operator
+    # products. Vector-vector ops spelled jnp.vdot (or plain arithmetic)
+    # stay exempt — a scan of cheap steps is not the pattern this rule
+    # exists for. Operand ranks are invisible to the AST, so `@`/matmul
+    # gates REGARDLESS of rank: a 1-D `w @ x` in a scan body trips it —
+    # spell cheap dots as jnp.vdot (the kernel idiom anyway) or gate it.
+    _HEAVY_CALLS = {"cho_factor", "matmul", "einsum", "tensordot"}
     # A justification comment counts when it sits on the scan call's line
     # or within this many lines above it (the idiom: a short gate comment
     # directly over the call, see ops/ipm.py's chunk body).
     _COMMENT_WINDOW = 3
 
-    def _contains_cho_factor(self, node: ast.AST) -> bool:
+    def _direct_heavy(self, node: ast.AST) -> bool:
         for sub in ast.walk(node):
+            if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.MatMult):
+                return True
             if isinstance(sub, ast.Call):
-                if dotted_name(sub.func).split(".")[-1] == "cho_factor":
+                if dotted_name(sub.func).split(".")[-1] in self._HEAVY_CALLS:
                     return True
         return False
+
+    def _called_names(self, node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                out.add(sub.func.id)
+        return out
+
+    def _heavy_names(self, defs: Dict[str, List[ast.AST]]) -> Set[str]:
+        """Function names whose body is heavy, directly or through calls to
+        other named functions (fixpoint over the name-level call graph —
+        scan bodies routinely delegate the operator application to a local
+        helper, e.g. ops/pdhg.py's ``T``)."""
+        heavy = {
+            name
+            for name, nodes in defs.items()
+            if any(self._direct_heavy(d) for d in nodes)
+        }
+        changed = True
+        while changed:
+            changed = False
+            for name, nodes in defs.items():
+                if name in heavy:
+                    continue
+                calls = set().union(
+                    *(self._called_names(d) for d in nodes)
+                )
+                if calls & heavy:
+                    heavy.add(name)
+                    changed = True
+        return heavy
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         if not any(ctx.relpath.startswith(p) for p in self._PATH_PREFIXES):
@@ -586,6 +634,7 @@ class FixedScanCholeskyNeedsGate(Rule):
         for node in ast.walk(ctx.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 defs.setdefault(node.name, []).append(node)
+        heavy_names = self._heavy_names(defs)
         comments = ctx.comments()
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
@@ -597,15 +646,14 @@ class FixedScanCholeskyNeedsGate(Rule):
                 continue
             body_arg = node.args[0] if node.args else None
             if isinstance(body_arg, ast.Lambda):
-                has_chol = self._contains_cho_factor(body_arg)
-            elif isinstance(body_arg, ast.Name):
-                has_chol = any(
-                    self._contains_cho_factor(d)
-                    for d in defs.get(body_arg.id, [])
+                has_heavy = self._direct_heavy(body_arg) or bool(
+                    self._called_names(body_arg) & heavy_names
                 )
+            elif isinstance(body_arg, ast.Name):
+                has_heavy = body_arg.id in heavy_names
             else:
-                has_chol = False
-            if not has_chol:
+                has_heavy = False
+            if not has_heavy:
                 continue
             gated = any(
                 self._GATE_WORD in comments.get(ln, "").lower()
@@ -619,11 +667,12 @@ class FixedScanCholeskyNeedsGate(Rule):
                 ctx.relpath,
                 node.lineno,
                 self.code,
-                "fixed-length lax.scan whose body calls cho_factor runs the "
-                "full factorization budget even after convergence; bound it "
-                "with a convergence-gated while_loop (see ops/ipm.py) or "
-                "justify the fixed length with a nearby 'convergence' "
-                "comment",
+                "fixed-length lax.scan whose body does per-step heavy "
+                "linear algebra (cho_factor / matmul / `@`) runs the full "
+                "budget even after convergence; bound it with a "
+                "convergence-gated while_loop (see ops/ipm.py and "
+                "ops/pdhg.py) or justify the fixed length with a nearby "
+                "'convergence' comment",
             )
 
 
