@@ -18,7 +18,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos
+test: lint-strict smoke-twin smoke-chaos smoke-gateway
 	python -m pytest tests/ -q
 
 .PHONY: bench
@@ -88,6 +88,54 @@ smoke-chaos: lint-strict
 		--fault-plan tests/traces/chaos_plan.json \
 		--deadline-ms 60000 --max-retries 2 --breaker-threshold 2 \
 		--chaos-check --quiet
+
+# Gateway smoke: the zero-downtime drain/restore contract, end to end.
+# Three serve runs over the bundled 10-fleet trace through 2 sharded
+# workers: (1) uninterrupted reference; (2) snapshot after 15 events then
+# HALT (the "kill" half — warm state on disk, process gone); (3) --resume
+# from the snapshot, replaying only the uncovered suffix. The comparator
+# asserts the resumed run's final placements are IDENTICAL to the
+# uninterrupted run's, that every restored shard's first tick rode warm
+# (warm_resumes == shards touched, cold_resumes == 0) and that the
+# resumed run paid ZERO cold solves. Then the chaos soak of smoke-chaos
+# runs unchanged against the multi-worker path (--workers 2): the soak
+# contract (valid placement every tick, quarantine accounting, bounded
+# recovery) must hold identically when the scheduler lives on a shard
+# worker — per-shard HealthState isolation is pinned in tests/test_gateway.py.
+.PHONY: smoke-gateway
+smoke-gateway: lint-strict
+	@D=$$(mktemp -d) && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+		--trace tests/traces/gateway_smoke_10f.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 2 --k-candidates 8,10 --quiet --fail-uncertified \
+		--metrics-out $$D/full.json && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+		--trace tests/traces/gateway_smoke_10f.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 2 --k-candidates 8,10 --quiet \
+		--snapshot-dir $$D/snap --snapshot-at 15 --halt-after-snapshot && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+		--trace tests/traces/gateway_smoke_10f.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 2 --k-candidates 8,10 --quiet --fail-uncertified \
+		--snapshot-dir $$D/snap --resume --metrics-out $$D/resumed.json && \
+	JAX_PLATFORMS=cpu python -c "import json; \
+		full=json.load(open('$$D/full.json')); \
+		res=json.load(open('$$D/resumed.json')); \
+		assert res['final_placements']==full['final_placements'], 'restored placements diverged'; \
+		g=res['gateway']; \
+		assert g['warm_resumes']>0, 'no warm resumes'; \
+		assert g['cold_resumes']==0 and g['tick_cold']==0, 'cold re-solve after restore'; \
+		print('smoke-gateway OK: %d shards resumed warm, placements identical, 0 cold re-solves' % g['warm_resumes'])" && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+		--trace tests/traces/scheduler_smoke_20.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--synthetic-fleet 4 --fleet-seed 11 --k-candidates 8,10 \
+		--fault-plan tests/traces/chaos_plan.json \
+		--deadline-ms 60000 --max-retries 2 --breaker-threshold 2 \
+		--chaos-check --quiet --workers 2; \
+	rc=$$?; rm -rf $$D; exit $$rc
 
 .PHONY: smoke-sched
 smoke-sched: lint-strict

@@ -201,6 +201,11 @@ _REGRESSION_GATED = (
     "value", "warm_tick_ms",
     "fleet_scale_pdhg_512_solve_ms", "fleet_scale_pdhg_2048_solve_ms",
 )
+# Higher-better metrics that also gate: a >20% DROP fails the compare.
+# The gateway's sustained multi-fleet rate is the serving tier's headline.
+_REGRESSION_GATED_HIGHER = (
+    "gateway_events_per_sec_100f_4w",
+)
 _REGRESSION_TOL = 0.20
 # Reported-only deltas (no gate): ms-like keys where lower is better,
 # rate-like keys where higher is better.
@@ -209,12 +214,14 @@ _COMPARE_LOWER_BETTER = (
     "scheduler_p50_ms", "scheduler_p99_ms",
     "cold_process_ms", "cold_process_cached_ms",
     "fleet_scale_pdhg_512_solve_ms", "fleet_scale_pdhg_2048_solve_ms",
+    "gateway_p99_ms_100f_4w",
 )
 _COMPARE_HIGHER_BETTER = (
     "vs_baseline", "placements_per_sec", "pipelined_placements_per_sec",
     "scenario_batch_placements_per_sec", "scheduler_events_per_sec",
     "twin_mc_evals_per_sec", "twin_rank_agreement",
     "fleet_scale_certified_m_max",
+    "gateway_events_per_sec_100f_4w", "gateway_scaling_100f_4w",
 )
 
 
@@ -278,6 +285,12 @@ def _compare_against(payload: dict, against: str) -> int:
             key in _REGRESSION_GATED
             and lower_better
             and change > _REGRESSION_TOL
+        ):
+            failures.append(f"{key} regressed {change:+.1%} (gate ±{_REGRESSION_TOL:.0%})")
+        if (
+            key in _REGRESSION_GATED_HIGHER
+            and not lower_better
+            and change < -_REGRESSION_TOL
         ):
             failures.append(f"{key} regressed {change:+.1%} (gate ±{_REGRESSION_TOL:.0%})")
     if failures:
@@ -537,6 +550,16 @@ def main(against: str | None = None) -> int:
     except Exception as e:  # pragma: no cover - defensive bench path
         payload["scheduler_error"] = f"{type(e).__name__}: {e}"
 
+    # Gateway tier (distilp_tpu.gateway): K synthetic fleets replayed
+    # through 1/2/4 sharded solve workers via the load generator. The
+    # headline is sustained events/sec at 100 fleets with the 4-vs-1
+    # worker scaling ratio; p50/p99 event->placement latency (queue wait
+    # included) is reported per arm. A failure costs only these keys.
+    try:
+        payload.update(_gateway_bench(model))
+    except Exception as e:  # pragma: no cover - defensive bench path
+        payload["gateway_error"] = f"{type(e).__name__}: {e}"
+
     # Digital twin (distilp_tpu.twin): Monte-Carlo throughput of the
     # vmapped robustness report (1024 perturbed what-if executions per
     # dispatch) and the objective-vs-twin rank agreement over the
@@ -607,6 +630,77 @@ def _scheduler_bench(model, base_devs) -> dict:
         "scheduler_structural_uncertified": report.structural_uncertified,
         "scheduler_failed_ticks": report.failed_ticks,
     }
+
+
+def _gateway_bench(model) -> dict:
+    """gateway_* section: multi-fleet serving throughput vs worker count.
+
+    Every arm replays the IDENTICAL seeded trace set (K fleets x
+    ``DPERF_GATEWAY_EVENTS`` drift events each, after one warmup event
+    per fleet that pays the cold solve + any jit compile), so the
+    events/sec ratio between worker counts is a like-for-like scaling
+    measurement. All fleets share one shape (M = ``DPERF_GATEWAY_M``), so
+    the compile is paid once per process, not per fleet. The scaling
+    ceiling on a C-core host is min(workers, C)x — thread-backed workers
+    overlap XLA execution (which releases the GIL), not Python host code —
+    so ``gateway_scaling_100f_4w`` must be read next to the machine's
+    core count (this repo's CI box has 2, capping the honest ratio at
+    ~2x; the >=2.5x serving target needs >=4 cores).
+    """
+    from distilp_tpu.gateway.loadgen import run_loadgen
+
+    fleet_counts = [
+        int(x)
+        for x in os.environ.get("DPERF_GATEWAY_FLEETS", "10,100").split(",")
+        if x.strip()
+    ]
+    worker_counts = [
+        int(x)
+        for x in os.environ.get("DPERF_GATEWAY_WORKERS", "1,2,4").split(",")
+        if x.strip()
+    ]
+    events = int(_env_num("DPERF_GATEWAY_EVENTS", 5))
+    fleet_size = int(_env_num("DPERF_GATEWAY_M", 3))
+    arms: dict = {}
+    for n_fleets in fleet_counts:
+        for n_workers in worker_counts:
+            rep = run_loadgen(
+                model,
+                n_fleets=n_fleets,
+                n_workers=n_workers,
+                events_per_fleet=events,
+                fleet_size=fleet_size,
+                seed=0,
+                k_candidates=[8, 10],
+                mip_gap=MIP_GAP,
+            )
+            arms[f"{n_fleets}f_{n_workers}w"] = {
+                "events_per_sec": rep["events_per_sec"],
+                "p50_ms": rep["p50_ms"],
+                "p99_ms": rep["p99_ms"],
+                "tick_failed": rep["tick_failed"],
+                "uncertified": rep["uncertified"],
+                "worker_events": rep["worker_events"],
+            }
+    out: dict = {
+        "gateway": {
+            "events_per_fleet": events,
+            "fleet_size": fleet_size,
+            "host_cores": os.cpu_count(),
+            "arms": arms,
+        }
+    }
+    big = max(fleet_counts)
+    hi = max(worker_counts)
+    base = arms.get(f"{big}f_1w", {}).get("events_per_sec")
+    top = arms.get(f"{big}f_{hi}w", {})
+    if base and top.get("events_per_sec"):
+        out[f"gateway_events_per_sec_{big}f_{hi}w"] = top["events_per_sec"]
+        out[f"gateway_p99_ms_{big}f_{hi}w"] = top["p99_ms"]
+        out[f"gateway_scaling_{big}f_{hi}w"] = round(
+            top["events_per_sec"] / base, 2
+        )
+    return out
 
 
 def _twin_bench(model, base_devs) -> dict:

@@ -275,6 +275,55 @@ def build_serve_parser() -> argparse.ArgumentParser:
         help="write the final metrics snapshot + replay summary JSON here",
     )
     p.add_argument("--quiet", action="store_true", help="summary line only")
+    # Gateway tier (distilp_tpu.gateway). With --workers 1 and none of the
+    # flags below, serve is byte-identical to the single-scheduler daemon:
+    # no gateway object, no listener, no extra threads.
+    p.add_argument(
+        "--workers",
+        type=int,
+        default=1,
+        help="solve workers behind consistent-hash shard ownership "
+        "(> 1 routes the replay through the gateway tier; each "
+        "(fleet, model) shard is owned by exactly one worker and keeps "
+        "its own HealthState)",
+    )
+    p.add_argument(
+        "--listen",
+        default=None,
+        metavar="HOST:PORT",
+        help="after the trace replay, keep serving the gateway's HTTP/1.1 "
+        "JSON API (POST /events, GET /placement/<fleet>, /healthz, "
+        "/metrics) until interrupted",
+    )
+    p.add_argument(
+        "--snapshot-dir",
+        default=None,
+        help="directory for the gateway warm-state snapshot "
+        "(GatewaySnapshot JSON: per-shard fleet, incumbents, duals, "
+        "IPM/PDHG iterates, margin anchors, health)",
+    )
+    p.add_argument(
+        "--snapshot-at",
+        type=int,
+        default=None,
+        metavar="N",
+        help="take the snapshot after N handled events of this run "
+        "(requires --snapshot-dir)",
+    )
+    p.add_argument(
+        "--halt-after-snapshot",
+        action="store_true",
+        help="exit right after --snapshot-at's snapshot lands (the 'kill' "
+        "half of a drain/restore cycle; pair with --resume)",
+    )
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="restore every shard's warm state from --snapshot-dir before "
+        "replaying, skipping the events the snapshot already covers — "
+        "the restored run's first tick per shard must ride warm "
+        "(warm_resumes counter; zero cold re-solves)",
+    )
     return p
 
 
@@ -487,6 +536,29 @@ def serve_main(argv=None) -> int:
 
     force_cpu_if_env_requested()
 
+    # Gateway tier: any of the scale-out flags (or a fleet-tagged trace)
+    # diverts to the sharded multi-worker path. With none of them, the
+    # code below runs exactly the PR 5/6 single-scheduler daemon.
+    gateway_mode = bool(
+        args.workers > 1
+        or args.listen
+        or args.snapshot_dir
+        or args.resume
+    )
+    if not gateway_mode and Path(args.trace).is_file():
+        from ..gateway.traces import is_gateway_trace
+
+        gateway_mode = is_gateway_trace(args.trace)
+    if gateway_mode:
+        return _serve_gateway(args)
+    if args.snapshot_at is not None or args.halt_after_snapshot:
+        print(
+            "error: --snapshot-at/--halt-after-snapshot need "
+            "--snapshot-dir (the gateway path)",
+            file=sys.stderr,
+        )
+        return 2
+
     from ..common import load_from_profile_folder, load_model_profile
     from ..sched import Scheduler, drift_warm_share, read_trace, replay
     from ..utils import make_synthetic_fleet
@@ -645,6 +717,336 @@ def serve_main(argv=None) -> int:
             file=sys.stderr,
         )
         return 1
+    return 0
+
+
+def _serve_gateway(args) -> int:
+    """``solver serve`` through the gateway tier (``distilp_tpu.gateway``).
+
+    Engaged by --workers > 1, --listen, --snapshot-dir/--resume, or a
+    fleet-tagged (multi-fleet) trace. The replay itself is SEQUENTIAL in
+    trace order — this path is the correctness/operations surface
+    (deterministic replays, snapshot cycles, chaos soaks); concurrent
+    throughput is the load generator's job (``gateway.loadgen``,
+    ``bench.py`` gateway section).
+    """
+    import time as _time
+
+    from ..common import load_from_profile_folder, load_model_profile
+    from ..gateway import (
+        Gateway,
+        ShardFacade,
+        load_snapshot,
+        read_gateway_trace,
+        save_snapshot,
+    )
+    from ..gateway.traces import is_gateway_trace, make_fleet_from_spec
+    from ..sched import STRUCTURAL_KINDS, drift_warm_share, read_trace
+    from ..sched.metrics import _quantile
+    from ..utils import make_synthetic_fleet
+
+    folder = Path(args.profile)
+    if not folder.is_dir():
+        print(f"error: {folder} is not a directory", file=sys.stderr)
+        return 2
+    trace_path = Path(args.trace)
+    if not trace_path.is_file():
+        print(f"error: trace {trace_path} not found", file=sys.stderr)
+        return 2
+    if args.snapshot_at is not None and not args.snapshot_dir:
+        print("error: --snapshot-at needs --snapshot-dir", file=sys.stderr)
+        return 2
+    if args.resume and not args.snapshot_dir:
+        print("error: --resume needs --snapshot-dir", file=sys.stderr)
+        return 2
+
+    model = load_model_profile(folder / "model_profile.json")
+    try:
+        multi = is_gateway_trace(trace_path)
+        if multi:
+            specs, items = read_gateway_trace(trace_path)
+        else:
+            events = read_trace(trace_path)
+            specs = {}
+            items = [("default", ev) for ev in events]
+    except (OSError, ValueError) as e:
+        print(f"error: cannot parse trace: {e}", file=sys.stderr)
+        return 2
+    if not items:
+        print("error: trace is empty", file=sys.stderr)
+        return 2
+
+    k_candidates = None
+    if args.k_candidates:
+        k_candidates = [int(x) for x in args.k_candidates.split(",") if x.strip()]
+
+    plan = None
+    if args.fault_plan:
+        if multi:
+            # The fault plan's tick schedule is defined over ONE fleet's
+            # replay; spraying it across interleaved fleets would make the
+            # soak contract unverifiable.
+            print(
+                "error: --fault-plan needs a single-fleet trace (chaos "
+                "per-shard isolation is pinned in tests/test_gateway.py)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.snapshot_at is not None or args.halt_after_snapshot:
+            # The chaos replay loop has no snapshot hook; silently running
+            # the soak WITHOUT taking the requested snapshot would strand
+            # the operator's next --resume with nothing on disk.
+            print(
+                "error: --fault-plan cannot combine with --snapshot-at/"
+                "--halt-after-snapshot (the chaos soak does not snapshot "
+                "mid-replay)",
+                file=sys.stderr,
+            )
+            return 2
+        from ..sched import FaultPlan
+
+        try:
+            plan = FaultPlan.from_json(args.fault_plan)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load --fault-plan: {e}", file=sys.stderr)
+            return 2
+        if args.fault_seed is not None:
+            plan = plan.model_copy(update={"seed": args.fault_seed})
+
+    scheduler_kwargs = dict(
+        mip_gap=args.mip_gap,
+        kv_bits=args.kv_bits,
+        backend=args.backend,
+        k_candidates=k_candidates,
+        warm_pool_size=args.warm_pool,
+        cold_start=args.cold_start,
+        lp_backend=args.lp_backend,
+        pdhg_iters=args.pdhg_iters,
+        pdhg_restart_tol=args.pdhg_restart_tol,
+        risk_aware=args.risk_aware,
+        risk_samples=args.risk_samples,
+        risk_seed=args.risk_seed,
+    )
+    if args.deadline_ms is not None:
+        scheduler_kwargs["solve_deadline_s"] = args.deadline_ms / 1e3
+    if args.max_retries:
+        scheduler_kwargs["max_retries"] = args.max_retries
+    if args.breaker_threshold is not None:
+        scheduler_kwargs["breaker_threshold"] = args.breaker_threshold
+
+    gw = Gateway(n_workers=args.workers, scheduler_kwargs=scheduler_kwargs)
+    try:
+        if args.resume:
+            try:
+                snap = load_snapshot(args.snapshot_dir)
+            except (OSError, ValueError) as e:
+                print(f"error: cannot load snapshot: {e}", file=sys.stderr)
+                return 2
+            gw.load_snapshot(snap)
+            for fleet_id in ([f for f in specs] if multi else ["default"]):
+                if fleet_id not in gw.fleet_ids():
+                    print(
+                        f"error: trace fleet {fleet_id!r} is not in the "
+                        "snapshot; resume needs the same trace",
+                        file=sys.stderr,
+                    )
+                    return 2
+        elif multi:
+            for fleet_id, spec in specs.items():
+                gw.register_fleet(
+                    fleet_id, make_fleet_from_spec(fleet_id, spec), model
+                )
+        else:
+            if args.synthetic_fleet > 0:
+                devices = make_synthetic_fleet(
+                    args.synthetic_fleet, seed=args.fleet_seed
+                )
+            else:
+                devices, model = load_from_profile_folder(folder)
+            gw.register_fleet("default", devices, model)
+
+        # Resume cursor: skip the per-fleet prefix the snapshot already
+        # covers (Gateway.uncovered owns the contract — quarantined
+        # events advanced the cursor too and must not replay).
+        run_items = gw.uncovered(items)
+
+        def log_event(fleet_id, ev, view, ms):
+            if args.quiet:
+                return
+            r = view.result
+            print(
+                f"[{fleet_id} {view.fleet_seq:4d}] {ev.kind:<10s} "
+                f"M={len(r.w):2d} mode={view.mode:<6s} "
+                f"certified={str(r.certified):<5s} k={r.k:<3d} "
+                f"obj={r.obj_value:.6f} {ms:8.1f} ms"
+            )
+
+        chaos = None
+        snapshot_taken = False
+        lat = []
+        uncert = 0
+        final_views = {}
+        if plan is not None:
+            from ..sched import chaos_replay
+
+            facade = ShardFacade(gw, "default")
+            chaos = chaos_replay(
+                facade,
+                [ev for _, ev in run_items],
+                plan,
+                on_event=lambda ev, view, ms: log_event("default", ev, view, ms),
+            )
+            report = _chaos_to_replay_report(chaos, facade)
+            if chaos.views:
+                final_views["default"] = chaos.views[-1]
+        else:
+            t_start = _time.perf_counter()
+            for handled, (fleet_id, ev) in enumerate(run_items, 1):
+                t0 = _time.perf_counter()
+                view = gw.handle_event(fleet_id, ev)
+                ms = (_time.perf_counter() - t0) * 1e3
+                lat.append(ms)
+                final_views[fleet_id] = view
+                if (
+                    ev.kind in STRUCTURAL_KINDS
+                    and view.events_behind == 0
+                    and not view.result.certified
+                ):
+                    uncert += 1
+                log_event(fleet_id, ev, view, ms)
+                if args.snapshot_at is not None and handled == args.snapshot_at:
+                    save_snapshot(gw.snapshot(), args.snapshot_dir)
+                    snapshot_taken = True
+                    if not args.quiet:
+                        print(
+                            f"[snapshot] {len(gw.fleet_ids())} shard(s) -> "
+                            f"{args.snapshot_dir} after {handled} event(s)"
+                        )
+                    if args.halt_after_snapshot:
+                        break
+            total_s = _time.perf_counter() - t_start
+            srt = sorted(lat)
+            report = None
+            replay_summary = {
+                "events": len(lat),
+                "events_per_sec": round(len(lat) / total_s, 2)
+                if total_s > 0
+                else 0.0,
+                "p50_ms": round(_quantile(srt, 0.50), 3),
+                "p99_ms": round(_quantile(srt, 0.99), 3),
+                "structural_uncertified": uncert,
+            }
+
+        mx = gw.metrics_snapshot()
+        totals = mx["shard_totals"]
+        if report is not None:  # chaos path reuses the ReplayReport shape
+            replay_summary = report.summary()
+        replay_summary["failed_ticks"] = totals.get("tick_failed", 0)
+        summary = {
+            "replay": replay_summary,
+            "gateway": {
+                "workers": args.workers,
+                "fleets": len(gw.fleet_ids()),
+                "resumed": bool(args.resume),
+                "snapshot_taken": snapshot_taken,
+                "warm_resumes": totals.get("warm_resumes", 0),
+                "cold_resumes": totals.get("cold_resumes", 0),
+                "tick_cold": totals.get("tick_cold", 0),
+                "tick_warm": totals.get("tick_warm", 0),
+                "tick_margin": totals.get("tick_margin", 0),
+                "events_quarantined": totals.get("events_quarantined", 0),
+            },
+            "final_placements": {
+                f: {
+                    "k": v.result.k,
+                    "w": v.result.w,
+                    "n": v.result.n,
+                    "y": v.result.y,
+                    "obj_value": v.result.obj_value,
+                    "certified": v.result.certified,
+                }
+                for f, v in sorted(final_views.items())
+            },
+            "health": gw.healthz(),
+            "metrics": mx,
+        }
+        if not multi:
+            summary["drift_warm_share"] = round(
+                drift_warm_share(gw.scheduler("default").metrics), 4
+            )
+        if chaos is not None:
+            summary["chaos"] = chaos.summary()
+        print(json.dumps(summary))
+        if args.metrics_out:
+            Path(args.metrics_out).write_text(json.dumps(summary, indent=2))
+
+        if args.chaos_check:
+            if chaos is None:
+                print(
+                    "error: --chaos-check needs --fault-plan",
+                    file=sys.stderr,
+                )
+                return 2
+            violations = chaos.violations(
+                gw.scheduler("default").fleet.model.L
+            )
+            if violations:
+                for v in violations:
+                    print(f"chaos violation: {v}", file=sys.stderr)
+                return 1
+            print(
+                f"chaos soak OK ({args.workers} workers): "
+                f"{chaos.injected.get('injected_total', 0)} fault(s) "
+                f"injected, {chaos.summary()['quarantined']} quarantined, "
+                f"healthy after {chaos.ticks_to_healthy} clean tick(s)"
+            )
+        if args.fail_uncertified and (
+            replay_summary.get("structural_uncertified")
+            or replay_summary["failed_ticks"]
+        ):
+            print(
+                f"error: {replay_summary.get('structural_uncertified', 0)} "
+                "structural event(s) missed the optimality certificate, "
+                f"{replay_summary['failed_ticks']} tick(s) produced no "
+                "placement at all",
+                file=sys.stderr,
+            )
+            return 1
+        if args.listen:
+            return _listen_forever(gw, args.listen, quiet=args.quiet)
+        return 0
+    finally:
+        gw.close()
+
+
+def _listen_forever(gw, listen: str, quiet: bool = False) -> int:
+    """Serve the gateway's HTTP API until interrupted (serve --listen)."""
+    import asyncio
+
+    from ..gateway import GatewayHTTPServer
+
+    host, _, port_s = listen.rpartition(":")
+    host = host or "127.0.0.1"
+    try:
+        port = int(port_s)
+    except ValueError:
+        print(f"error: --listen wants HOST:PORT (got {listen!r})", file=sys.stderr)
+        return 2
+
+    async def _run() -> None:
+        server = GatewayHTTPServer(gw, host=host, port=port)
+        await server.start()
+        if not quiet:
+            print(
+                f"gateway listening on http://{host}:{server.port} "
+                "(POST /events, GET /placement/<fleet>, /healthz, /metrics)"
+            )
+        await server.serve_forever()
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
     return 0
 
 

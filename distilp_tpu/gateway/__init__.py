@@ -1,0 +1,65 @@
+"""Gateway: the horizontally scalable multi-fleet serving tier.
+
+``distilp_tpu.sched`` turned the solver into ONE fleet's long-lived
+daemon; this package turns that daemon into infrastructure that serves
+MANY fleets at once (ROADMAP open item 2):
+
+- ``router``   — consistent-hash shard ownership: each (fleet, model)
+  shard belongs to exactly one solve worker, deterministically, with
+  ~1/N churn when the worker count changes;
+- ``worker``   — the solve worker: one thread, N shards, every shard's
+  ``Scheduler`` run unchanged (so PR 5's quarantine/deadline/breaker/
+  HealthState machinery applies per shard, isolated);
+- ``gateway``  — the tier itself: sync + asyncio ingest, per-shard
+  routing, aggregated health/metrics, drain + warm snapshot;
+- ``snapshot`` — ``GatewaySnapshot``: every shard's warm state (fleet,
+  incumbents, duals, IPM/PDHG iterates, margin anchors, health) as one
+  JSON file; restore resumes with warm ticks, zero cold re-solves;
+- ``http``     — minimal stdlib HTTP/1.1 JSON API (POST /events,
+  GET /placement/<fleet>, /healthz, /metrics);
+- ``traces``   — fleet-tagged JSONL traces (the multi-fleet replay
+  format) and deterministic synthetic-fleet specs;
+- ``loadgen``  — the throughput harness behind ``bench.py``'s gateway
+  section (K fleets × N workers, events/sec + latency quantiles).
+
+Stdlib + the existing solver stack only — no new dependencies.
+"""
+
+from .gateway import Gateway, ShardFacade, view_to_dict
+from .http import GatewayHTTPServer
+from .loadgen import run_loadgen
+from .router import ConsistentHashRouter, shard_key
+from .snapshot import (
+    GatewaySnapshot,
+    ShardSnapshot,
+    load_snapshot,
+    save_snapshot,
+    snapshot_path,
+)
+from .traces import (
+    is_gateway_trace,
+    make_fleet_from_spec,
+    read_gateway_trace,
+    write_gateway_trace,
+)
+from .worker import ShardWorker
+
+__all__ = [
+    "Gateway",
+    "ShardFacade",
+    "view_to_dict",
+    "GatewayHTTPServer",
+    "run_loadgen",
+    "ConsistentHashRouter",
+    "shard_key",
+    "GatewaySnapshot",
+    "ShardSnapshot",
+    "load_snapshot",
+    "save_snapshot",
+    "snapshot_path",
+    "is_gateway_trace",
+    "make_fleet_from_spec",
+    "read_gateway_trace",
+    "write_gateway_trace",
+    "ShardWorker",
+]

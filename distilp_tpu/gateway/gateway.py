@@ -1,0 +1,515 @@
+"""The gateway: N solve workers behind consistent-hash shard ownership.
+
+``distilp_tpu.sched.Scheduler`` is one fleet's replanning daemon; this
+tier owns MANY of them. Each (fleet_id, model_id) shard maps to exactly
+one ``ShardWorker`` (``router.ConsistentHashRouter``), which runs that
+shard's ``Scheduler`` unchanged on its own thread — so independent fleets
+solve concurrently while any single shard's ticks stay strictly
+serialized, and every PR 5 hardening knob (quarantine, deadlines,
+retries, breaker, per-shard HealthState) rides along for free. A broken
+fleet degrades ITS shard's health; the others never see it.
+
+Ingest is synchronous (``handle_event`` — the trace replay path) or
+asyncio (``handle_event_async`` — the HTTP tier): both enqueue on the
+owning worker, so ordering per fleet is the submission order either way.
+
+``snapshot()`` drains every worker (a queued barrier — queued events
+finish first) and serializes each shard's warm state into a
+``GatewaySnapshot``; ``load_snapshot`` restores shards — re-routed by the
+CURRENT worker count — with their incumbents, duals, LP iterates and
+margin anchors intact, so the first tick after a restore rides warm
+(``warm_resumes`` counts the proof, ``cold_resumes`` the violations).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..common import DeviceProfile, ModelProfile
+from ..sched.metrics import (
+    HEALTH_BROKEN,
+    HEALTH_DEGRADED,
+    HEALTH_HEALTHY,
+    SchedulerMetrics,
+)
+from ..sched.scheduler import PlacementView, Scheduler
+from .router import ConsistentHashRouter, shard_key
+from .snapshot import GatewaySnapshot, ShardSnapshot
+from .worker import ShardWorker
+
+# Counters aggregated across shards into the gateway metrics snapshot —
+# the serving-tier dashboard without grepping per-shard dumps.
+_AGGREGATED_SHARD_COUNTERS = (
+    "events_total",
+    "events_quarantined",
+    "tick_cold",
+    "tick_warm",
+    "tick_margin",
+    "tick_failed",
+    "tick_certified",
+    "tick_uncertified",
+    "warm_resumes",
+    "cold_resumes",
+    "deadline_missed",
+    "breaker_open",
+    "solver_escalations",
+)
+
+
+class Gateway:
+    """Horizontally scalable serving tier over sharded solve workers.
+
+    ``scheduler_kwargs`` is the shared solver configuration every shard's
+    ``Scheduler`` is built with (mip_gap, kv_bits, backend, k_candidates,
+    lp_backend, risk_aware, deadline/retry/breaker knobs, ...);
+    ``scheduler_factory(devices, model)`` overrides construction entirely
+    (tests inject failing schedulers through it).
+    """
+
+    def __init__(
+        self,
+        n_workers: int = 1,
+        replicas: int = 64,
+        scheduler_kwargs: Optional[dict] = None,
+        scheduler_factory: Optional[Callable] = None,
+        metrics: Optional[SchedulerMetrics] = None,
+    ):
+        # Library entry point that dispatches backend work (via the
+        # schedulers it builds): arm the axon-wedge guard exactly like
+        # StreamingReplanner/halda_solve do, so JAX_PLATFORMS=cpu cannot
+        # wedge the first tick on a dead tunneled-TPU plugin.
+        from ..axon_guard import force_cpu_if_env_requested
+
+        force_cpu_if_env_requested()
+        if n_workers < 1:
+            raise ValueError("gateway needs at least one worker")
+        self.n_workers = n_workers
+        self.scheduler_kwargs = dict(scheduler_kwargs or {})
+        self._factory = scheduler_factory
+        self.metrics = metrics if metrics is not None else SchedulerMetrics()
+        self.router = ConsistentHashRouter(n_workers, replicas=replicas)
+        self.workers: List[ShardWorker] = [
+            ShardWorker(i, metrics=self.metrics) for i in range(n_workers)
+        ]
+        # shard_key -> (fleet_id, model_id, worker index); fleet -> key.
+        self._shards: Dict[str, Tuple[str, str, int]] = {}
+        self._fleet_key: Dict[str, str] = {}
+        # Per-fleet handled-event cursor (quarantines included): the
+        # resume point a trace replay skips to after a restore.
+        self._handled: Dict[str, int] = {}
+        self._closed = False
+
+    # -- shard lifecycle ---------------------------------------------------
+
+    def _build_scheduler(
+        self, devices: Sequence[DeviceProfile], model: ModelProfile
+    ) -> Scheduler:
+        if self._factory is not None:
+            return self._factory(devices, model)
+        return Scheduler(devices, model, **self.scheduler_kwargs)
+
+    def register_fleet(
+        self,
+        fleet_id: str,
+        devices: Sequence[DeviceProfile],
+        model: ModelProfile,
+        model_id: str = "default",
+        state: Optional[dict] = None,
+        events_handled: int = 0,
+    ) -> int:
+        """Create (or restore) a shard; returns the owning worker index.
+
+        ``state`` is a ``Scheduler.dump_state`` blob: the shard resumes
+        with its warm pool, published placement and health machine intact
+        (the blob's fleet/model override ``devices``/``model`` — they are
+        still required so a registration without state is well-formed).
+        """
+        key = shard_key(fleet_id, model_id)
+        if key in self._shards:
+            raise ValueError(f"shard {key!r} is already registered")
+        if fleet_id in self._fleet_key:
+            # The ingest/snapshot directory is keyed by fleet_id; a second
+            # shard under the same fleet would silently clobber the first's
+            # routing and resume cursor. One live model per fleet — a model
+            # change is a ModelSwap EVENT on the existing shard, not a
+            # second registration.
+            raise ValueError(
+                f"fleet {fleet_id!r} is already registered (under model "
+                f"{self._shards[self._fleet_key[fleet_id]][1]!r}); swap "
+                "models via a model_swap event, or use a distinct fleet id"
+            )
+        widx = self.router.owner(key)
+        worker = self.workers[widx]
+
+        def _do() -> None:
+            sched = self._build_scheduler(devices, model)
+            if state is not None:
+                sched.load_state(state)
+            worker.shards[key] = sched
+
+        worker.call(_do)
+        self._shards[key] = (fleet_id, model_id, widx)
+        self._fleet_key[fleet_id] = key
+        self._handled[fleet_id] = events_handled
+        self.metrics.inc("shards_registered")
+        if state is not None:
+            self.metrics.inc("shards_restored")
+        return widx
+
+    def fleet_ids(self) -> List[str]:
+        return list(self._fleet_key)
+
+    def _lookup(self, fleet_id: str) -> Tuple[str, ShardWorker]:
+        key = self._fleet_key.get(fleet_id)
+        if key is None:
+            raise KeyError(f"unknown fleet {fleet_id!r}; register it first")
+        return key, self.workers[self._shards[key][2]]
+
+    def scheduler(self, fleet_id: str) -> Scheduler:
+        """Direct handle on a shard's live scheduler.
+
+        Main-thread reads are only sound while the owning worker is
+        quiescent (sequential replay, post-drain inspection, chaos
+        arming) — event ticks always go through the worker queue.
+        """
+        key, worker = self._lookup(fleet_id)
+        return worker.shards[key]
+
+    # -- ingest ------------------------------------------------------------
+
+    def _tick_closure(self, fleet_id: str, key: str, worker, event):
+        """The queued unit of ingest: tick the shard AND advance the
+        fleet's resume cursor, both ON the worker thread. The cursor must
+        move inside the closure — a snapshot is a later closure on the
+        same queue, so it always observes a cursor consistent with the
+        shard state it dumps (bumping the cursor caller-side after the
+        wait would let a snapshot read state covering event n with a
+        cursor still at n-1, and a resume would double-apply event n).
+        """
+
+        def _do() -> PlacementView:
+            # finally, not on success: a raising handle() may still have
+            # mutated the fleet (seq advances before the solve fails), and
+            # a cursor one behind the seq would make a resume double-apply
+            # that event. Counting a rejected-and-raised event too only
+            # skips a repeat rejection on resume — always safe.
+            try:
+                return worker.shards[key].handle(event)
+            finally:
+                self._handled[fleet_id] = self._handled.get(fleet_id, 0) + 1
+
+        return _do
+
+    def handle_event(self, fleet_id: str, event) -> PlacementView:
+        """Apply one event to its fleet's shard; blocks for the view.
+
+        Latency observed here (``gateway_event_to_placement``) includes
+        the queue wait on the owning worker — the number a client sees,
+        not just the solve.
+        """
+        key, worker = self._lookup(fleet_id)
+        t0 = time.perf_counter()
+        view = worker.call(self._tick_closure(fleet_id, key, worker, event))
+        self._note_handled(worker, t0)
+        return view
+
+    async def handle_event_async(self, fleet_id: str, event) -> PlacementView:
+        """Asyncio ingest: enqueue on the owning worker, await the view.
+
+        Completion resolves a loop future via ``call_soon_threadsafe`` —
+        no executor thread parked per in-flight event, so thousands of
+        fleets can await concurrently over a handful of workers.
+        """
+        key, worker = self._lookup(fleet_id)
+        loop = asyncio.get_running_loop()
+        fut: "asyncio.Future" = loop.create_future()
+
+        def _resolve(box: dict) -> None:
+            if fut.cancelled():
+                return
+            if "exc" in box:
+                fut.set_exception(box["exc"])
+            else:
+                fut.set_result(box["result"])
+
+        t0 = time.perf_counter()
+        worker.submit(
+            self._tick_closure(fleet_id, key, worker, event),
+            on_done=lambda box: loop.call_soon_threadsafe(_resolve, box),
+        )
+        view = await fut
+        self._note_handled(worker, t0)
+        return view
+
+    def _note_handled(self, worker: ShardWorker, t0: float) -> None:
+        """Caller-side observability only (the resume cursor moved on the
+        worker thread, inside the tick closure)."""
+        ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.inc("gateway_events")
+        self.metrics.inc(f"worker_{worker.worker_id}_events")
+        self.metrics.observe("gateway_event_to_placement", ms)
+
+    def latest(self, fleet_id: str) -> PlacementView:
+        """The fleet's most recent published placement (via its worker, so
+        it never races a tick in flight)."""
+        key, worker = self._lookup(fleet_id)
+        return worker.call(lambda: worker.shards[key].latest())
+
+    # -- observability -----------------------------------------------------
+
+    def _per_worker(self, extract) -> Dict[str, dict]:
+        """Run ``extract(scheduler, fleet_id)`` for every shard, ONE
+        queued round trip per worker (not per shard — with hundreds of
+        shards a per-shard loop would pay hundreds of FIFO waits behind
+        in-flight solves for a single observability probe). The closure
+        runs ON the worker thread, behind everything already queued, so
+        anything it reads (shard state, the resume cursor that tick
+        closures bump) is observed at one consistent point of that
+        worker's timeline. Returns fleet_id -> value.
+        """
+        by_worker: Dict[int, List[Tuple[str, str]]] = {}
+        for key, (fleet_id, _mid, widx) in self._shards.items():
+            by_worker.setdefault(widx, []).append((key, fleet_id))
+        out: Dict[str, dict] = {}
+        for widx, members in by_worker.items():
+            worker = self.workers[widx]
+
+            def _collect(w=worker, ms=members) -> dict:
+                return {fid: extract(w.shards[k], fid) for k, fid in ms}
+
+            out.update(worker.call(_collect))
+        return out
+
+    def healthz(self) -> dict:
+        """Per-shard health + the worst state as the overall verdict."""
+        rank = {HEALTH_HEALTHY: 0, HEALTH_DEGRADED: 1, HEALTH_BROKEN: 2}
+        worst = HEALTH_HEALTHY
+        shards = self._per_worker(lambda s, _fid: s.health_snapshot())
+        for key, (fleet_id, model_id, widx) in self._shards.items():
+            snap = shards[fleet_id]
+            snap["worker"] = widx
+            snap["model_id"] = model_id
+            if rank.get(snap["state"], 2) > rank[worst]:
+                worst = snap["state"]
+        return {
+            "status": worst,
+            "workers": self.n_workers,
+            "shards": shards,
+            "queue_depths": [w.depth() for w in self.workers],
+        }
+
+    def metrics_snapshot(self) -> dict:
+        """Gateway counters/latency + per-shard aggregates, plain dicts."""
+        agg: Dict[str, int] = {}
+        per_shard: Dict[str, dict] = {}
+        all_counters = self._per_worker(
+            lambda s, _fid: dict(s.metrics.counters)
+        )
+        for fleet_id, counters in all_counters.items():
+            per_shard[fleet_id] = {
+                c: counters.get(c, 0)
+                for c in _AGGREGATED_SHARD_COUNTERS
+                if counters.get(c, 0)
+            }
+            for c in _AGGREGATED_SHARD_COUNTERS:
+                agg[c] = agg.get(c, 0) + counters.get(c, 0)
+        snap = self.metrics.snapshot()
+        snap["shard_totals"] = agg
+        snap["per_shard"] = per_shard
+        snap["workers"] = self.n_workers
+        snap["shards"] = len(self._shards)
+        return snap
+
+    # -- snapshot / restore ------------------------------------------------
+
+    def snapshot(self) -> GatewaySnapshot:
+        """Drain every worker and serialize every shard's warm state.
+
+        The dump runs ON each worker thread behind whatever events are
+        already queued — a natural barrier: the snapshot observes each
+        shard after its last accepted event, never mid-tick. New events
+        submitted while snapshotting land after the dump and are NOT in
+        the snapshot (their replay is the restore side's job).
+        """
+        # State AND resume cursor are captured in ONE worker-thread
+        # closure: the cursor moves inside queued tick closures, so
+        # reading both on the worker guarantees they describe the same
+        # point of the shard's timeline even while async ingest keeps
+        # submitting (a caller-side cursor read could observe a tick the
+        # dump did not, and a resume would then skip an uncovered event).
+        states = self._per_worker(
+            lambda s, fid: (s.dump_state(), self._handled.get(fid, 0))
+        )
+        shards: List[ShardSnapshot] = []
+        for key, (fleet_id, model_id, _widx) in self._shards.items():
+            state, cursor = states[fleet_id]
+            shards.append(
+                ShardSnapshot(
+                    fleet_id=fleet_id,
+                    model_id=model_id,
+                    shard_key=key,
+                    events_handled=cursor,
+                    state=state,
+                )
+            )
+        self.metrics.inc("snapshots_taken")
+        return GatewaySnapshot(
+            n_workers=self.n_workers,
+            shards=shards,
+            counters=self.metrics.snapshot()["counters"],
+        )
+
+    def load_snapshot(self, snap: GatewaySnapshot) -> None:
+        """Restore every shard from a snapshot into THIS gateway.
+
+        Worker count may differ from the producing gateway's: shards
+        re-route by the current consistent-hash ring, warm state riding
+        the blob to the new owner. Must be called before any events are
+        ingested (restore is a boot-time operation, not a live merge).
+        """
+        if self._shards:
+            raise RuntimeError(
+                "load_snapshot needs a fresh gateway (shards already "
+                "registered)"
+            )
+        for shard in snap.shards:
+            devices = [
+                DeviceProfile.model_validate(d)
+                for d in shard.state["devices"]
+            ]
+            model = ModelProfile.model_validate(shard.state["model"])
+            self.register_fleet(
+                shard.fleet_id,
+                devices,
+                model,
+                model_id=shard.model_id,
+                state=shard.state,
+                events_handled=shard.events_handled,
+            )
+
+    def events_handled(self, fleet_id: str) -> int:
+        """This fleet's resume cursor (events handled, quarantines
+        included) — restored from the snapshot on the other side."""
+        return self._handled.get(fleet_id, 0)
+
+    def uncovered(self, items: Sequence[Tuple[str, object]]):
+        """The suffix of a trace the resume cursors do NOT cover.
+
+        THE one implementation of the resume-skip contract (CLI,
+        walkthrough and tests all route through it): for each fleet, skip
+        its first ``events_handled(fleet)`` items — handled counts
+        quarantined events too (they advanced the cursor without the
+        fleet seq, and replaying them would only repeat the rejection).
+        """
+        seen: Dict[str, int] = {}
+        out: List[Tuple[str, object]] = []
+        for fleet_id, ev in items:
+            seen[fleet_id] = seen.get(fleet_id, 0) + 1
+            if seen[fleet_id] > self._handled.get(fleet_id, 0):
+                out.append((fleet_id, ev))
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop every worker (graceful: queued work drains first)."""
+        if self._closed:
+            return
+        self._closed = True
+        for w in self.workers:
+            w.stop()
+
+    def __enter__(self) -> "Gateway":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class ShardFacade:
+    """A single gateway shard masquerading as a bare ``Scheduler``.
+
+    The serve CLI's replay and chaos harnesses (``sched.sim.replay``,
+    ``sched.faults.chaos_replay``) drive a scheduler-shaped object:
+    ``handle``/``latest``/``metrics``/``fleet``/``health``/``fault_hook``.
+    This facade routes ``handle`` through the owning worker's queue (so
+    the multi-worker path is what is actually exercised) and delegates
+    the rest to the live scheduler — sound because those harnesses are
+    strictly sequential, so the worker is quiescent at every read.
+    """
+
+    def __init__(self, gateway: Gateway, fleet_id: str):
+        object.__setattr__(self, "_gw", gateway)
+        object.__setattr__(self, "_fleet", fleet_id)
+
+    @property
+    def _sched(self) -> Scheduler:
+        return self._gw.scheduler(self._fleet)
+
+    def handle(self, event) -> PlacementView:
+        return self._gw.handle_event(self._fleet, event)
+
+    def latest(self) -> PlacementView:
+        return self._gw.latest(self._fleet)
+
+    def metrics_snapshot(self) -> dict:
+        return self._sched.metrics_snapshot()
+
+    def health_snapshot(self) -> dict:
+        return self._sched.health_snapshot()
+
+    def close(self) -> None:
+        """No-op: the gateway owns worker/scheduler lifecycle."""
+
+    @property
+    def metrics(self):
+        return self._sched.metrics
+
+    @property
+    def fleet(self):
+        return self._sched.fleet
+
+    @property
+    def health(self):
+        return self._sched.health
+
+    @property
+    def quarantined(self):
+        return self._sched.quarantined
+
+    @property
+    def fault_hook(self):
+        return self._sched.fault_hook
+
+    def __setattr__(self, name, value):
+        # chaos_replay installs its injector via `scheduler.fault_hook =`;
+        # forward that one write to the live scheduler (the worker only
+        # READS the hook, inside a tick this sequential caller isn't
+        # running) — everything else stays local.
+        if name == "fault_hook":
+            self._sched.fault_hook = value
+        else:
+            object.__setattr__(self, name, value)
+
+
+def view_to_dict(view: PlacementView) -> dict:
+    """A served placement as the JSON the HTTP tier returns."""
+    r = view.result
+    return {
+        "k": r.k,
+        "w": r.w,
+        "n": r.n,
+        "y": r.y,
+        "obj_value": r.obj_value,
+        "certified": r.certified,
+        "gap": r.gap,
+        "mode": view.mode,
+        "seq": view.seq,
+        "fleet_seq": view.fleet_seq,
+        "events_behind": view.events_behind,
+        "age_s": round(view.age_s, 6),
+        "twin_p95_s": view.twin_p95_s,
+        "risk_selected": view.risk_selected,
+    }

@@ -1,0 +1,171 @@
+"""Minimal HTTP/1.1 JSON API over the gateway (stdlib asyncio only).
+
+Four routes, all JSON:
+
+    POST /events                 {"fleet": <id>, "event": {<sched.events>}}
+                                 -> 200 {"view": {...}} after the shard
+                                 ticks (the response IS the placement)
+    GET  /placement/<fleet_id>   -> 200 {"view": {...}} (latest, no solve)
+    GET  /healthz                -> 200/503 per-shard health + overall
+    GET  /metrics                -> 200 gateway metrics snapshot
+
+One connection = one request (``Connection: close``): the serving tier's
+clients are schedulers and probes, not browsers, and the parser stays ~50
+lines. The asyncio loop only ever PARSES and ROUTES — every blocking step
+(shard ticks, worker round trips) happens on the shard workers' threads,
+reached through ``handle_event_async``'s future bridge or the default
+executor, so one slow fleet's solve never stalls another fleet's ingest.
+That invariant is mechanically enforced: dlint DLP018 forbids blocking
+calls inside ``async def`` bodies in this package.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional, Tuple
+
+from .gateway import Gateway, view_to_dict
+
+_MAX_BODY = 8 * 1024 * 1024  # a DeviceJoin carries a full profile; 8 MB is generous
+_MAX_HEADER_LINES = 64
+
+
+def _response(status: int, payload: dict) -> bytes:
+    body = json.dumps(payload).encode()
+    reason = {
+        200: "OK", 400: "Bad Request", 404: "Not Found",
+        405: "Method Not Allowed", 409: "Conflict", 413: "Payload Too Large",
+        500: "Internal Server Error", 503: "Service Unavailable",
+    }.get(status, "OK")
+    head = (
+        f"HTTP/1.1 {status} {reason}\r\n"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        f"Connection: close\r\n\r\n"
+    )
+    return head.encode() + body
+
+
+class GatewayHTTPServer:
+    """asyncio HTTP front end for one ``Gateway``."""
+
+    def __init__(self, gateway: Gateway, host: str = "127.0.0.1", port: int = 0):
+        self.gateway = gateway
+        self.host = host
+        self.port = port  # 0 = ephemeral; replaced by the bound port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- request handling --------------------------------------------------
+
+    async def _handle_conn(self, reader, writer) -> None:
+        try:
+            status, payload = await self._dispatch(reader)
+        except (EOFError, ConnectionError) as e:
+            # IncompleteReadError (an EOFError) = the client closed before
+            # its advertised body arrived: a client fault, not a server
+            # one — it must not inflate the internal-error signal.
+            self.gateway.metrics.inc("http_client_gone")
+            status, payload = 400, {"error": f"{type(e).__name__}: {e}"}
+        except (ValueError, json.JSONDecodeError) as e:
+            self.gateway.metrics.inc("http_bad_request")
+            status, payload = 400, {"error": f"{type(e).__name__}: {e}"}
+        except (KeyError, FileNotFoundError) as e:
+            self.gateway.metrics.inc("http_not_found")
+            status, payload = 404, {"error": str(e)}
+        except RuntimeError as e:
+            # e.g. "no placement published yet" — the shard exists but has
+            # nothing servable; a retriable condition, not a client error.
+            self.gateway.metrics.inc("http_conflict")
+            status, payload = 409, {"error": str(e)}
+        except Exception as e:
+            self.gateway.metrics.inc("http_internal_error")
+            status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+        try:
+            writer.write(_response(status, payload))
+            await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            self.gateway.metrics.inc("http_client_gone")
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                self.gateway.metrics.inc("http_client_gone")
+
+    async def _read_request(self, reader) -> Tuple[str, str, bytes]:
+        request_line = (await reader.readline()).decode("latin-1").strip()
+        if not request_line:
+            raise ValueError("empty request")
+        parts = request_line.split()
+        if len(parts) != 3:
+            raise ValueError(f"malformed request line {request_line!r}")
+        method, target, _version = parts
+        content_length = 0
+        for _ in range(_MAX_HEADER_LINES):
+            line = (await reader.readline()).decode("latin-1")
+            if line in ("\r\n", "\n", ""):
+                break
+            name, _, value = line.partition(":")
+            if name.strip().lower() == "content-length":
+                content_length = int(value.strip())
+        else:
+            raise ValueError("too many header lines")
+        if content_length > _MAX_BODY:
+            raise ValueError(f"body exceeds {_MAX_BODY} bytes")
+        body = (
+            await reader.readexactly(content_length) if content_length else b""
+        )
+        return method, target, body
+
+    async def _dispatch(self, reader) -> Tuple[int, dict]:
+        method, target, body = await self._read_request(reader)
+        loop = asyncio.get_running_loop()
+        if method == "POST" and target == "/events":
+            data = json.loads(body or b"{}")
+            fleet_id = data.get("fleet")
+            if not fleet_id:
+                raise ValueError("POST /events needs a 'fleet' field")
+            if "event" not in data:
+                raise ValueError("POST /events needs an 'event' object")
+            from ..sched.events import event_from_dict
+
+            event = event_from_dict(data["event"])
+            view = await self.gateway.handle_event_async(fleet_id, event)
+            return 200, {"fleet": fleet_id, "view": view_to_dict(view)}
+        if method == "GET" and target.startswith("/placement/"):
+            fleet_id = target[len("/placement/"):]
+            # latest() blocks on a worker round trip; off the loop thread.
+            view = await loop.run_in_executor(
+                None, self.gateway.latest, fleet_id
+            )
+            return 200, {"fleet": fleet_id, "view": view_to_dict(view)}
+        if method == "GET" and target == "/healthz":
+            health = await loop.run_in_executor(None, self.gateway.healthz)
+            return (503 if health["status"] == "broken" else 200), health
+        if method == "GET" and target == "/metrics":
+            snap = await loop.run_in_executor(
+                None, self.gateway.metrics_snapshot
+            )
+            return 200, snap
+        if method not in ("GET", "POST"):
+            return 405, {"error": f"method {method} not supported"}
+        return 404, {"error": f"no route for {method} {target}"}

@@ -1,0 +1,244 @@
+"""Load generator: K synthetic fleets replayed through N solve workers.
+
+The gateway exists to keep many fleets' replanning concurrent; this
+module measures exactly that. ``run_loadgen`` builds K deterministic
+synthetic fleets (one shard each), generates a seeded drift trace per
+fleet, warms every shard (first event = cold solve + jit compile,
+excluded from the steady-state numbers, same convention as the
+single-fleet scheduler bench), then replays the remaining events with
+every fleet's stream concurrent — per-fleet order preserved (shard
+serialization), cross-fleet parallelism bounded only by the workers.
+
+Reported: sustained ``events_per_sec`` over the timed phase, p50/p99
+event→placement latency (queue wait INCLUDED — it is what a client
+sees), per-worker event counts, and failure/certification tallies.
+``bench.py``'s gateway section runs this at K ∈ {10, 100} through
+1/2/4 workers and derives the scaling ratio; on a box with C cores the
+honest ceiling is min(workers, C)×, so read the ratio next to the
+machine, not in the abstract.
+
+Runnable directly:
+
+    python -m distilp_tpu.gateway.loadgen --fleets 10 --workers 2 \
+        --events 5 --profile tests/profiles/llama_3_70b/online
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..sched.metrics import _quantile
+from ..sched.sim import generate_trace
+from .gateway import Gateway
+from .traces import make_fleet_from_spec
+
+
+def make_fleet_specs(
+    n_fleets: int, fleet_size: int = 3, seed: int = 0
+) -> Dict[str, dict]:
+    """K deterministic synthetic-fleet specs (traces.py spec-line shape)."""
+    return {
+        f"f{i:03d}": {"m": fleet_size, "seed": seed * 1000 + i}
+        for i in range(n_fleets)
+    }
+
+
+def make_loadgen_trace(
+    specs: Dict[str, dict],
+    events_per_fleet: int,
+    seed: int = 0,
+    scenario: str = "drift",
+) -> List[Tuple[str, object]]:
+    """Interleaved (fleet_id, event) items, round-robin across fleets.
+
+    Drift-only by default: every post-warmup tick should ride the warm
+    path, so the measured rate is the steady-state replanning rate, not a
+    mixture with cold identity changes.
+    """
+    per_fleet: Dict[str, list] = {}
+    for i, (fleet_id, spec) in enumerate(specs.items()):
+        devices = make_fleet_from_spec(fleet_id, spec)
+        per_fleet[fleet_id] = generate_trace(
+            scenario, events_per_fleet, seed=seed * 7919 + i,
+            base_fleet=devices,
+        )
+    items: List[Tuple[str, object]] = []
+    for j in range(events_per_fleet):
+        for fleet_id in specs:
+            items.append((fleet_id, per_fleet[fleet_id][j]))
+    return items
+
+
+async def replay_concurrent(
+    gateway: Gateway,
+    items: Sequence[Tuple[str, object]],
+    measure_from: Dict[str, int],
+) -> dict:
+    """Replay items with one sequential task per fleet, all concurrent.
+
+    ``measure_from[fleet]`` is the per-fleet index (0-based) of the first
+    MEASURED event. The warmup prefix runs as its own concurrent phase
+    with a barrier before the timed phase: cold solves AND the first warm
+    tick's jit compile land entirely in warmup (a compile leaking into
+    any arm's timed phase would make the first arm of a bench sweep look
+    ~50x slower than the rest), and the reported wall clock covers only
+    measured events.
+    """
+    per_fleet: Dict[str, list] = {}
+    for fleet_id, ev in items:
+        per_fleet.setdefault(fleet_id, []).append(ev)
+    latencies: List[float] = []
+    failures = {"tick_failed": 0, "uncertified": 0}
+
+    async def _drive(fleet_id: str, events: list, record: bool) -> None:
+        for ev in events:
+            t0 = time.perf_counter()
+            view = await gateway.handle_event_async(fleet_id, ev)
+            ms = (time.perf_counter() - t0) * 1e3
+            if record:
+                latencies.append(ms)
+                if view.events_behind > 0:
+                    failures["tick_failed"] += 1
+                elif not view.result.certified:
+                    failures["uncertified"] += 1
+
+    split = {f: measure_from.get(f, 0) for f in per_fleet}
+    await asyncio.gather(
+        *(
+            _drive(f, evs[: split[f]], record=False)
+            for f, evs in per_fleet.items()
+        )
+    )
+    t_start = time.perf_counter()
+    await asyncio.gather(
+        *(
+            _drive(f, evs[split[f]:], record=True)
+            for f, evs in per_fleet.items()
+        )
+    )
+    wall_s = time.perf_counter() - t_start
+    srt = sorted(latencies)
+    return {
+        "events": len(latencies),
+        "wall_s": round(wall_s, 3),
+        "events_per_sec": round(len(latencies) / wall_s, 2) if wall_s else 0.0,
+        "p50_ms": round(_quantile(srt, 0.50), 3),
+        "p99_ms": round(_quantile(srt, 0.99), 3),
+        **failures,
+    }
+
+
+def run_loadgen(
+    model,
+    n_fleets: int,
+    n_workers: int,
+    events_per_fleet: int = 5,
+    fleet_size: int = 3,
+    seed: int = 0,
+    # Two warmup events per fleet: the first pays the cold solve (+ the
+    # cold layout's jit compile), the second the first warm tick (+ the
+    # WARM layout's compile — a distinct program). Both must precede the
+    # timed phase or the first arm of a sweep eats a compile bill the
+    # later arms don't.
+    warmup_per_fleet: int = 2,
+    k_candidates: Optional[Sequence[int]] = None,
+    mip_gap: float = 1e-3,
+    kv_bits: str = "4bit",
+    scenario: str = "drift",
+    scheduler_kwargs: Optional[dict] = None,
+) -> dict:
+    """One full loadgen arm: build fleets, replay, report, tear down.
+
+    The same (n_fleets, seed, events) always produces the same trace set,
+    so arms at different worker counts compare like for like — the bench's
+    scaling ratio divides two runs of the IDENTICAL workload.
+    """
+    total_events = events_per_fleet + warmup_per_fleet
+    specs = make_fleet_specs(n_fleets, fleet_size=fleet_size, seed=seed)
+    items = make_loadgen_trace(specs, total_events, seed=seed, scenario=scenario)
+    kwargs = {
+        "mip_gap": mip_gap,
+        "kv_bits": kv_bits,
+        "backend": "jax",
+        "k_candidates": list(k_candidates) if k_candidates else None,
+    }
+    kwargs.update(scheduler_kwargs or {})
+    gateway = Gateway(n_workers=n_workers, scheduler_kwargs=kwargs)
+    try:
+        for fleet_id, spec in specs.items():
+            gateway.register_fleet(
+                fleet_id, make_fleet_from_spec(fleet_id, spec), model
+            )
+        measure_from = {f: warmup_per_fleet for f in specs}
+        report = asyncio.run(replay_concurrent(gateway, items, measure_from))
+        snap = gateway.metrics_snapshot()
+        report.update(
+            {
+                "fleets": n_fleets,
+                "workers": n_workers,
+                "events_per_fleet": events_per_fleet,
+                "warmup_per_fleet": warmup_per_fleet,
+                "shard_totals": snap["shard_totals"],
+                "worker_events": [
+                    snap["counters"].get(f"worker_{i}_events", 0)
+                    for i in range(n_workers)
+                ],
+            }
+        )
+        return report
+    finally:
+        gateway.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    import sys
+    from pathlib import Path
+
+    from ..axon_guard import force_cpu_if_env_requested
+
+    force_cpu_if_env_requested()
+
+    p = argparse.ArgumentParser(
+        prog="python -m distilp_tpu.gateway.loadgen",
+        description="replay K synthetic fleets through N gateway workers "
+        "and report sustained events/sec + latency quantiles",
+    )
+    p.add_argument("--fleets", type=int, default=10)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--events", type=int, default=5, help="measured events per fleet")
+    p.add_argument("--fleet-size", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--profile", "-p", required=True, help="profile folder (model_profile.json)")
+    p.add_argument("--k-candidates", default="8,10")
+    p.add_argument("--mip-gap", type=float, default=1e-3)
+    args = p.parse_args(argv)
+
+    from ..common import load_model_profile
+
+    folder = Path(args.profile)
+    model_path = folder / "model_profile.json" if folder.is_dir() else folder
+    if not model_path.is_file():
+        print(f"error: no model profile at {model_path}", file=sys.stderr)
+        return 2
+    model = load_model_profile(model_path)
+    ks = [int(x) for x in args.k_candidates.split(",") if x.strip()] or None
+    report = run_loadgen(
+        model,
+        n_fleets=args.fleets,
+        n_workers=args.workers,
+        events_per_fleet=args.events,
+        fleet_size=args.fleet_size,
+        seed=args.seed,
+        k_candidates=ks,
+        mip_gap=args.mip_gap,
+    )
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,76 @@
+"""Consistent-hash shard routing: (fleet, model) -> exactly one worker.
+
+Every shard must be owned by exactly one solve worker — two workers
+ticking the same shard would interleave warm-state writes — and the
+mapping must be stable under reconfiguration: a snapshot taken under 2
+workers restored under 4 should move as few shards as possible (a moved
+shard keeps its warm state — it rides the snapshot blob — but loses its
+jit cache locality). A classic consistent-hash ring over virtual nodes
+gives both: deterministic ownership (pure function of the shard key and
+the worker count — a restored gateway recomputes the same routing), and
+~1/N churn when N changes.
+
+No coordination, no clock, no randomness: the ring is SHA-1 positions of
+``worker:<i>#<v>`` labels, so two processes with the same worker count
+route identically — which is what lets the load generator and the serve
+CLI reason about per-worker load without talking to each other.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, List, Sequence, Tuple
+
+
+def _ring_hash(label: str) -> int:
+    """64-bit ring position (SHA-1 prefix; stable across processes —
+    Python's builtin ``hash`` is salted per process and would not be)."""
+    return int.from_bytes(hashlib.sha1(label.encode()).digest()[:8], "big")
+
+
+class ConsistentHashRouter:
+    """Stable shard->worker assignment over a virtual-node hash ring."""
+
+    def __init__(self, n_workers: int, replicas: int = 64):
+        if n_workers < 1:
+            raise ValueError("router needs at least one worker")
+        if replicas < 1:
+            raise ValueError("router needs at least one virtual node")
+        self.n_workers = n_workers
+        self.replicas = replicas
+        ring: List[Tuple[int, int]] = []
+        for w in range(n_workers):
+            for v in range(replicas):
+                ring.append((_ring_hash(f"worker:{w}#{v}"), w))
+        ring.sort()
+        self._ring = ring
+        self._points = [h for h, _ in ring]
+
+    def owner(self, shard_key: str) -> int:
+        """Worker index owning this shard (first ring point clockwise)."""
+        h = _ring_hash(shard_key)
+        idx = bisect.bisect_right(self._points, h)
+        if idx == len(self._ring):
+            idx = 0
+        return self._ring[idx][1]
+
+    def assignments(self, shard_keys: Sequence[str]) -> Dict[str, int]:
+        return {k: self.owner(k) for k in shard_keys}
+
+    def load(self, shard_keys: Sequence[str]) -> List[int]:
+        """Shards per worker — the balance gauge the bench reports."""
+        counts = [0] * self.n_workers
+        for k in shard_keys:
+            counts[self.owner(k)] += 1
+        return counts
+
+
+def shard_key(fleet_id: str, model_id: str = "default") -> str:
+    """The canonical shard name. '/' is reserved for the HTTP path split."""
+    if not fleet_id or "/" in fleet_id or "/" in model_id:
+        raise ValueError(
+            f"fleet/model ids must be non-empty and '/'-free "
+            f"(got fleet={fleet_id!r}, model={model_id!r})"
+        )
+    return f"{fleet_id}::{model_id}"

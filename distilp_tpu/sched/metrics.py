@@ -9,10 +9,20 @@ attached — duck-typed, so the solver package does not import this one).
 
 ``snapshot()`` returns nothing but plain ints/floats in dicts — safe to
 ``json.dumps`` straight into a bench line or a /metrics endpoint.
+
+Thread safety: the gateway tier (``distilp_tpu.gateway``) funnels every
+shard worker thread into ONE gateway-level sink, and an HTTP ``/metrics``
+read can land mid-``observe`` — so ``inc``/``observe``/``snapshot`` (and
+the hist's ``record``) hold a lock. Uncontended, that is one
+``threading.Lock`` acquire per counter bump (tens of nanoseconds) — noise
+next to a solve tick; contended, it is exactly what keeps a concurrent
+snapshot from reading a half-updated hist buffer (count bumped, value not
+yet appended).
 """
 
 from __future__ import annotations
 
+import threading
 from collections import defaultdict, deque
 from typing import Dict, List
 
@@ -79,17 +89,24 @@ class LatencyHist:
         self._vals: "deque[float]" = deque(maxlen=cap)
         self.count = 0
         self.total = 0.0
+        # record() is a three-field update; a snapshot between the count
+        # bump and the append would see count != len(values) and report a
+        # torn (count, mean, quantile) triple. One lock covers both.
+        self._lock = threading.Lock()
 
     def record(self, ms: float) -> None:
-        self.count += 1
-        self.total += ms
-        self._vals.append(float(ms))
+        with self._lock:
+            self.count += 1
+            self.total += ms
+            self._vals.append(float(ms))
 
     def snapshot(self) -> Dict[str, float]:
-        vals = sorted(self._vals)
+        with self._lock:
+            vals = sorted(self._vals)
+            count, total = self.count, self.total
         return {
-            "count": self.count,
-            "mean_ms": round(self.total / self.count, 3) if self.count else 0.0,
+            "count": count,
+            "mean_ms": round(total / count, 3) if count else 0.0,
             "p50_ms": round(_quantile(vals, 0.50), 3),
             "p99_ms": round(_quantile(vals, 0.99), 3),
             "max_ms": round(vals[-1], 3) if vals else 0.0,
@@ -102,16 +119,22 @@ class SchedulerMetrics:
     def __init__(self):
         self.counters: Dict[str, int] = defaultdict(int)
         self.hists: Dict[str, LatencyHist] = {}
+        # Guards the counter dict and hist-map mutation; each hist guards
+        # its own buffer (record/snapshot above), so observe() holds this
+        # lock only for the get-or-create, never across the record.
+        self._lock = threading.Lock()
 
     # -- generic sinks ----------------------------------------------------
 
     def inc(self, name: str, n: int = 1) -> None:
-        self.counters[name] += n
+        with self._lock:
+            self.counters[name] += n
 
     def observe(self, name: str, ms: float) -> None:
-        hist = self.hists.get(name)
-        if hist is None:
-            hist = self.hists[name] = LatencyHist()
+        with self._lock:
+            hist = self.hists.get(name)
+            if hist is None:
+                hist = self.hists[name] = LatencyHist()
         hist.record(ms)
 
     # -- the replanner hook (see StreamingReplanner.metrics) --------------
@@ -128,18 +151,26 @@ class SchedulerMetrics:
     # -- derived views ----------------------------------------------------
 
     def tick_total(self) -> int:
-        return sum(self.counters[f"tick_{m}"] for m in TICK_MODES)
+        with self._lock:
+            return sum(self.counters[f"tick_{m}"] for m in TICK_MODES)
 
     def pool_hit_rate(self) -> float:
-        hits = self.counters["pool_hit"]
-        total = hits + self.counters["pool_miss"]
+        with self._lock:
+            hits = self.counters["pool_hit"]
+            total = hits + self.counters["pool_miss"]
         return hits / total if total else 0.0
 
     def snapshot(self) -> dict:
         """Plain-dict view: counters, derived rates, histogram quantiles."""
+        with self._lock:
+            counters = dict(self.counters)
+            hists = list(self.hists.items())
+        tick_total = sum(counters.get(f"tick_{m}", 0) for m in TICK_MODES)
+        hits = counters.get("pool_hit", 0)
+        pool_total = hits + counters.get("pool_miss", 0)
         return {
-            "counters": dict(self.counters),
-            "pool_hit_rate": round(self.pool_hit_rate(), 4),
-            "tick_total": self.tick_total(),
-            "latency": {name: h.snapshot() for name, h in self.hists.items()},
+            "counters": counters,
+            "pool_hit_rate": round(hits / pool_total, 4) if pool_total else 0.0,
+            "tick_total": tick_total,
+            "latency": {name: h.snapshot() for name, h in hists},
         }

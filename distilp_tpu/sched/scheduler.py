@@ -185,6 +185,20 @@ class WarmPool:
         reads cached incumbents without touching recency or hit counters."""
         return list(self._pool.items())
 
+    def adopt(self, key: tuple, planner: StreamingReplanner) -> None:
+        """Install a restored replanner under its key (snapshot restore).
+
+        Counts as neither a hit nor a miss — the pool never routed an
+        event to it; capacity is still enforced (restoring onto a smaller
+        pool evicts LRU-style, warm state lost but correctness kept).
+        """
+        self._pool[key] = planner
+        self._pool.move_to_end(key)
+        while len(self._pool) > self.capacity:
+            self._pool.popitem(last=False)
+            if self._metrics is not None:
+                self._metrics.inc("pool_evict")
+
 
 class Scheduler:
     """Event-driven replanning daemon over one fleet + model.
@@ -301,6 +315,16 @@ class Scheduler:
         self._abandoned = None  # future of a deadline-abandoned solve
         self._published: Optional[PlacementView] = None
         self._published_at: float = 0.0
+        # Snapshot-restore accounting: set by load_state(); the FIRST tick
+        # after a restore proves whether the warm state survived the round
+        # trip (counter `warm_resumes`) or the service paid a cold re-solve
+        # it was promised not to (`cold_resumes`). One tick only — later
+        # cold ticks are ordinary identity changes, not restore failures.
+        # A first tick whose identity was NOT in the restored pool (e.g. a
+        # structural event landed first) proves nothing about the restore
+        # and counts as neither (`resume_identity_changed`).
+        self._restore_pending = False
+        self._restored_keys: frozenset = frozenset()
         if solve_on_init:
             self.metrics.inc("init_solve")
             self._tick(structural=None)
@@ -444,6 +468,20 @@ class Scheduler:
         if tick_tm.get("escalated"):
             self.metrics.inc("solver_escalations")
         mode = getattr(planner, "last_tick_mode", None) or "cold"
+        if self._restore_pending:
+            self._restore_pending = False
+            if key not in self._restored_keys:
+                # The first post-restore tick changed identity (structural
+                # event); a cold solve here is ordinary routing, not a
+                # restore failure — flagging it as cold_resumes would page
+                # on a perfectly healthy drain/restore cycle.
+                self.metrics.inc("resume_identity_changed")
+            else:
+                self.metrics.inc(
+                    "warm_resumes"
+                    if mode in ("warm", "margin")
+                    else "cold_resumes"
+                )
         if structural is not None:
             self.metrics.observe(
                 "structural_tick" if structural else "drift_tick", ms
@@ -799,6 +837,116 @@ class Scheduler:
 
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot()
+
+    # -- warm snapshot / restore (the gateway's drain/restore cycle) -------
+
+    def dump_state(self) -> dict:
+        """The scheduler's full warm state as one JSON-able blob.
+
+        Everything a restored scheduler needs to resume serving mid-trace
+        with warm ticks: the live fleet snapshot (devices + model + event
+        seq), the published placement (so ``latest()`` serves immediately),
+        the health/breaker machine, and the warm pool — every replanner's
+        incumbent, duals, LP iterates and margin anchor via
+        ``StreamingReplanner.dump_warm_state`` (bit-exact round trip).
+        Metrics counters are NOT included: a restored process starts fresh
+        observability, and the ``warm_resumes``/``cold_resumes`` counters
+        are what audit the restore itself. The risk-aware per-k candidate
+        cache is also dropped — it is re-enumerated on demand (a cold
+        *enumeration*, never a cold serving tick).
+        """
+        # A deadline-abandoned solve still runs on the sched-solve daemon
+        # thread and writes the planner's warm state (last/_margin_state)
+        # when it finally finishes — dumping concurrently could pair an
+        # incumbent and LP iterates from different ticks (or crash
+        # encoding a dict mutated mid-walk). Drain it first: the solve is
+        # finite jit'd work, and a snapshot's consistency outranks its
+        # latency.
+        if self._abandoned is not None:
+            _box, done = self._abandoned
+            done.wait()
+            self.metrics.inc("abandoned_solves_drained")
+            self._abandoned = None
+        published = None
+        if self._published is not None:
+            v = self._published
+            published = {
+                "result": v.result.model_dump(),
+                "seq": v.seq,
+                "mode": v.mode,
+                "key": list(v.key),
+                "twin_p95_s": v.twin_p95_s,
+                "risk_selected": v.risk_selected,
+            }
+        return {
+            "version": 1,
+            "devices": [d.model_dump() for d in self.fleet.device_list()],
+            "model": self.fleet.model.model_dump(),
+            "seq": self.fleet.seq,
+            "health": self.health,
+            "breaker_open": self._breaker_open,
+            "breaker_cooldown_left": self._breaker_cooldown_left,
+            "consec_failures": self._consec_failures,
+            "clean_streak": self._clean_streak,
+            "last_error": self._last_error,
+            "published": published,
+            # LRU order preserved oldest-first so adoption re-creates it.
+            "pool": [
+                {"key": list(key), "warm": planner.dump_warm_state()}
+                for key, planner in self.pool.items()
+            ],
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Restore a ``dump_state`` blob into this scheduler.
+
+        The scheduler must have been constructed with the same solver
+        configuration (gap, backend, engine pins — the blob carries state,
+        not config); fleet and model are taken from the blob, so the
+        constructor's devices only seeded routing. The first tick after a
+        restore self-reports through ``warm_resumes``/``cold_resumes``.
+        """
+        if state.get("version") != 1:
+            raise ValueError(
+                f"unknown scheduler state version {state.get('version')!r}"
+            )
+        from ..common import DeviceProfile, ModelProfile
+
+        devices = [DeviceProfile.model_validate(d) for d in state["devices"]]
+        model = ModelProfile.model_validate(state["model"])
+        self.fleet = FleetState(devices, model)
+        self.fleet.seq = state["seq"]
+        self.health = state["health"]
+        self._breaker_open = state["breaker_open"]
+        self._breaker_cooldown_left = state["breaker_cooldown_left"]
+        self._consec_failures = state["consec_failures"]
+        self._clean_streak = state["clean_streak"]
+        self._last_error = state.get("last_error")
+        for entry in state["pool"]:
+            planner = self._make_replanner()
+            planner.load_warm_state(entry["warm"])
+            self.pool.adopt(tuple(entry["key"]), planner)
+        self._restored_keys = frozenset(
+            tuple(entry["key"]) for entry in state["pool"]
+        )
+        pub = state.get("published")
+        if pub is not None:
+            self._published = PlacementView(
+                result=HALDAResult.model_validate(pub["result"]),
+                seq=pub["seq"],
+                fleet_seq=self.fleet.seq,
+                events_behind=self.fleet.seq - pub["seq"],
+                age_s=0.0,
+                mode=pub["mode"],
+                key=tuple(pub["key"]),
+                twin_p95_s=pub.get("twin_p95_s"),
+                risk_selected=bool(pub.get("risk_selected", False)),
+            )
+            self._published_at = time.monotonic()
+        self._risk_per_k = []
+        self._risk_per_k_key = None
+        self._restore_pending = True
+        self.metrics.inc("state_restored")
 
     _last_error: Optional[str] = None
 

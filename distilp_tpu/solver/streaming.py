@@ -32,6 +32,65 @@ from ..common import DeviceProfile, ModelProfile
 from .api import halda_solve
 from .result import HALDAResult
 
+# Wire format of the warm-state blob (dump_warm_state/load_warm_state).
+# Bump on any layout change; load refuses versions it does not know —
+# a snapshot is warm STATE, staleness costs iterations but a misdecoded
+# array would cost soundness.
+WARM_BLOB_VERSION = 1
+
+
+def _encode_state(obj):
+    """JSON-able encoding of a warm-state payload, bit-exact for arrays.
+
+    numpy arrays ride as base64 of their raw bytes plus dtype/shape (the
+    round trip is bit-identical — a restored replanner's next tick must
+    equal the uninterrupted one's, and f32 iterates re-encoded through
+    decimal text would not be). Tuples decode as lists; every consumer of
+    the warm state (margin gate, IPM/PDHG warm entry) only iterates or
+    ``np.array_equal``s them, so the distinction is not load-bearing.
+    """
+    import base64
+
+    import numpy as np
+
+    if isinstance(obj, np.ndarray):
+        return {
+            "__nd__": 1,
+            "dtype": str(obj.dtype),
+            "shape": list(obj.shape),
+            "data": base64.b64encode(
+                np.ascontiguousarray(obj).tobytes()
+            ).decode("ascii"),
+        }
+    if isinstance(obj, np.generic):
+        # A lone numpy scalar (e.g. rd["E"]): re-materialize at the same
+        # dtype so exact-match gates keep comparing equal types.
+        return {"__npscalar__": 1, "dtype": str(obj.dtype), "value": obj.item()}
+    if isinstance(obj, dict):
+        return {str(k): _encode_state(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode_state(v) for v in obj]
+    return obj
+
+
+def _decode_state(obj):
+    import base64
+
+    import numpy as np
+
+    if isinstance(obj, dict):
+        if obj.get("__nd__") == 1:
+            arr = np.frombuffer(
+                base64.b64decode(obj["data"]), dtype=np.dtype(obj["dtype"])
+            )
+            return arr.reshape(obj["shape"]).copy()
+        if obj.get("__npscalar__") == 1:
+            return np.dtype(obj["dtype"]).type(obj["value"])
+        return {k: _decode_state(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode_state(v) for v in obj]
+    return obj
+
 
 class StreamingReplanner:
     """Holds the previous placement and re-solves warm on every tick.
@@ -378,3 +437,72 @@ class StreamingReplanner:
         self._load_factors = None
         self._in_flight = []
         self._margin_state = {}
+
+    # -- warm-state snapshot/restore --------------------------------------
+    #
+    # Three kinds of warm state ride across ticks (module docstring): the
+    # integer incumbent + Lagrangian duals (on ``self.last``), the root LP
+    # iterates (``last.ipm_state`` — IPM and PDHG share the field layout,
+    # so one blob serves both engines), and the MoE margin anchor
+    # (``self._margin_state``). All of it is validity-gated on-device at
+    # the next tick, so a snapshot that goes stale between dump and load
+    # costs iterations, never soundness. ``HALDAResult.ipm_state`` is
+    # ``exclude=True`` in pydantic serialization on purpose (a casually
+    # reloaded *solution* should re-solve its roots cold); these two
+    # methods are the one sanctioned round trip for the full blob.
+
+    def dump_warm_state(self) -> dict:
+        """Every cross-tick warm artifact as one JSON-able blob.
+
+        The inverse is ``load_warm_state``; the round trip is bit-exact
+        (arrays travel as raw bytes), so a restored replanner's next tick
+        is identical to the uninterrupted replanner's. Refuses to snapshot
+        with pipelined ticks in flight — collect() them first; their warm
+        state exists only on the device until redeemed.
+        """
+        if self._in_flight:
+            raise RuntimeError(
+                "cannot dump warm state with pipelined ticks in flight; "
+                "collect() them first"
+            )
+        blob: dict = {
+            "version": WARM_BLOB_VERSION,
+            "shape": list(self._last_shape) if self._last_shape else None,
+            "last": None,
+            "ipm_state": None,
+            "margin_state": None,
+            "load_factors": _encode_state(self._load_factors),
+        }
+        if self.last is not None:
+            blob["last"] = self.last.model_dump()
+            blob["ipm_state"] = _encode_state(self.last.ipm_state)
+        if self._margin_state:
+            ms = {k: v for k, v in self._margin_state.items() if k != "used"}
+            blob["margin_state"] = _encode_state(ms)
+        return blob
+
+    def load_warm_state(self, blob: dict) -> None:
+        """Restore a ``dump_warm_state`` blob into this replanner.
+
+        Replaces every piece of cross-tick state (an implicit ``reset()``
+        first); the replanner's configuration (gap, backend, search knobs)
+        stays its own — warm state interchanges across engines by design,
+        so a blob dumped under one ``lp_backend`` warm-starts the other.
+        """
+        version = blob.get("version")
+        if version != WARM_BLOB_VERSION:
+            raise ValueError(
+                f"unknown warm-state blob version {version!r} "
+                f"(this build reads {WARM_BLOB_VERSION})"
+            )
+        self.reset()
+        if blob.get("last") is not None:
+            result = HALDAResult.model_validate(blob["last"])
+            result.ipm_state = _decode_state(blob.get("ipm_state"))
+            self.last = result
+        shape = blob.get("shape")
+        self._last_shape = tuple(shape) if shape else None
+        ms = _decode_state(blob.get("margin_state"))
+        self._margin_state = ms if isinstance(ms, dict) else {}
+        lf = _decode_state(blob.get("load_factors"))
+        self._load_factors = lf

@@ -23,18 +23,32 @@ so reports and schemas stay importable without a backend.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from .model import PlacementVectors
 
 # The jitted kernel, built on first use (lazy jax import). jit's own cache
 # handles per-shape (M, R) specialization behind this single callable.
+# Build is locked: the gateway's shard workers score risk-aware ticks
+# from several threads, and two concurrent first uses would otherwise
+# both trace (wasted compile) and race the global's publication.
 _KERNEL = None
+_KERNEL_LOCK = threading.Lock()
 
 
 def _get_kernel():
     global _KERNEL
     if _KERNEL is not None:
+        return _KERNEL
+    with _KERNEL_LOCK:
+        return _build_kernel()
+
+
+def _build_kernel():
+    global _KERNEL
+    if _KERNEL is not None:  # lost the build race: use the winner's
         return _KERNEL
 
     import jax
@@ -184,4 +198,5 @@ def run_monte_carlo(
 def reset_kernel_cache() -> None:
     """Drop the jitted program (tests use this to count retraces)."""
     global _KERNEL
-    _KERNEL = None
+    with _KERNEL_LOCK:
+        _KERNEL = None

@@ -36,6 +36,7 @@ def test_registry_has_all_rule_codes():
     expected = {
         "DLP001", "DLP002", "DLP010", "DLP011",
         "DLP012", "DLP013", "DLP014", "DLP015", "DLP016", "DLP017",
+        "DLP018",
     }
     assert expected <= set(RULES)
     for code, rule in RULES.items():
@@ -545,6 +546,23 @@ def test_twin_layer_is_backend_touching_for_entry_points():
     assert len(out) == 1
 
 
+def test_gateway_layer_is_lazy_for_dlp013():
+    out = findings_for("DLP013", "distilp_tpu/gateway/gateway2.py", """\
+        import jax
+
+        def f():
+            return jax
+        """)
+    assert len(out) == 1
+    out = findings_for("DLP013", "distilp_tpu/gateway/gateway2.py", """\
+        def f():
+            import jax
+
+            return jax
+        """)
+    assert out == []
+
+
 def test_twin_layer_is_lazy_for_dlp013():
     out = findings_for("DLP013", "distilp_tpu/twin/engine.py", """\
         import jax
@@ -802,6 +820,119 @@ def test_silent_except_outside_sched_not_flagged():
                 self.solve()
             except RuntimeError:
                 pass
+        """)
+    assert out == []
+
+
+def test_silent_except_in_gateway_flagged():
+    # The gateway tier inherits the observability contract: its worker
+    # and HTTP layers must account every swallowed fault.
+    out = findings_for("DLP017", "distilp_tpu/gateway/newpart.py", """\
+        def run(self):
+            try:
+                self.fn()
+            except RuntimeError:
+                pass
+        """)
+    assert len(out) == 1 and "metrics sink" in out[0].message
+
+
+# --------------------------------------------------------------------------
+# DLP018 — no blocking calls inside async def bodies in the gateway
+
+
+def test_time_sleep_in_async_gateway_flagged():
+    out = findings_for("DLP018", "distilp_tpu/gateway/http2.py", """\
+        import time
+
+        async def handle(self, reader, writer):
+            time.sleep(0.1)
+        """)
+    assert len(out) == 1 and "blocks the gateway event loop" in out[0].message
+
+
+def test_bare_sleep_import_in_async_gateway_flagged():
+    out = findings_for("DLP018", "distilp_tpu/gateway/http2.py", """\
+        from time import sleep
+
+        async def handle(self):
+            sleep(1)
+        """)
+    assert len(out) == 1 and "time.sleep" in out[0].message
+
+
+def test_subprocess_run_in_async_gateway_flagged():
+    out = findings_for("DLP018", "distilp_tpu/gateway/ops.py", """\
+        import subprocess
+
+        async def deploy(self):
+            subprocess.run(["restart"])
+        """)
+    assert len(out) == 1
+
+
+def test_aliased_blocking_imports_in_async_gateway_flagged():
+    # Both binding forms must resolve: `from subprocess import run` and a
+    # module alias (`import time as t`) block exactly as hard as the
+    # literal dotted spellings the rule names.
+    out = findings_for("DLP018", "distilp_tpu/gateway/ops.py", """\
+        from subprocess import run
+
+        async def deploy(self):
+            run(["restart"])
+        """)
+    assert len(out) == 1 and "subprocess.run" in out[0].message
+    out = findings_for("DLP018", "distilp_tpu/gateway/ops.py", """\
+        import time as t
+
+        async def handle(self):
+            t.sleep(1)
+        """)
+    assert len(out) == 1 and "time.sleep" in out[0].message
+
+
+def test_sync_socket_accept_in_async_gateway_flagged():
+    out = findings_for("DLP018", "distilp_tpu/gateway/listener.py", """\
+        async def serve(self, sock):
+            conn, addr = sock.accept()
+            return conn
+        """)
+    assert len(out) == 1 and "accept" in out[0].message
+
+
+def test_asyncio_sleep_in_async_gateway_ok():
+    out = findings_for("DLP018", "distilp_tpu/gateway/http2.py", """\
+        import asyncio
+
+        async def handle(self):
+            await asyncio.sleep(0.1)
+        """)
+    assert out == []
+
+
+def test_blocking_in_sync_def_or_nested_closure_ok():
+    # Sync defs block a worker thread, not the loop; nested closures are
+    # the run_in_executor idiom and run off-loop too.
+    out = findings_for("DLP018", "distilp_tpu/gateway/worker2.py", """\
+        import time
+
+        def drain(self):
+            time.sleep(0.1)
+
+        async def snapshot(self, loop):
+            def _wait():
+                time.sleep(0.5)
+            await loop.run_in_executor(None, _wait)
+        """)
+    assert out == []
+
+
+def test_blocking_async_outside_gateway_not_flagged():
+    out = findings_for("DLP018", "distilp_tpu/sched/x.py", """\
+        import time
+
+        async def tick(self):
+            time.sleep(0.1)
         """)
     assert out == []
 

@@ -1,0 +1,482 @@
+"""Gateway tier: routing, sharded workers, snapshot/restore, HTTP API.
+
+Solver-backed tests reuse the L=32 model + M=4 synthetic fleets and the
+[4, 8] k-grid of tests/test_sched.py, so the jit programs are shared
+across modules within one pytest process and each tick after warmup is
+milliseconds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from distilp_tpu.gateway import (
+    ConsistentHashRouter,
+    Gateway,
+    GatewayHTTPServer,
+    GatewaySnapshot,
+    shard_key,
+)
+from distilp_tpu.gateway.traces import (
+    is_gateway_trace,
+    make_fleet_from_spec,
+    read_gateway_trace,
+    write_gateway_trace,
+)
+from distilp_tpu.sched import DeviceDegrade, LoadTick, generate_trace, write_trace
+from distilp_tpu.sched.metrics import LatencyHist, SchedulerMetrics
+from distilp_tpu.utils import make_synthetic_fleet
+
+GAP = 1e-3
+KS = [4, 8]
+
+
+@pytest.fixture(scope="module")
+def model():
+    from distilp_tpu.profiler.api import profile_model
+
+    return profile_model(
+        "tests/configs/llama31_8b_4bit.json",
+        batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+
+
+def sched_kwargs(**extra):
+    kw = dict(
+        mip_gap=GAP, kv_bits="4bit", backend="jax", k_candidates=KS
+    )
+    kw.update(extra)
+    return kw
+
+
+def fleet_for(fleet_id: str, seed: int, m: int = 4):
+    return make_fleet_from_spec(fleet_id, {"m": m, "seed": seed})
+
+
+# -- router (no solver) ----------------------------------------------------
+
+
+def test_router_deterministic_stable_and_balanced():
+    keys = [shard_key(f"fleet-{i}") for i in range(200)]
+    r1 = ConsistentHashRouter(4)
+    r2 = ConsistentHashRouter(4)
+    # Pure function of (key, worker count): two routers agree, across
+    # processes too (SHA-1, not the salted builtin hash).
+    assert r1.assignments(keys) == r2.assignments(keys)
+    load = r1.load(keys)
+    assert sum(load) == len(keys)
+    # Virtual nodes keep the split from degenerating (no worker starved).
+    assert min(load) >= len(keys) // 4 // 4
+
+    # Reconfiguration churn ~1/N: going 4 -> 5 workers must not reshuffle
+    # everything (warm state moves with a shard; churn is the cost).
+    r5 = ConsistentHashRouter(5)
+    moved = sum(1 for k in keys if r1.owner(k) != r5.owner(k))
+    assert moved < len(keys) // 2
+
+
+def test_shard_key_rejects_reserved_chars():
+    with pytest.raises(ValueError):
+        shard_key("a/b")
+    with pytest.raises(ValueError):
+        shard_key("")
+    assert shard_key("f0", "m1") == "f0::m1"
+
+
+# -- thread-safe metrics (satellite: two-thread hammer) --------------------
+
+
+def test_metrics_hammer_two_threads_exact_counts():
+    """Two writer threads hammer inc/observe while the main thread
+    snapshots continuously. Locks make this exact: without them the
+    counter misses increments (read-modify-write races) and the snapshot
+    sort crashes on 'deque mutated during iteration'."""
+    m = SchedulerMetrics()
+    N = 20_000
+    stop = threading.Event()
+
+    def writer():
+        for i in range(N):
+            m.inc("hammered")
+            m.observe("lat", float(i % 97))
+
+    threads = [threading.Thread(target=writer) for _ in range(2)]
+    snaps = []
+
+    def reader():
+        while not stop.is_set():
+            snap = m.snapshot()
+            snaps.append(snap)
+            h = snap["latency"].get("lat")
+            if h:
+                # A torn hist would report count > 0 with mean 0/0 garbage;
+                # under the lock every snapshot is internally consistent.
+                assert h["count"] >= 1
+                assert h["max_ms"] <= 96.0
+
+    r = threading.Thread(target=reader)
+    for t in threads:
+        t.start()
+    r.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    r.join()
+    assert m.counters["hammered"] == 2 * N
+    final = m.snapshot()
+    assert final["latency"]["lat"]["count"] == 2 * N
+    assert len(snaps) >= 1
+    assert json.dumps(final)
+
+
+def test_latency_hist_concurrent_record_exact():
+    h = LatencyHist()
+    N = 50_000
+
+    def rec():
+        for i in range(N):
+            h.record(float(i % 10))
+
+    ts = [threading.Thread(target=rec) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert h.snapshot()["count"] == 2 * N
+
+
+# -- multi-fleet traces (no solver) ----------------------------------------
+
+
+def test_gateway_trace_roundtrip_and_detection(tmp_path):
+    specs = {"fA": {"m": 3, "seed": 1}, "fB": {"m": 4, "seed": 2}}
+    items = []
+    for fid, spec in specs.items():
+        devs = make_fleet_from_spec(fid, spec)
+        for ev in generate_trace("drift", 3, seed=5, base_fleet=devs):
+            items.append((fid, ev))
+    path = tmp_path / "multi.jsonl"
+    write_gateway_trace(path, specs, items)
+    assert is_gateway_trace(path)
+    back_specs, back_items = read_gateway_trace(path)
+    assert back_specs == specs
+    assert [(f, e.model_dump()) for f, e in back_items] == [
+        (f, e.model_dump()) for f, e in items
+    ]
+    # Device names are namespaced per fleet — no aliasing across shards.
+    assert all(
+        d.name.startswith("fA-") for d in make_fleet_from_spec("fA", specs["fA"])
+    )
+
+    # A single-fleet trace is NOT detected as a gateway trace.
+    single = tmp_path / "single.jsonl"
+    write_trace(single, generate_trace(
+        "drift", 3, seed=5, base_fleet=make_synthetic_fleet(3, seed=9)
+    ))
+    assert not is_gateway_trace(single)
+
+    # Events for undeclared fleets are rejected, not silently dropped.
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"fleet": "ghost", "event": {"kind": "load"}}\n')
+    with pytest.raises(ValueError, match="undeclared fleet"):
+        read_gateway_trace(bad)
+
+
+# -- the serving tier (JAX backend on CPU) ---------------------------------
+
+
+def test_gateway_multi_fleet_concurrent_replay(model):
+    """Three fleets through two workers, streams replayed concurrently:
+    every tick certified, per-fleet ordering preserved (drift rides warm
+    after the cold bootstrap), worker ownership fixed per shard."""
+    specs = {f"g{i}": {"m": 4, "seed": 30 + i} for i in range(3)}
+    gw = Gateway(n_workers=2, scheduler_kwargs=sched_kwargs())
+    try:
+        traces = {}
+        for fid, spec in specs.items():
+            devs = make_fleet_from_spec(fid, spec)
+            gw.register_fleet(fid, devs, model)
+            traces[fid] = generate_trace(
+                "drift", 4, seed=40 + int(fid[1]), base_fleet=devs
+            )
+
+        async def drive(fid):
+            out = []
+            for ev in traces[fid]:
+                out.append(await gw.handle_event_async(fid, ev))
+            return out
+
+        async def main():
+            return await asyncio.gather(*(drive(f) for f in specs))
+
+        views = asyncio.run(main())
+        for fleet_views in views:
+            assert all(v.result.certified for v in fleet_views)
+            assert all(v.events_behind == 0 for v in fleet_views)
+        snap = gw.metrics_snapshot()
+        assert snap["shard_totals"]["events_total"] == 12
+        assert snap["shard_totals"]["tick_failed"] == 0
+        # Cold only for the bootstrap tick of each shard; drift rides warm.
+        assert snap["shard_totals"]["tick_cold"] == 3
+        assert snap["shard_totals"]["tick_warm"] == 9
+        # Every event for a shard landed on its one owning worker.
+        per_worker = [
+            snap["counters"].get(f"worker_{i}_events", 0) for i in range(2)
+        ]
+        assert sum(per_worker) == 12
+        assert gw.healthz()["status"] == "healthy"
+    finally:
+        gw.close()
+
+
+def test_shard_health_isolation_broken_fleet_never_degrades_neighbor(model):
+    """The per-shard HealthState pin: a fleet whose solves fail (injected
+    via the scheduler's fault_hook seam) goes broken behind its breaker;
+    a healthy fleet sharing the gateway — even the same worker — keeps
+    serving certified warm ticks with untouched health."""
+    gw = Gateway(
+        n_workers=2,
+        scheduler_kwargs=sched_kwargs(breaker_threshold=2, max_retries=0),
+    )
+    try:
+        for fid, seed in (("sick", 50), ("well", 51)):
+            gw.register_fleet(fid, fleet_for(fid, seed), model)
+        # Bootstrap both (publish a placement so failures serve stale).
+        for fid in ("sick", "well"):
+            gw.handle_event(fid, LoadTick(t_comm_jitter={}))
+
+        def explode(attempt):
+            raise RuntimeError("injected: this shard's solver is down")
+
+        gw.scheduler("sick").fault_hook = explode
+        sick_dev = gw.scheduler("sick").fleet.device_list()[1].name
+        well_dev = gw.scheduler("well").fleet.device_list()[1].name
+        for i in range(4):
+            v_sick = gw.handle_event(
+                "sick", DeviceDegrade(name=sick_dev, t_comm_scale=1.01)
+            )
+            v_well = gw.handle_event(
+                "well", DeviceDegrade(name=well_dev, t_comm_scale=1.01)
+            )
+            assert v_sick.events_behind > 0  # serving last-known-good
+            assert v_well.events_behind == 0 and v_well.result.certified
+
+        health = gw.healthz()
+        assert health["shards"]["sick"]["state"] == "broken"
+        assert health["shards"]["sick"]["breaker_open"] is True
+        assert health["shards"]["well"]["state"] == "healthy"
+        assert health["status"] == "broken"  # worst-of aggregation
+        well_counters = gw.scheduler("well").metrics.counters
+        assert well_counters["tick_failed"] == 0
+        assert well_counters["drift_tick_warm"] == 4
+    finally:
+        gw.close()
+
+
+@pytest.mark.parametrize("engine", ["ipm", "pdhg"])
+def test_snapshot_restore_mid_trace_identical_and_warm(model, engine, tmp_path):
+    """The acceptance pin, both LP engines: snapshot mid-trace, restore
+    into a FRESH gateway (different worker count), replay the suffix —
+    final placements identical to the uninterrupted run, first tick per
+    restored shard warm (warm_resumes == shards), zero cold re-solves."""
+    from distilp_tpu.gateway import load_snapshot, save_snapshot
+
+    extra = {"lp_backend": engine}
+    if engine == "pdhg":
+        extra["pdhg_iters"] = 400
+    specs = {f"s{i}": {"m": 4, "seed": 60 + i} for i in range(2)}
+    traces = {
+        fid: generate_trace(
+            "drift", 4, seed=70 + i, base_fleet=make_fleet_from_spec(fid, spec)
+        )
+        for i, (fid, spec) in enumerate(specs.items())
+    }
+    items = [(fid, ev) for j in range(4) for fid, ev in
+             ((f, traces[f][j]) for f in specs)]
+
+    def fresh(n_workers):
+        gw = Gateway(n_workers=n_workers, scheduler_kwargs=sched_kwargs(**extra))
+        for fid, spec in specs.items():
+            gw.register_fleet(fid, make_fleet_from_spec(fid, spec), model)
+        return gw
+
+    finals_a = {}
+    gw_a = fresh(2)
+    try:
+        for fid, ev in items:
+            finals_a[fid] = gw_a.handle_event(fid, ev)
+    finally:
+        gw_a.close()
+
+    gw_b = fresh(2)
+    try:
+        for fid, ev in items[:4]:
+            gw_b.handle_event(fid, ev)
+        save_snapshot(gw_b.snapshot(), tmp_path)
+    finally:
+        gw_b.close()
+
+    snap = load_snapshot(tmp_path)
+    assert isinstance(snap, GatewaySnapshot)
+    gw_c = Gateway(n_workers=3, scheduler_kwargs=sched_kwargs(**extra))
+    try:
+        gw_c.load_snapshot(snap)
+        finals_c = {}
+        uncovered = gw_c.uncovered(items)
+        # The cursor covers exactly the snapshotted prefix.
+        assert len(uncovered) == len(items) - 4
+        for fid, ev in uncovered:
+            finals_c[fid] = gw_c.handle_event(fid, ev)
+        for fid in specs:
+            a, c = finals_a[fid].result, finals_c[fid].result
+            assert (a.k, a.w, a.n, a.obj_value) == (c.k, c.w, c.n, c.obj_value)
+        totals = gw_c.metrics_snapshot()["shard_totals"]
+        assert totals["warm_resumes"] == len(specs)
+        assert totals["cold_resumes"] == 0
+        assert totals["tick_cold"] == 0  # zero cold re-solves after restore
+    finally:
+        gw_c.close()
+
+
+def test_snapshot_restore_preserves_latest_without_solving(model):
+    """A restored gateway serves latest() immediately — the published
+    placement rides the snapshot; no event needed before the first read."""
+    gw = Gateway(n_workers=1, scheduler_kwargs=sched_kwargs())
+    try:
+        gw.register_fleet("p0", fleet_for("p0", 80), model)
+        served = gw.handle_event("p0", LoadTick(t_comm_jitter={}))
+        snap = gw.snapshot()
+    finally:
+        gw.close()
+    # JSON round trip, like the on-disk file.
+    snap = GatewaySnapshot.model_validate(json.loads(json.dumps(snap.model_dump())))
+    gw2 = Gateway(n_workers=2, scheduler_kwargs=sched_kwargs())
+    try:
+        gw2.load_snapshot(snap)
+        view = gw2.latest("p0")
+        assert view.result.obj_value == served.result.obj_value
+        assert view.events_behind == 0
+    finally:
+        gw2.close()
+
+
+def test_http_api_roundtrip(model):
+    """POST /events ticks the shard and returns the placement; GETs serve
+    placement/health/metrics; unknown fleets 404. Exercised over a real
+    socket against the asyncio server."""
+    import urllib.error
+    import urllib.request
+
+    gw = Gateway(n_workers=2, scheduler_kwargs=sched_kwargs())
+
+    def post(port, path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def get(port, path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=60
+            ) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        gw.register_fleet("h0", fleet_for("h0", 90), model)
+
+        async def main():
+            srv = GatewayHTTPServer(gw)
+            await srv.start()
+            loop = asyncio.get_running_loop()
+            port = srv.port
+            ev = {"kind": "load", "t_comm_jitter": {}}
+            st, out = await loop.run_in_executor(
+                None, post, port, "/events", {"fleet": "h0", "event": ev}
+            )
+            assert st == 200 and out["view"]["certified"]
+            assert out["view"]["k"] in KS
+            st, out = await loop.run_in_executor(
+                None, get, port, "/placement/h0"
+            )
+            assert st == 200 and out["view"]["events_behind"] == 0
+            st, out = await loop.run_in_executor(None, get, port, "/healthz")
+            assert st == 200 and out["status"] == "healthy"
+            st, out = await loop.run_in_executor(None, get, port, "/metrics")
+            assert st == 200
+            assert out["counters"]["gateway_events"] == 1
+            assert out["shard_totals"]["tick_certified"] == 1
+            st, _ = await loop.run_in_executor(
+                None, get, port, "/placement/ghost"
+            )
+            assert st == 404
+            st, _ = await loop.run_in_executor(None, get, port, "/nope")
+            assert st == 404
+            st, out = await loop.run_in_executor(
+                None, post, port, "/events", {"fleet": "h0"}
+            )
+            await srv.close()
+            return st
+
+        st = asyncio.run(main())
+        assert st == 400  # event-less POST is a client error
+    finally:
+        gw.close()
+
+
+def test_structural_first_event_after_restore_is_not_a_cold_resume(model):
+    """A structural event landing as the FIRST post-restore tick changes
+    the shard's identity; the legitimate cold solve it triggers must count
+    as resume_identity_changed — flagging it cold_resumes would fail the
+    zero-downtime audit on a perfectly healthy restore."""
+    from distilp_tpu.sched import DeviceLeave
+
+    gw = Gateway(n_workers=1, scheduler_kwargs=sched_kwargs())
+    try:
+        gw.register_fleet("r0", fleet_for("r0", 97), model)
+        gw.handle_event("r0", LoadTick(t_comm_jitter={}))
+        snap = gw.snapshot()
+    finally:
+        gw.close()
+    gw2 = Gateway(n_workers=1, scheduler_kwargs=sched_kwargs())
+    try:
+        gw2.load_snapshot(snap)
+        victim = gw2.scheduler("r0").fleet.device_list()[-1].name
+        view = gw2.handle_event("r0", DeviceLeave(name=victim))
+        assert view.events_behind == 0
+        c = gw2.scheduler("r0").metrics.counters
+        assert c["resume_identity_changed"] == 1
+        assert c["cold_resumes"] == 0 and c["warm_resumes"] == 0
+    finally:
+        gw2.close()
+
+
+def test_register_duplicate_and_unknown_fleet_errors(model):
+    gw = Gateway(n_workers=1, scheduler_kwargs=sched_kwargs())
+    try:
+        gw.register_fleet("d0", fleet_for("d0", 95), model)
+        with pytest.raises(ValueError, match="already registered"):
+            gw.register_fleet("d0", fleet_for("d0", 95), model)
+        # Same fleet under a DIFFERENT model id must also be rejected: the
+        # ingest directory is keyed by fleet, and a second shard would
+        # silently clobber the first's routing and resume cursor.
+        with pytest.raises(ValueError, match="already registered"):
+            gw.register_fleet("d0", fleet_for("d0", 95), model, model_id="m2")
+        with pytest.raises(KeyError, match="unknown fleet"):
+            gw.handle_event("nope", LoadTick(t_comm_jitter={}))
+    finally:
+        gw.close()

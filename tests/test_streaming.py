@@ -8,6 +8,7 @@ solve must certify to the same answer — warm only changes how fast.
 from __future__ import annotations
 
 import copy
+import json
 
 import pytest
 
@@ -326,3 +327,126 @@ def test_submit_snapshot_is_shallow_but_freezes_scalars(fleet_and_model):
 
     result = planner.collect()  # drain the in-flight tick
     assert result.certified
+
+
+# -- warm-state snapshot/restore (dump_warm_state / load_warm_state) -------
+#
+# The gateway's drain/restore cycle rides these: the round trip must be
+# bit-exact, so a restored replanner's next tick — same drift applied —
+# is IDENTICAL to the uninterrupted replanner's, on both LP engines.
+
+
+@pytest.fixture(scope="module")
+def small_fleet_and_model():
+    """L=32 model + M=4 fleet: same shapes as tests/test_sched.py, so the
+    jit programs are shared across modules within one pytest process."""
+    from distilp_tpu.profiler.api import profile_model
+
+    model = profile_model(
+        "tests/configs/llama31_8b_4bit.json",
+        batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+    return make_synthetic_fleet(4, seed=11), model
+
+
+@pytest.mark.parametrize("engine", ["ipm", "pdhg"])
+def test_warm_blob_roundtrip_matches_uninterrupted(
+    small_fleet_and_model, engine
+):
+    devs, model = small_fleet_and_model
+    devs = [copy.deepcopy(d) for d in devs]
+    ks = [4, 8]
+    search = {"lp_backend": engine}
+    if engine == "pdhg":
+        search["pdhg_iters"] = 400  # tiny instance; full default is waste
+    p = StreamingReplanner(
+        mip_gap=GAP, kv_bits="4bit", backend="jax", search=search
+    )
+    p.step(devs, model, k_candidates=ks)
+    for d in devs:
+        d.t_comm *= 1.02
+    p.step(devs, model, k_candidates=ks)
+
+    # The blob is JSON all the way down (it rides GatewaySnapshot files).
+    blob = json.loads(json.dumps(p.dump_warm_state()))
+    q = StreamingReplanner(
+        mip_gap=GAP, kv_bits="4bit", backend="jax", search=search
+    )
+    q.load_warm_state(blob)
+    # Restored warm artifacts are bit-identical, not just close.
+    assert q.last is not None and q.last.ipm_state is not None
+    import numpy as np
+
+    for key, arr in p.last.ipm_state.items():
+        assert np.array_equal(np.asarray(arr), np.asarray(q.last.ipm_state[key]))
+    assert q._last_shape == p._last_shape
+    assert q.last.duals == p.last.duals
+
+    for d in devs:
+        d.t_comm *= 0.97
+    r_uninterrupted = p.step(devs, model, k_candidates=ks)
+    r_restored = q.step(devs, model, k_candidates=ks)
+    assert q.last_tick_mode == "warm"  # the restore's whole point
+    assert p.last_tick_mode == "warm"
+    assert (
+        r_restored.k,
+        r_restored.w,
+        r_restored.n,
+        r_restored.obj_value,
+    ) == (
+        r_uninterrupted.k,
+        r_uninterrupted.w,
+        r_uninterrupted.n,
+        r_uninterrupted.obj_value,
+    )
+
+
+def test_warm_blob_roundtrip_preserves_margin_anchor():
+    """MoE: the margin fast path's anchor (rd exact-match fields + m_y
+    profile + duals) must survive the round trip — the restored tick rides
+    the MARGIN path, not merely warm."""
+    from distilp_tpu.profiler.api import profile_model
+
+    moe_model = profile_model(
+        "tests/configs/mixtral_8x7b.json",
+        batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+    devs = make_synthetic_fleet(4, seed=7, pool_bytes=int(64e9))
+    p = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
+    p.step(devs, moe_model)
+    devs[1].t_comm *= 1.01
+    p.step(devs, moe_model)
+
+    blob = json.loads(json.dumps(p.dump_warm_state()))
+    q = StreamingReplanner(mip_gap=GAP, kv_bits="8bit", backend="jax")
+    q.load_warm_state(blob)
+
+    devs[2].t_comm *= 1.02
+    r_p = p.step(devs, moe_model)
+    r_q = q.step(devs, moe_model)
+    assert p.last_tick_mode == "margin"
+    assert q.last_tick_mode == "margin"
+    assert r_p.certified and r_q.certified
+    assert (r_p.k, r_p.w, r_p.n, r_p.y, r_p.obj_value) == (
+        r_q.k,
+        r_q.w,
+        r_q.n,
+        r_q.y,
+        r_q.obj_value,
+    )
+
+
+def test_warm_blob_refuses_in_flight_and_bad_version(small_fleet_and_model):
+    devs, model = small_fleet_and_model
+    planner = StreamingReplanner(mip_gap=GAP, kv_bits="4bit", backend="jax")
+    planner.submit(devs, model, k_candidates=[4, 8])
+    with pytest.raises(RuntimeError, match="in flight"):
+        planner.dump_warm_state()
+    planner.collect()
+    blob = planner.dump_warm_state()
+    blob["version"] = 99
+    fresh = StreamingReplanner(mip_gap=GAP, kv_bits="4bit", backend="jax")
+    with pytest.raises(ValueError, match="version"):
+        fresh.load_warm_state(blob)

@@ -47,6 +47,11 @@ LAZY_JAX_PREFIXES = (
     # The twin layer's report schemas must parse without a backend; the
     # engine lazy-imports jax inside its kernel builder.
     "distilp_tpu/twin/",
+    # The gateway tier routes, snapshots and serves HTTP without touching
+    # a backend itself — only the schedulers its workers build do; a
+    # top-level jax import here would drag backend init into every
+    # process that merely parses a snapshot or a multi-fleet trace.
+    "distilp_tpu/gateway/",
 )
 LAZY_JAX_MODULES = {
     "distilp_tpu/__init__.py",
@@ -75,6 +80,9 @@ GUARDED_LIBRARY_FILES = {
     "distilp_tpu/solver/api.py",
     "distilp_tpu/solver/streaming.py",
     "distilp_tpu/twin/api.py",
+    # Gateway construction builds Schedulers (backend work) for plain
+    # library users with no CLI shim in between.
+    "distilp_tpu/gateway/gateway.py",
 }
 
 # Modules whose IMPORT eagerly loads jax (top-level `import jax` in the
@@ -94,6 +102,7 @@ BACKEND_TOUCHING_PREFIXES = (
     "distilp_tpu.parallel",
     "distilp_tpu.sched",
     "distilp_tpu.twin",
+    "distilp_tpu.gateway",
     "distilp_tpu.utils",
     "distilp_tpu.profiler.device",
     "distilp_tpu.profiler.topology",
@@ -772,16 +781,17 @@ class SilentExceptInScheduler(Rule):
     code = "DLP017"
     name = "silent-except-in-sched"
     rationale = (
-        "The scheduler service is the layer that PROMISES observability "
+        "The serving layers are the ones that PROMISE observability "
         "under faults (README degraded-mode semantics: every fault is "
         "counted, health is derived from counters). A `try/except` in "
-        "distilp_tpu/sched/ that neither re-raises nor records through the "
-        "metrics sink swallows exactly the signal the chaos soak audits — "
-        "a fault recovers 'successfully' while the counters (and therefore "
-        "HealthState and every dashboard) claim nothing happened."
+        "distilp_tpu/sched/ or distilp_tpu/gateway/ that neither "
+        "re-raises nor records through the metrics sink swallows exactly "
+        "the signal the chaos soak audits — a fault recovers "
+        "'successfully' while the counters (and therefore HealthState "
+        "and every dashboard) claim nothing happened."
     )
 
-    _PATH_PREFIX = "distilp_tpu/sched/"
+    _PATH_PREFIXES = ("distilp_tpu/sched/", "distilp_tpu/gateway/")
     # Attribute calls that count as recording through the metrics sink.
     # `_quarantine` is the scheduler's fault recorder (it increments the
     # quarantine counters and the health state); delegating to it from a
@@ -789,7 +799,9 @@ class SilentExceptInScheduler(Rule):
     _SINK_METHODS = {"inc", "observe", "record_tick", "_quarantine"}
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
-        if not ctx.relpath.startswith(self._PATH_PREFIX) or ctx.is_test:
+        if ctx.is_test or not any(
+            ctx.relpath.startswith(p) for p in self._PATH_PREFIXES
+        ):
             return
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.ExceptHandler):
@@ -800,10 +812,10 @@ class SilentExceptInScheduler(Rule):
                 ctx.relpath,
                 node.lineno,
                 self.code,
-                "except handler in sched/ neither re-raises nor records "
-                "through the metrics sink (.inc/.observe/.record_tick); "
-                "silent recovery hides faults from HealthState and the "
-                "chaos soak's accounting",
+                "except handler in sched//gateway/ neither re-raises nor "
+                "records through the metrics sink "
+                "(.inc/.observe/.record_tick); silent recovery hides "
+                "faults from HealthState and the chaos soak's accounting",
             )
 
     def _handler_accounts(self, handler: ast.ExceptHandler) -> bool:
@@ -816,3 +828,110 @@ class SilentExceptInScheduler(Rule):
                 if node.func.attr in self._SINK_METHODS:
                     return True
         return False
+
+
+@register
+class BlockingCallInAsyncGateway(Rule):
+    code = "DLP018"
+    name = "blocking-call-in-async"
+    rationale = (
+        "The gateway's asyncio loop is the single ingest thread for EVERY "
+        "fleet's HTTP traffic: one `time.sleep`, synchronous socket "
+        "accept/recv, or `subprocess.run` inside an `async def` there "
+        "stalls all of them at once — the exact cross-fleet isolation "
+        "failure the sharded-worker design exists to rule out. Blocking "
+        "work belongs on the shard workers (queue + thread) or behind "
+        "`loop.run_in_executor`; the event loop only parses and routes. "
+        "Nested synchronous defs inside an async body are exempt — they "
+        "are the executor-closure idiom, judged where they run."
+    )
+
+    _PATH_PREFIXES = ("distilp_tpu/gateway/",)
+    # module -> function names that block the loop outright. Matched
+    # through ALIASES too: `import time as t; t.sleep(...)` and
+    # `from subprocess import run` block exactly as hard as the literal
+    # dotted spellings, so the ban resolves both binding forms.
+    _BANNED_FUNCS = {
+        "time": {"sleep"},
+        "subprocess": {"run", "call", "check_call", "check_output"},
+    }
+    # Attribute calls that are synchronous socket operations (the asyncio
+    # equivalents are loop.sock_accept / StreamReader reads and never
+    # spell these bare names).
+    _BANNED_ATTRS = {"accept", "recv", "recvfrom", "recv_into"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.is_test or not any(
+            ctx.relpath.startswith(p) for p in self._PATH_PREFIXES
+        ):
+            return
+        # Resolve both import forms down to local names:
+        #   module_aliases: local module name -> canonical ("t" -> "time")
+        #   banned_names:   local bare name -> canonical dotted call
+        module_aliases: Dict[str, str] = {}
+        banned_names: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in self._BANNED_FUNCS:
+                        module_aliases[a.asname or a.name] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                funcs = self._BANNED_FUNCS.get(node.module or "")
+                if funcs:
+                    for a in node.names:
+                        if a.name in funcs:
+                            banned_names[a.asname or a.name] = (
+                                f"{node.module}.{a.name}"
+                            )
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                yield from self._scan_async_body(
+                    ctx, node, module_aliases, banned_names
+                )
+
+    def _scan_async_body(
+        self, ctx, func, module_aliases, banned_names
+    ) -> Iterator[Finding]:
+        stack: List[ast.AST] = list(func.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                # Nested scopes run elsewhere (executor closures, worker
+                # callbacks); nested async defs get their own walk.
+                continue
+            if isinstance(node, ast.Call):
+                fn = dotted_name(node.func)
+                reason = None
+                head, _, tail = fn.partition(".")
+                module = module_aliases.get(head, head)
+                if (
+                    tail
+                    and "." not in tail
+                    and tail in self._BANNED_FUNCS.get(module, ())
+                ):
+                    reason = f"`{module}.{tail}()`"
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in banned_names
+                ):
+                    reason = (
+                        f"`{node.func.id}()` ({banned_names[node.func.id]})"
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._BANNED_ATTRS
+                ):
+                    reason = f"synchronous socket `.{node.func.attr}()`"
+                if reason is not None:
+                    yield Finding(
+                        ctx.relpath,
+                        node.lineno,
+                        self.code,
+                        f"{reason} inside `async def {func.name}` blocks "
+                        "the gateway event loop for every fleet; use "
+                        "await asyncio.sleep / the shard-worker queue / "
+                        "loop.run_in_executor",
+                    )
+            stack.extend(ast.iter_child_nodes(node))
