@@ -324,6 +324,43 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "the restored run's first tick per shard must ride warm "
         "(warm_resumes counter; zero cold re-solves)",
     )
+    # Observability (distilp_tpu.obs; README "Observability"). All three
+    # default off — serving without them is byte-identical to the
+    # uninstrumented daemon.
+    p.add_argument(
+        "--trace-spans-dir",
+        default=None,
+        metavar="DIR",
+        help="span tracing: record every event's span tree (HTTP ingest -> "
+        "route -> worker queue wait -> tick -> solve -> publish) to "
+        "DIR/spans.jsonl; convert with `solver spans` into Chrome "
+        "trace-event JSON (Perfetto / chrome://tracing)",
+    )
+    p.add_argument(
+        "--flight-dir",
+        default=None,
+        metavar="DIR",
+        help="flight recorder: keep a bounded ring of the last N tick "
+        "records per shard (mode, health, counter deltas, span ids, LP "
+        "engine), auto-dumped to a post-mortem JSONL in DIR on "
+        "breaker-open or a chaos-contract violation, and readable live "
+        "via GET /debug/flight/<fleet> when --listen is up",
+    )
+    p.add_argument(
+        "--flight-capacity",
+        type=int,
+        default=128,
+        help="tick records kept per shard in the flight recorder's ring",
+    )
+    p.add_argument(
+        "--jax-profile-dir",
+        default=None,
+        metavar="DIR",
+        help="wrap the FIRST cold solve tick in jax.profiler.trace(DIR) "
+        "(XLA profile for the TPU path; single-scheduler serving only — "
+        "concurrent gateway workers would race the process-global "
+        "profiler)",
+    )
     return p
 
 
@@ -528,6 +565,38 @@ def evaluate_main(argv=None) -> int:
     return 0
 
 
+def _build_obs(args):
+    """(tracer, writer, flight) from the serve observability flags.
+
+    One tracer + one flight recorder per process, shared across shards;
+    None everywhere when the flags are off, so the scheduler/gateway run
+    their uninstrumented default paths.
+    """
+    tracer = writer = flight = None
+    if args.trace_spans_dir:
+        from ..obs import JsonlSpanWriter, Tracer
+
+        writer = JsonlSpanWriter(Path(args.trace_spans_dir) / "spans.jsonl")
+        tracer = Tracer(capacity=65536, writer=writer)
+    if args.flight_dir:
+        from ..obs import FlightRecorder
+
+        flight = FlightRecorder(
+            capacity=max(1, args.flight_capacity), dump_dir=args.flight_dir
+        )
+    return tracer, writer, flight
+
+
+def _obs_summary(writer, flight) -> dict:
+    out = {}
+    if writer is not None:
+        out["spans_written"] = writer.written
+        out["spans_path"] = str(writer.path)
+    if flight is not None:
+        out["flight_dumps"] = [str(p) for p in flight.dumps]
+    return out
+
+
 def serve_main(argv=None) -> int:
     """``solver serve``: replay a churn trace through the scheduler daemon."""
     args = build_serve_parser().parse_args(argv)
@@ -550,6 +619,15 @@ def serve_main(argv=None) -> int:
 
         gateway_mode = is_gateway_trace(args.trace)
     if gateway_mode:
+        if args.jax_profile_dir:
+            # jax.profiler.trace is process-global; two shard workers
+            # profiling their first ticks concurrently would race it.
+            print(
+                "error: --jax-profile-dir needs the single-scheduler path "
+                "(no gateway flags, --workers 1, single-fleet trace)",
+                file=sys.stderr,
+            )
+            return 2
         return _serve_gateway(args)
     if args.snapshot_at is not None or args.halt_after_snapshot:
         print(
@@ -619,6 +697,7 @@ def serve_main(argv=None) -> int:
     if args.breaker_threshold is not None:
         harden_kw["breaker_threshold"] = args.breaker_threshold
 
+    tracer, writer, flight = _build_obs(args)
     sched = Scheduler(
         devices,
         model,
@@ -634,6 +713,9 @@ def serve_main(argv=None) -> int:
         risk_aware=args.risk_aware,
         risk_samples=args.risk_samples,
         risk_seed=args.risk_seed,
+        tracer=tracer,
+        flight=flight,
+        jax_profile_dir=args.jax_profile_dir,
         **harden_kw,
     )
 
@@ -667,6 +749,8 @@ def serve_main(argv=None) -> int:
         return 1
     finally:
         sched.close()  # release the deadline worker (no-op when unused)
+        if tracer is not None:
+            tracer.close()  # flush the span JSONL
 
     summary = {
         "replay": report.summary(),
@@ -677,6 +761,13 @@ def serve_main(argv=None) -> int:
         summary["health"] = sched.health_snapshot()
     if chaos is not None:
         summary["chaos"] = chaos.summary()
+        if flight is not None and chaos.violations(sched.fleet.model.L):
+            # A violated soak is exactly the post-mortem moment the flight
+            # recorder exists for — dump before the process reports it.
+            if flight.trigger("default", "chaos_violation") is not None:
+                sched.metrics.inc("flight_dumps")
+    if writer is not None or flight is not None:
+        summary["obs"] = _obs_summary(writer, flight)
     if args.risk_aware:
         c = sched.metrics.counters
         summary["risk"] = {
@@ -834,7 +925,13 @@ def _serve_gateway(args) -> int:
     if args.breaker_threshold is not None:
         scheduler_kwargs["breaker_threshold"] = args.breaker_threshold
 
-    gw = Gateway(n_workers=args.workers, scheduler_kwargs=scheduler_kwargs)
+    tracer, writer, flight = _build_obs(args)
+    gw = Gateway(
+        n_workers=args.workers,
+        scheduler_kwargs=scheduler_kwargs,
+        tracer=tracer,
+        flight=flight,
+    )
     try:
         if args.resume:
             try:
@@ -976,6 +1073,13 @@ def _serve_gateway(args) -> int:
             )
         if chaos is not None:
             summary["chaos"] = chaos.summary()
+            if flight is not None and chaos.violations(
+                gw.scheduler("default").fleet.model.L
+            ):
+                if flight.trigger("default", "chaos_violation") is not None:
+                    gw.scheduler("default").metrics.inc("flight_dumps")
+        if writer is not None or flight is not None:
+            summary["obs"] = _obs_summary(writer, flight)
         print(json.dumps(summary))
         if args.metrics_out:
             Path(args.metrics_out).write_text(json.dumps(summary, indent=2))
@@ -1017,6 +1121,8 @@ def _serve_gateway(args) -> int:
         return 0
     finally:
         gw.close()
+        if tracer is not None:
+            tracer.close()  # flush the span JSONL
 
 
 def _listen_forever(gw, listen: str, quiet: bool = False) -> int:
@@ -1084,6 +1190,83 @@ def _chaos_to_replay_report(chaos, sched):
     )
 
 
+def build_spans_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solver spans",
+        description="convert a span JSONL (serve --trace-spans-dir) into "
+        "Chrome trace-event JSON loadable in Perfetto (ui.perfetto.dev) or "
+        "chrome://tracing: one track per thread, spans as complete events, "
+        "queue waits as flow arrows from the enqueuing thread to the "
+        "worker that picked the tick up",
+    )
+    p.add_argument(
+        "input",
+        help="span JSONL file, or the --trace-spans-dir directory holding "
+        "spans.jsonl",
+    )
+    p.add_argument(
+        "--out",
+        default=None,
+        metavar="FILE",
+        help="Chrome trace JSON output path (default: <input>.chrome.json)",
+    )
+    p.add_argument(
+        "--top",
+        type=int,
+        default=3,
+        help="also print the N slowest spans (0 disables)",
+    )
+    p.add_argument("--quiet", action="store_true", help="no summary output")
+    return p
+
+
+def spans_main(argv=None) -> int:
+    """``solver spans``: span JSONL -> Chrome trace-event JSON."""
+    args = build_spans_parser().parse_args(argv)
+
+    # Pure JSON-to-JSON: no profiles, no backend, no axon guard needed.
+    from ..obs import read_spans, spans_to_chrome, top_spans
+
+    src = Path(args.input)
+    if src.is_dir():
+        src = src / "spans.jsonl"
+    if not src.is_file():
+        print(f"error: no span JSONL at {src}", file=sys.stderr)
+        return 2
+    try:
+        spans = read_spans(src)
+    except (OSError, ValueError) as e:  # JSONDecodeError is a ValueError
+        print(f"error: cannot parse {src}: {e}", file=sys.stderr)
+        return 2
+    if not spans:
+        print(f"error: {src} holds no spans", file=sys.stderr)
+        return 1
+    chrome = spans_to_chrome(spans)
+    out = Path(args.out) if args.out else src.with_suffix(".chrome.json")
+    out.write_text(json.dumps(chrome))
+    if not args.quiet:
+        traces = len({s["trace_id"] for s in spans})
+        print(
+            f"wrote {out}: {len(chrome['traceEvents'])} trace events from "
+            f"{len(spans)} spans across {traces} traces (load in "
+            "ui.perfetto.dev or chrome://tracing)"
+        )
+        if args.top > 0:
+            print(f"top {args.top} slowest spans:")
+            for s in top_spans(spans, args.top):
+                attrs = s.get("attrs") or {}
+                extra = "".join(
+                    f" {k}={attrs[k]}"
+                    for k in ("fleet", "kind", "mode", "lp_backend")
+                    if k in attrs
+                )
+                print(
+                    f"  {s['dur_ms']:10.3f} ms  {s['name']:<20s} "
+                    f"thread={s.get('thread', '?')}{extra}"
+                )
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -1093,6 +1276,8 @@ def main(argv=None) -> int:
         return serve_main(argv[1:])
     if argv and argv[0] == "evaluate":
         return evaluate_main(argv[1:])
+    if argv and argv[0] == "spans":
+        return spans_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     from ..axon_guard import force_cpu_if_env_requested

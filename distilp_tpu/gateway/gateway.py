@@ -28,6 +28,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..common import DeviceProfile, ModelProfile
+from ..obs.trace import NOOP_TRACER
 from ..sched.metrics import (
     HEALTH_BROKEN,
     HEALTH_DEGRADED,
@@ -75,6 +76,8 @@ class Gateway:
         scheduler_kwargs: Optional[dict] = None,
         scheduler_factory: Optional[Callable] = None,
         metrics: Optional[SchedulerMetrics] = None,
+        tracer=None,
+        flight=None,
     ):
         # Library entry point that dispatches backend work (via the
         # schedulers it builds): arm the axon-wedge guard exactly like
@@ -89,6 +92,15 @@ class Gateway:
         self.scheduler_kwargs = dict(scheduler_kwargs or {})
         self._factory = scheduler_factory
         self.metrics = metrics if metrics is not None else SchedulerMetrics()
+        # Observability (distilp_tpu.obs), opt-in: ONE tracer and ONE
+        # flight recorder shared by the gateway and every shard scheduler
+        # it builds — span parenting crosses the worker-queue boundary by
+        # attaching the ingest span's context on the worker thread, and
+        # flight rings are keyed per fleet. With neither configured the
+        # NOOP tracer makes every instrumentation site a constant-cost
+        # no-op and schedulers are built exactly as before.
+        self.tracer = NOOP_TRACER if tracer is None else tracer
+        self.flight = flight
         self.router = ConsistentHashRouter(n_workers, replicas=replicas)
         self.workers: List[ShardWorker] = [
             ShardWorker(i, metrics=self.metrics) for i in range(n_workers)
@@ -104,11 +116,22 @@ class Gateway:
     # -- shard lifecycle ---------------------------------------------------
 
     def _build_scheduler(
-        self, devices: Sequence[DeviceProfile], model: ModelProfile
+        self,
+        devices: Sequence[DeviceProfile],
+        model: ModelProfile,
+        fleet_id: str = "default",
     ) -> Scheduler:
         if self._factory is not None:
+            # Factory signature stays (devices, model): tests inject
+            # failing schedulers through it and obs plumbing is theirs.
             return self._factory(devices, model)
-        return Scheduler(devices, model, **self.scheduler_kwargs)
+        kw = dict(self.scheduler_kwargs)
+        if self.tracer is not NOOP_TRACER:
+            kw["tracer"] = self.tracer
+        if self.flight is not None:
+            kw["flight"] = self.flight
+            kw["flight_key"] = fleet_id
+        return Scheduler(devices, model, **kw)
 
     def register_fleet(
         self,
@@ -144,7 +167,7 @@ class Gateway:
         worker = self.workers[widx]
 
         def _do() -> None:
-            sched = self._build_scheduler(devices, model)
+            sched = self._build_scheduler(devices, model, fleet_id)
             if state is not None:
                 sched.load_state(state)
             worker.shards[key] = sched
@@ -179,7 +202,9 @@ class Gateway:
 
     # -- ingest ------------------------------------------------------------
 
-    def _tick_closure(self, fleet_id: str, key: str, worker, event):
+    def _tick_closure(
+        self, fleet_id: str, key: str, worker, event, parent=None, t_enq=None
+    ):
         """The queued unit of ingest: tick the shard AND advance the
         fleet's resume cursor, both ON the worker thread. The cursor must
         move inside the closure — a snapshot is a later closure on the
@@ -187,18 +212,36 @@ class Gateway:
         shard state it dumps (bumping the cursor caller-side after the
         wait would let a snapshot read state covering event n with a
         cursor still at n-1, and a resume would double-apply event n).
+
+        ``parent``/``t_enq`` carry the ingest span's context and enqueue
+        timestamp (ms) across the queue: the closure's first act on the
+        worker thread is recording the **queue-wait span** — submit to
+        pickup, the number that diagnoses worker thrash — and attaching
+        the ingest context so the tick's own spans parent under it. With
+        tracing off both are shared no-ops (parent is None).
         """
 
         def _do() -> PlacementView:
-            # finally, not on success: a raising handle() may still have
-            # mutated the fleet (seq advances before the solve fails), and
-            # a cursor one behind the seq would make a resume double-apply
-            # that event. Counting a rejected-and-raised event too only
-            # skips a repeat rejection on resume — always safe.
-            try:
-                return worker.shards[key].handle(event)
-            finally:
-                self._handled[fleet_id] = self._handled.get(fleet_id, 0) + 1
+            self.tracer.record_span(
+                "gateway.queue_wait",
+                t_enq if t_enq is not None else 0.0,
+                None,
+                parent=parent,
+                attrs={"worker": worker.worker_id},
+            )
+            with self.tracer.attach(parent):
+                # finally, not on success: a raising handle() may still
+                # have mutated the fleet (seq advances before the solve
+                # fails), and a cursor one behind the seq would make a
+                # resume double-apply that event. Counting a
+                # rejected-and-raised event too only skips a repeat
+                # rejection on resume — always safe.
+                try:
+                    return worker.shards[key].handle(event)
+                finally:
+                    self._handled[fleet_id] = (
+                        self._handled.get(fleet_id, 0) + 1
+                    )
 
         return _do
 
@@ -209,39 +252,82 @@ class Gateway:
         the queue wait on the owning worker — the number a client sees,
         not just the solve.
         """
-        key, worker = self._lookup(fleet_id)
-        t0 = time.perf_counter()
-        view = worker.call(self._tick_closure(fleet_id, key, worker, event))
-        self._note_handled(worker, t0)
-        return view
+        span = self.tracer.start_span(
+            "gateway.ingest", parent=None, attrs={"fleet": fleet_id}
+        )
+        try:
+            t0 = time.perf_counter()
+            key, worker = self._lookup(fleet_id)
+            self.tracer.record_span(
+                "gateway.route",
+                t0 * 1e3,
+                None,
+                parent=span.context(),
+                attrs={"shard": key, "worker": worker.worker_id},
+            )
+            view = worker.call(
+                self._tick_closure(
+                    fleet_id, key, worker, event,
+                    parent=span.context(), t_enq=t0 * 1e3,
+                )
+            )
+            self._note_handled(worker, t0)
+            return view
+        finally:
+            span.end()
 
-    async def handle_event_async(self, fleet_id: str, event) -> PlacementView:
+    async def handle_event_async(
+        self, fleet_id: str, event, parent=None
+    ) -> PlacementView:
         """Asyncio ingest: enqueue on the owning worker, await the view.
 
         Completion resolves a loop future via ``call_soon_threadsafe`` —
         no executor thread parked per in-flight event, so thousands of
         fleets can await concurrently over a handful of workers.
+
+        ``parent`` is an optional ``SpanContext`` (the HTTP tier's request
+        span). Parenting here is EXPLICIT — on the shared loop thread a
+        thread-local "current span" would leak between interleaved
+        coroutines and mis-parent concurrent fleets' spans.
         """
-        key, worker = self._lookup(fleet_id)
-        loop = asyncio.get_running_loop()
-        fut: "asyncio.Future" = loop.create_future()
-
-        def _resolve(box: dict) -> None:
-            if fut.cancelled():
-                return
-            if "exc" in box:
-                fut.set_exception(box["exc"])
-            else:
-                fut.set_result(box["result"])
-
-        t0 = time.perf_counter()
-        worker.submit(
-            self._tick_closure(fleet_id, key, worker, event),
-            on_done=lambda box: loop.call_soon_threadsafe(_resolve, box),
+        span = self.tracer.start_span(
+            "gateway.ingest", parent=parent, attrs={"fleet": fleet_id}
         )
-        view = await fut
-        self._note_handled(worker, t0)
-        return view
+        try:
+            # t0 BEFORE the lookup, like the sync path: the route span
+            # must actually time the shard resolution, not measure ~0.
+            t0 = time.perf_counter()
+            key, worker = self._lookup(fleet_id)
+            self.tracer.record_span(
+                "gateway.route",
+                t0 * 1e3,
+                None,
+                parent=span.context(),
+                attrs={"shard": key, "worker": worker.worker_id},
+            )
+            loop = asyncio.get_running_loop()
+            fut: "asyncio.Future" = loop.create_future()
+
+            def _resolve(box: dict) -> None:
+                if fut.cancelled():
+                    return
+                if "exc" in box:
+                    fut.set_exception(box["exc"])
+                else:
+                    fut.set_result(box["result"])
+
+            worker.submit(
+                self._tick_closure(
+                    fleet_id, key, worker, event,
+                    parent=span.context(), t_enq=t0 * 1e3,
+                ),
+                on_done=lambda box: loop.call_soon_threadsafe(_resolve, box),
+            )
+            view = await fut
+            self._note_handled(worker, t0)
+            return view
+        finally:
+            span.end()
 
     def _note_handled(self, worker: ShardWorker, t0: float) -> None:
         """Caller-side observability only (the resume cursor moved on the
@@ -321,6 +407,51 @@ class Gateway:
         snap["workers"] = self.n_workers
         snap["shards"] = len(self._shards)
         return snap
+
+    def prometheus_text(self) -> str:
+        """Prometheus v0.0.4 text: per-shard metrics with
+        ``{fleet,shard,worker,health}`` labels + gateway-level counters.
+
+        The ``GET /metrics`` content-negotiated rendering: per-shard
+        counters surface as labeled samples instead of being summed away
+        (the JSON snapshot's ``shard_totals`` loses exactly the per-shard
+        split a dashboard needs to see ONE broken fleet). One queued round
+        trip per worker, same consistency argument as ``_per_worker``.
+        """
+        from ..obs.export import render_prometheus
+
+        per_shard = self._per_worker(
+            lambda s, _fid: (s.metrics.snapshot(), s.health)
+        )
+        entries = []
+        for key, (fleet_id, _mid, widx) in self._shards.items():
+            snap, health = per_shard[fleet_id]
+            entries.append(
+                {
+                    "fleet": fleet_id,
+                    "shard": key,
+                    "worker": widx,
+                    "health": health,
+                    "counters": snap["counters"],
+                    "latency": snap["latency"],
+                }
+            )
+        gw = self.metrics.snapshot()
+        return render_prometheus(
+            entries,
+            gateway_counters=gw["counters"],
+            gateway_latency=gw["latency"],
+        )
+
+    def flight_snapshot(self, fleet_id: str) -> List[dict]:
+        """The fleet's live flight-recorder ring (``GET /debug/flight/<fleet>``)."""
+        if self.flight is None:
+            raise KeyError(
+                "flight recorder not enabled (serve --flight-dir)"
+            )
+        if fleet_id not in self._fleet_key:
+            raise KeyError(f"unknown fleet {fleet_id!r}")
+        return self.flight.snapshot(fleet_id)
 
     # -- snapshot / restore ------------------------------------------------
 

@@ -1,13 +1,19 @@
 """Minimal HTTP/1.1 JSON API over the gateway (stdlib asyncio only).
 
-Four routes, all JSON:
+Five routes:
 
     POST /events                 {"fleet": <id>, "event": {<sched.events>}}
                                  -> 200 {"view": {...}} after the shard
                                  ticks (the response IS the placement)
     GET  /placement/<fleet_id>   -> 200 {"view": {...}} (latest, no solve)
     GET  /healthz                -> 200/503 per-shard health + overall
-    GET  /metrics                -> 200 gateway metrics snapshot
+    GET  /metrics                -> 200 gateway metrics snapshot (JSON), OR
+                                 Prometheus v0.0.4 text when the client
+                                 content-negotiates it (``Accept:
+                                 text/plain`` or ``?format=prom``) — the
+                                 labeled per-shard exposition
+    GET  /debug/flight/<fleet>   -> 200 the fleet's live flight-recorder
+                                 ring (404 unless serving with a recorder)
 
 One connection = one request (``Connection: close``): the serving tier's
 clients are schedulers and probes, not browsers, and the parser stays ~50
@@ -17,6 +23,11 @@ reached through ``handle_event_async``'s future bridge or the default
 executor, so one slow fleet's solve never stalls another fleet's ingest.
 That invariant is mechanically enforced: dlint DLP018 forbids blocking
 calls inside ``async def`` bodies in this package.
+
+Tracing: with a tracer on the gateway, every POST /events gets an
+``http.request`` root span whose context rides into ``handle_event_async``
+as the explicit parent — so a traced event's tree starts at HTTP parse,
+not at ingest.
 """
 
 from __future__ import annotations
@@ -25,14 +36,23 @@ import asyncio
 import json
 from typing import Optional, Tuple
 
+from ..obs.trace import now_ms
 from .gateway import Gateway, view_to_dict
 
 _MAX_BODY = 8 * 1024 * 1024  # a DeviceJoin carries a full profile; 8 MB is generous
 _MAX_HEADER_LINES = 64
+_JSON = "application/json"
+# The exposition content type the Prometheus scraper expects.
+_PROM = "text/plain; version=0.0.4; charset=utf-8"
 
 
-def _response(status: int, payload: dict) -> bytes:
-    body = json.dumps(payload).encode()
+def _response(status: int, payload, content_type: str = _JSON) -> bytes:
+    if isinstance(payload, (dict, list)):
+        body = json.dumps(payload).encode()
+    elif isinstance(payload, bytes):
+        body = payload
+    else:
+        body = str(payload).encode()
     reason = {
         200: "OK", 400: "Bad Request", 404: "Not Found",
         405: "Method Not Allowed", 409: "Conflict", 413: "Payload Too Large",
@@ -40,7 +60,7 @@ def _response(status: int, payload: dict) -> bytes:
     }.get(status, "OK")
     head = (
         f"HTTP/1.1 {status} {reason}\r\n"
-        f"Content-Type: application/json\r\n"
+        f"Content-Type: {content_type}\r\n"
         f"Content-Length: {len(body)}\r\n"
         f"Connection: close\r\n\r\n"
     )
@@ -77,8 +97,9 @@ class GatewayHTTPServer:
     # -- request handling --------------------------------------------------
 
     async def _handle_conn(self, reader, writer) -> None:
+        ctype = _JSON
         try:
-            status, payload = await self._dispatch(reader)
+            status, payload, ctype = await self._dispatch(reader)
         except (EOFError, ConnectionError) as e:
             # IncompleteReadError (an EOFError) = the client closed before
             # its advertised body arrived: a client fault, not a server
@@ -100,7 +121,7 @@ class GatewayHTTPServer:
             self.gateway.metrics.inc("http_internal_error")
             status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
         try:
-            writer.write(_response(status, payload))
+            writer.write(_response(status, payload, ctype))
             await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
             self.gateway.metrics.inc("http_client_gone")
@@ -111,7 +132,7 @@ class GatewayHTTPServer:
             except (ConnectionError, OSError):
                 self.gateway.metrics.inc("http_client_gone")
 
-    async def _read_request(self, reader) -> Tuple[str, str, bytes]:
+    async def _read_request(self, reader) -> Tuple[str, str, bytes, str]:
         request_line = (await reader.readline()).decode("latin-1").strip()
         if not request_line:
             raise ValueError("empty request")
@@ -120,13 +141,17 @@ class GatewayHTTPServer:
             raise ValueError(f"malformed request line {request_line!r}")
         method, target, _version = parts
         content_length = 0
+        accept = ""
         for _ in range(_MAX_HEADER_LINES):
             line = (await reader.readline()).decode("latin-1")
             if line in ("\r\n", "\n", ""):
                 break
             name, _, value = line.partition(":")
-            if name.strip().lower() == "content-length":
+            name = name.strip().lower()
+            if name == "content-length":
                 content_length = int(value.strip())
+            elif name == "accept":
+                accept = value.strip().lower()
         else:
             raise ValueError("too many header lines")
         if content_length > _MAX_BODY:
@@ -134,12 +159,14 @@ class GatewayHTTPServer:
         body = (
             await reader.readexactly(content_length) if content_length else b""
         )
-        return method, target, body
+        return method, target, body, accept
 
-    async def _dispatch(self, reader) -> Tuple[int, dict]:
-        method, target, body = await self._read_request(reader)
+    async def _dispatch(self, reader) -> Tuple[int, object, str]:
+        t_req = now_ms()  # request arrival: the http.request span starts HERE
+        method, target, body, accept = await self._read_request(reader)
         loop = asyncio.get_running_loop()
-        if method == "POST" and target == "/events":
+        path, _, query = target.partition("?")
+        if method == "POST" and path == "/events":
             data = json.loads(body or b"{}")
             fleet_id = data.get("fleet")
             if not fleet_id:
@@ -149,23 +176,55 @@ class GatewayHTTPServer:
             from ..sched.events import event_from_dict
 
             event = event_from_dict(data["event"])
-            view = await self.gateway.handle_event_async(fleet_id, event)
-            return 200, {"fleet": fleet_id, "view": view_to_dict(view)}
-        if method == "GET" and target.startswith("/placement/"):
-            fleet_id = target[len("/placement/"):]
+            # The trace root for an HTTP-ingested event: parse+route+wait
+            # +tick all under one request span (explicit parent — the loop
+            # thread is shared, ambient context would cross coroutines).
+            # Backdated to request arrival so header/body reads and the
+            # JSON/event parse — which all happened above — are INSIDE the
+            # span: "HTTP parse?" is one of the questions a trace answers.
+            span = self.gateway.tracer.start_span(
+                "http.request", parent=None,
+                attrs={"method": method, "target": path, "fleet": fleet_id},
+            )
+            if self.gateway.tracer.enabled:
+                span.t0_ms = t_req  # the shared NOOP span has no slots
+            try:
+                view = await self.gateway.handle_event_async(
+                    fleet_id, event, parent=span.context()
+                )
+            finally:
+                span.end()
+            return 200, {"fleet": fleet_id, "view": view_to_dict(view)}, _JSON
+        if method == "GET" and path.startswith("/placement/"):
+            fleet_id = path[len("/placement/"):]
             # latest() blocks on a worker round trip; off the loop thread.
             view = await loop.run_in_executor(
                 None, self.gateway.latest, fleet_id
             )
-            return 200, {"fleet": fleet_id, "view": view_to_dict(view)}
-        if method == "GET" and target == "/healthz":
+            return 200, {"fleet": fleet_id, "view": view_to_dict(view)}, _JSON
+        if method == "GET" and path == "/healthz":
             health = await loop.run_in_executor(None, self.gateway.healthz)
-            return (503 if health["status"] == "broken" else 200), health
-        if method == "GET" and target == "/metrics":
+            return (503 if health["status"] == "broken" else 200), health, _JSON
+        if method == "GET" and path == "/metrics":
+            # Content negotiation: Prometheus scrapers say `Accept:
+            # text/plain` (or force it with ?format=prom) and get the
+            # labeled v0.0.4 text exposition; everyone else keeps the
+            # JSON snapshot — the pre-obs default, byte-compatible.
+            if "format=prom" in query or "text/plain" in accept:
+                text = await loop.run_in_executor(
+                    None, self.gateway.prometheus_text
+                )
+                return 200, text, _PROM
             snap = await loop.run_in_executor(
                 None, self.gateway.metrics_snapshot
             )
-            return 200, snap
+            return 200, snap, _JSON
+        if method == "GET" and path.startswith("/debug/flight/"):
+            fleet_id = path[len("/debug/flight/"):]
+            records = await loop.run_in_executor(
+                None, self.gateway.flight_snapshot, fleet_id
+            )
+            return 200, {"fleet": fleet_id, "records": records}, _JSON
         if method not in ("GET", "POST"):
-            return 405, {"error": f"method {method} not supported"}
-        return 404, {"error": f"no route for {method} {target}"}
+            return 405, {"error": f"method {method} not supported"}, _JSON
+        return 404, {"error": f"no route for {method} {target}"}, _JSON

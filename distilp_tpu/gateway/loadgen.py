@@ -148,13 +148,24 @@ def run_loadgen(
     kv_bits: str = "4bit",
     scenario: str = "drift",
     scheduler_kwargs: Optional[dict] = None,
+    tracer=None,
+    prom_scrape_s: Optional[float] = None,
 ) -> dict:
     """One full loadgen arm: build fleets, replay, report, tear down.
 
     The same (n_fleets, seed, events) always produces the same trace set,
     so arms at different worker counts compare like for like — the bench's
     scaling ratio divides two runs of the IDENTICAL workload.
+
+    ``tracer`` (an ``obs.Tracer``) instruments the whole arm;
+    ``prom_scrape_s`` additionally runs a background thread rendering the
+    Prometheus exposition at that period for the arm's duration — together
+    they are the "observability on" arm of the bench's overhead gate (the
+    scrape thread is a real scrape: its per-worker round trips queue
+    behind live solves, exactly like a sidecar hitting ``/metrics``).
     """
+    import threading
+
     total_events = events_per_fleet + warmup_per_fleet
     specs = make_fleet_specs(n_fleets, fleet_size=fleet_size, seed=seed)
     items = make_loadgen_trace(specs, total_events, seed=seed, scenario=scenario)
@@ -165,12 +176,32 @@ def run_loadgen(
         "k_candidates": list(k_candidates) if k_candidates else None,
     }
     kwargs.update(scheduler_kwargs or {})
-    gateway = Gateway(n_workers=n_workers, scheduler_kwargs=kwargs)
+    gateway = Gateway(
+        n_workers=n_workers, scheduler_kwargs=kwargs, tracer=tracer
+    )
+    scrape_stop = threading.Event()
+    scraper = None
+    if prom_scrape_s is not None:
+
+        def _scrape() -> None:
+            while not scrape_stop.wait(prom_scrape_s):
+                try:
+                    gateway.prometheus_text()
+                except Exception:
+                    # The scrape must never kill the arm; a failure is a
+                    # real observability signal, so it is counted.
+                    gateway.metrics.inc("prom_scrape_error")
+
+        scraper = threading.Thread(
+            target=_scrape, daemon=True, name="prom-scrape"
+        )
     try:
         for fleet_id, spec in specs.items():
             gateway.register_fleet(
                 fleet_id, make_fleet_from_spec(fleet_id, spec), model
             )
+        if scraper is not None:
+            scraper.start()
         measure_from = {f: warmup_per_fleet for f in specs}
         report = asyncio.run(replay_concurrent(gateway, items, measure_from))
         snap = gateway.metrics_snapshot()
@@ -187,8 +218,17 @@ def run_loadgen(
                 ],
             }
         )
+        if prom_scrape_s is not None:
+            report["prom_scrape_errors"] = snap["counters"].get(
+                "prom_scrape_error", 0
+            )
         return report
     finally:
+        # Scraper first: a scrape landing on a stopping worker would only
+        # count an error, but the arm should end quiet.
+        scrape_stop.set()
+        if scraper is not None and scraper.is_alive():
+            scraper.join(timeout=2.0)
         gateway.close()
 
 

@@ -1,0 +1,54 @@
+"""Observability for the serving stack: tracing, exporters, flight data.
+
+Three pieces, all opt-in and stdlib-only (the obs layer imports neither
+jax nor the solver — it is plumbing the serving layers thread data
+through):
+
+- ``trace``  — span-based tracing of the event path (HTTP ingest → shard
+  routing → worker queue wait → scheduler tick → solve → publish), a
+  bounded finished-span ring, a JSONL writer, and the NOOP twins that make
+  the disabled path free;
+- ``export`` — span JSONL → Chrome trace-event JSON (Perfetto loadable;
+  the ``solver spans`` CLI) and the labeled Prometheus v0.0.4 text
+  exposition of scheduler metrics (+ the minimal parser that round-trips
+  it in tests);
+- ``flight`` — the flight recorder: per-shard rings of the last N tick
+  records, auto-dumped to a post-mortem JSONL on breaker-open or a
+  chaos-contract violation, readable live over HTTP.
+
+See README "Observability" for the span model and the label table.
+"""
+
+from .export import (
+    parse_prometheus_text,
+    read_spans,
+    render_prometheus,
+    spans_to_chrome,
+    top_spans,
+)
+from .flight import FlightRecorder
+from .trace import (
+    NOOP_SPAN,
+    NOOP_TRACER,
+    JsonlSpanWriter,
+    Span,
+    SpanContext,
+    Tracer,
+    now_ms,
+)
+
+__all__ = [
+    "Tracer",
+    "Span",
+    "SpanContext",
+    "JsonlSpanWriter",
+    "NOOP_TRACER",
+    "NOOP_SPAN",
+    "now_ms",
+    "read_spans",
+    "spans_to_chrome",
+    "top_spans",
+    "render_prometheus",
+    "parse_prometheus_text",
+    "FlightRecorder",
+]

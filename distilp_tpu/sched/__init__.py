@@ -51,8 +51,11 @@ from .metrics import (
     HEALTH_DEGRADED,
     HEALTH_HEALTHY,
     HEALTH_STATES,
+    METRIC_FAMILIES,
+    METRIC_REGISTRY,
     LatencyHist,
     SchedulerMetrics,
+    registry_help,
 )
 from .scheduler import PlacementView, Scheduler, WarmPool, drift_warm_share
 from .sim import ReplayReport, generate_trace, replay
@@ -73,6 +76,9 @@ __all__ = [
     "FleetState",
     "SchedulerMetrics",
     "LatencyHist",
+    "METRIC_REGISTRY",
+    "METRIC_FAMILIES",
+    "registry_help",
     "HEALTH_HEALTHY",
     "HEALTH_DEGRADED",
     "HEALTH_BROKEN",

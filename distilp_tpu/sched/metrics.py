@@ -66,6 +66,125 @@ FAULT_COUNTERS = (
     "health_recovered",  # degraded/broken -> healthy transitions
 )
 
+# The ONE enumeration of every metric name the sched/gateway/obs layers
+# emit, name -> Prometheus `# HELP` text. Three consumers keep each other
+# honest: the Prometheus exposition (obs.export.render_prometheus) takes
+# its HELP lines from here, dashboards enumerate from here instead of
+# grepping call sites, and dlint DLP019 fails the gate on any
+# string-literal ``metrics.inc("...")`` in those layers whose name is NOT
+# an exact entry — so a new counter cannot ship without its help text.
+# Dynamically composed names (f-strings over event kinds, tick modes,
+# fault kinds, worker ids) resolve through METRIC_FAMILIES by longest
+# prefix instead; ``registry_help`` is the lookup both exposition and
+# tests use.
+METRIC_REGISTRY = {
+    # -- event routing (scheduler.handle) ---------------------------------
+    "events_total": "Events accepted into the fleet state",
+    "structural_events": "Accepted events that changed the problem identity",
+    "drift_events": "Accepted events that kept the problem identity",
+    "events_quarantined": "Events rejected before touching the fleet",
+    "quarantine_fleet": "Ticks refused because the fleet state went non-finite",
+    "init_solve": "Eventless solves at construction (solve_on_init)",
+    # -- tick outcomes (SchedulerMetrics.record_tick + scheduler) ---------
+    "tick_cold": "Solver ticks that solved from scratch",
+    "tick_warm": "Solver ticks warm-started from the previous placement",
+    "tick_margin": "Solver ticks served by the MoE margin fast path",
+    "tick_certified": "Ticks whose placement carried an optimality certificate",
+    "tick_uncertified": "Ticks whose placement missed its certificate",
+    "tick_failed": "Ticks that produced no placement at all",
+    "tick_failed_structural": "Failed ticks routed as structural events",
+    "tick_failed_drift": "Failed ticks routed as drift events",
+    "fallback_escalations": "Certification-ladder escalations across ticks",
+    "solver_escalations": "In-solver budget escalations (timings['escalated'])",
+    "structural_uncertified": "Structural events whose tick missed its certificate",
+    # -- warm pool --------------------------------------------------------
+    "pool_hit": "Warm-pool lookups that found a live replanner",
+    "pool_miss": "Warm-pool lookups that minted a fresh replanner",
+    "pool_evict": "Warm replanners dropped by the LRU bound",
+    # -- fault-hardened serving (see FAULT_COUNTERS comments) -------------
+    "deadline_missed": "Solves abandoned at the wall-clock deadline",
+    "deadline_backlog": "Ticks skipped behind a still-running abandoned solve",
+    "abandoned_solves_drained": "Overrun solves that finished and were discarded",
+    "solve_retries": "Retry attempts after a failed solve attempt",
+    "solve_attempt_failed": "Individual solve attempts that raised",
+    "solve_retry_success": "Ticks saved by a retry",
+    "breaker_open": "Circuit-breaker transitions to open",
+    "breaker_short_circuit": "Ticks served degraded with the breaker open",
+    "breaker_half_open_probe": "Probe solves attempted from half-open",
+    "breaker_close": "Half-open probes that closed the breaker",
+    "breaker_reopen": "Half-open probes that re-opened the breaker",
+    "served_stale": "Views re-served as mode='stale'",
+    "served_degraded": "Views re-served as mode='degraded'",
+    "health_recovered": "degraded/broken -> healthy transitions",
+    "faults_injected_total": "Faults injected by the chaos harness (all kinds)",
+    # -- risk-aware serving ----------------------------------------------
+    "risk_eval": "Ticks that ran the twin's risk-aware candidate scoring",
+    "risk_candidates": "Candidates scored by the risk-aware selector",
+    "risk_switch": "Ticks that served a candidate over the fresh solve",
+    "risk_error": "Risk scorings that failed (fresh solve served instead)",
+    "risk_per_k_failed": "Per-k candidate enumerations that failed",
+    # -- snapshot / restore ----------------------------------------------
+    "state_restored": "Scheduler warm-state restores (load_state)",
+    "warm_resumes": "First post-restore ticks that rode warm (the proof)",
+    "cold_resumes": "First post-restore ticks that paid a cold solve",
+    "resume_identity_changed": "First post-restore ticks on a changed identity",
+    # -- gateway tier -----------------------------------------------------
+    "shards_registered": "Shards registered with the gateway",
+    "shards_restored": "Shards registered from a snapshot blob",
+    "gateway_events": "Events ingested through the gateway",
+    "worker_events": "Events routed, by worker (worker label)",
+    "snapshots_taken": "Gateway warm-state snapshots taken",
+    "worker_exception": "Closures that raised on a shard worker thread",
+    "worker_callback_error": "Completion callbacks that raised (dead loop)",
+    "prom_scrape_error": "Background Prometheus scrapes that failed",
+    "http_client_gone": "HTTP clients gone before request/response finished",
+    "http_bad_request": "HTTP 400s (malformed request or body)",
+    "http_not_found": "HTTP 404s (unknown route or fleet)",
+    "http_conflict": "HTTP 409s (shard exists but nothing servable yet)",
+    "http_internal_error": "HTTP 500s (unexpected server-side failure)",
+    # -- observability layer ----------------------------------------------
+    "flight_dumps": "Flight-recorder post-mortem dumps written",
+    "health_state": "Shard health as a gauge (0 healthy, 1 degraded, 2 broken)",
+    # -- latency histograms (exposed as Prometheus summaries, ms) ---------
+    "event_to_placement": "Event to published placement, ms (per shard)",
+    "structural_tick": "Structural-event tick latency, ms",
+    "drift_tick": "Drift-event tick latency, ms",
+    "ipm_iters_executed": "LP iterations the tick's solve actually executed",
+    "twin_p95": "Twin p95 latency of the served placement, ms",
+    "gateway_event_to_placement": "Gateway ingest to placement (queue wait included), ms",
+}
+
+# Longest-prefix fallback for dynamically composed names. Every f-string
+# ``inc``/``observe`` site in sched/gateway/obs must be covered by one of
+# these (or be an exact entry above).
+METRIC_FAMILIES = (
+    ("event_", "Accepted events, by event kind"),
+    ("quarantine_", "Quarantined events, by event kind"),
+    ("structural_tick_", "Structural-event ticks, by tick mode"),
+    ("drift_tick_", "Drift-event ticks, by tick mode"),
+    ("tick_", "Solver ticks, by mode or outcome"),
+    ("lp_backend_", "Ticks by the LP relaxation engine that actually ran"),
+    ("served_", "Degraded-mode serves, by published mode"),
+    ("fault_injected_", "Chaos faults scheduled, by kind"),
+    ("fault_fired_", "Solver-channel chaos faults that fired, by kind"),
+    ("worker_", "Gateway per-worker counters (worker_<i>_events)"),
+)
+
+
+def registry_help(name: str):
+    """``# HELP`` text for a metric name: exact entry first, then the
+    longest matching family prefix; None when nothing covers it (the
+    Prometheus round-trip test treats that as registry drift)."""
+    if name in METRIC_REGISTRY:
+        return METRIC_REGISTRY[name]
+    best = None
+    for prefix, help_txt in METRIC_FAMILIES:
+        if name.startswith(prefix) and (
+            best is None or len(prefix) > len(best[0])
+        ):
+            best = (prefix, help_txt)
+    return best[1] if best else None
+
 
 def _quantile(sorted_vals: List[float], q: float) -> float:
     """Nearest-rank quantile on an already-sorted list (no numpy needed)."""
@@ -81,6 +200,13 @@ class LatencyHist:
     Keeps raw samples (traces are thousands of events, not millions); the
     snapshot sorts once. ``cap`` bounds memory for genuinely long-lived
     daemons by keeping the most recent window.
+
+    Snapshot semantics after the window overflows: ``count``/``mean_ms``
+    are ALL-TIME (every sample ever recorded), while the quantiles, the
+    max and the ``window_count``/``window_mean_ms`` pair describe only the
+    ``cap``-bounded recent window. Both views are reported explicitly so a
+    long-lived daemon's snapshot is never an incoherent mix of the two
+    (the old snapshot paired an all-time mean with windowed quantiles).
     """
 
     def __init__(self, cap: int = 100_000):
@@ -105,8 +231,18 @@ class LatencyHist:
             vals = sorted(self._vals)
             count, total = self.count, self.total
         return {
+            # All-time: survives the window overflowing. total_ms is the
+            # exact running sum (the Prometheus summary `_sum` — derived
+            # from the rounded mean it could DECREASE between scrapes).
             "count": count,
+            "total_ms": round(total, 3),
             "mean_ms": round(total / count, 3) if count else 0.0,
+            # Recent window (at most `cap` samples): the same population
+            # the quantiles and max are computed from.
+            "window_count": len(vals),
+            "window_mean_ms": (
+                round(sum(vals) / len(vals), 3) if vals else 0.0
+            ),
             "p50_ms": round(_quantile(vals, 0.50), 3),
             "p99_ms": round(_quantile(vals, 0.99), 3),
             "max_ms": round(vals[-1], 3) if vals else 0.0,

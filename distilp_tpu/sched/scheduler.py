@@ -26,6 +26,7 @@ from collections import OrderedDict, deque
 from typing import Callable, NamedTuple, Optional, Sequence, Tuple
 
 from ..common import DeviceProfile, ModelProfile
+from ..obs.trace import NOOP_SPAN, NOOP_TRACER
 from ..solver.result import HALDAResult
 from ..solver.streaming import StreamingReplanner
 from .events import validate_event
@@ -35,6 +36,13 @@ from .metrics import (
     HEALTH_DEGRADED,
     HEALTH_HEALTHY,
     SchedulerMetrics,
+)
+
+# Solver-timings keys worth attaching to the solve span: the wall-clock
+# breakdown plus the work/engine counters that attribute a slow tick.
+_SOLVE_SPAN_KEYS = (
+    "build_ms", "pack_ms", "upload_ms", "solve_ms", "static_hit",
+    "lp_backend", "bnb_rounds", "ipm_iters_executed", "escalated",
 )
 
 
@@ -240,6 +248,10 @@ class Scheduler:
         breaker_cooldown: int = 3,
         healthy_after: int = 3,
         fault_hook: Optional[Callable[[int], None]] = None,
+        tracer=None,
+        flight=None,
+        flight_key: str = "default",
+        jax_profile_dir: Optional[str] = None,
     ):
         self.fleet = FleetState(list(devices), model)
         self.mip_gap = mip_gap
@@ -325,6 +337,21 @@ class Scheduler:
         # and counts as neither (`resume_identity_changed`).
         self._restore_pending = False
         self._restored_keys: frozenset = frozenset()
+        # -- observability (distilp_tpu.obs), all opt-in. The tracer falls
+        # back to the shared NOOP twin so every instrumentation site below
+        # is a constant-cost no-op when tracing is off; the flight recorder
+        # and the XLA-profile hook stay None/dormant unless configured —
+        # the default tick path must remain byte-identical (pinned by the
+        # smoke gates' counter assertions).
+        self.tracer = NOOP_TRACER if tracer is None else tracer
+        self._span = NOOP_SPAN  # the in-flight tick's span (handle())
+        self._flight = flight
+        self._flight_key = flight_key
+        self._flight_prev_counters: dict = {}
+        self._flight_pending: Optional[str] = None
+        self._last_lp_backend: Optional[str] = None
+        self.jax_profile_dir = jax_profile_dir
+        self._jax_profiled = False
         if solve_on_init:
             self.metrics.inc("init_solve")
             self._tick(structural=None)
@@ -364,7 +391,31 @@ class Scheduler:
         ``self.quarantined``, and the last-known-good placement stays
         served. Before any placement exists a poisoned event is still an
         error: there is nothing safe to serve instead.
+
+        Observability wrapper: the whole handle runs inside a ``sched.tick``
+        span (the root of the trace in single-scheduler serving; a child of
+        the gateway ingest span when a worker attached its context), and —
+        when a flight recorder is attached — appends one tick record (mode,
+        health, counter deltas, span ids) to the shard's ring on every
+        exit path, raising ones included.
         """
+        span = self.tracer.span(
+            "sched.tick",
+            attrs={"kind": getattr(event, "kind", type(event).__name__)},
+        )
+        with span:
+            self._span = span
+            view: Optional[PlacementView] = None
+            try:
+                view = self._handle(event)
+                return view
+            finally:
+                span.set_attr("mode", view.mode if view is not None else "error")
+                if self._flight is not None:
+                    self._flight_note(event, view, span)
+                self._span = NOOP_SPAN
+
+    def _handle(self, event) -> PlacementView:
         reason = validate_event(event)
         if reason is not None:
             return self._quarantine(event, reason)
@@ -382,6 +433,7 @@ class Scheduler:
         kind = getattr(event, "kind", type(event).__name__)
         self.metrics.inc("events_quarantined")
         self.metrics.inc(f"quarantine_{kind}")
+        self._span.add_event("quarantined", kind=kind, reason=reason)
         self.quarantined.append((self.fleet.seq, kind, reason))
         self._last_error = f"quarantined {kind}: {reason}"
         self._note_fault()
@@ -404,6 +456,7 @@ class Scheduler:
         bad = self.fleet.non_finite_reason()
         if bad is not None:
             self.metrics.inc("quarantine_fleet")
+            self._span.add_event("quarantine_fleet", reason=bad)
             self._last_error = f"fleet state quarantined: {bad}"
             self._note_fault()
             if self._published is None:
@@ -417,35 +470,52 @@ class Scheduler:
             if self._breaker_cooldown_left > 0:
                 self._breaker_cooldown_left -= 1
                 self.metrics.inc("breaker_short_circuit")
+                self._span.add_event("breaker_short_circuit")
                 return self._serve_stale("degraded")
             probing = True
             self.metrics.inc("breaker_half_open_probe")
+            self._span.add_event("breaker_half_open_probe")
         key = self.fleet.key()
         planner, _hit = self.pool.get(key)
         devs = self.fleet.device_list()
         t0 = time.perf_counter()
         tick_tm: dict = {}
+        solve_span = self.tracer.start_span("sched.solve")
+        # Outer try/finally so the solve span is ended on EVERY exit — the
+        # handled failure classes below, and any exception type outside
+        # them (which would otherwise leak the span right out of the trace
+        # a post-mortem needs most). end() is idempotent.
         try:
-            result = self._solve_with_guards(planner, devs, tick_tm)
-        except _DeadlineMiss:
-            self.metrics.inc("deadline_missed")
-            self._last_error = (
-                f"solve deadline ({self.solve_deadline_s:.3f}s) missed"
-            )
-            self._solve_failed(probing)
-            return self._serve_stale("stale")
-        except (RuntimeError, ValueError, NotImplementedError) as e:
-            self.metrics.inc("tick_failed")
-            if structural is not None:
-                self.metrics.inc(
-                    "tick_failed_structural" if structural
-                    else "tick_failed_drift"
+            try:
+                result = self._maybe_profiled_solve(planner, devs, tick_tm)
+            except _DeadlineMiss:
+                solve_span.add_event("deadline_missed")
+                self.metrics.inc("deadline_missed")
+                self._last_error = (
+                    f"solve deadline ({self.solve_deadline_s:.3f}s) missed"
                 )
-            self._last_error = f"{type(e).__name__}: {e}"
-            self._solve_failed(probing)
-            if self._published is None:
-                raise
-            return self.latest()
+                self._solve_failed(probing)
+                return self._serve_stale("stale")
+            except (RuntimeError, ValueError, NotImplementedError) as e:
+                solve_span.add_event(
+                    "solve_failed", error=f"{type(e).__name__}: {e}"
+                )
+                self.metrics.inc("tick_failed")
+                if structural is not None:
+                    self.metrics.inc(
+                        "tick_failed_structural" if structural
+                        else "tick_failed_drift"
+                    )
+                self._last_error = f"{type(e).__name__}: {e}"
+                self._solve_failed(probing)
+                if self._published is None:
+                    raise
+                return self.latest()
+            for k in _SOLVE_SPAN_KEYS:
+                if k in tick_tm:
+                    solve_span.set_attr(k, tick_tm[k])
+        finally:
+            solve_span.end()
         self._on_clean_solve(probing)
         ms = (time.perf_counter() - t0) * 1e3
         self.metrics.observe("event_to_placement", ms)
@@ -462,6 +532,7 @@ class Scheduler:
         # ipm/pdhg crossover in production, next to the tick-mode counters.
         if "lp_backend" in tick_tm:
             self.metrics.inc(f"lp_backend_{tick_tm['lp_backend']}")
+            self._last_lp_backend = tick_tm["lp_backend"]
         # The in-solver certification ladder (halda_solve retrying an
         # uncertified dense solve at the MoE-class budget) reports through
         # the timings dict; count it so escalation storms are visible.
@@ -493,22 +564,28 @@ class Scheduler:
             )
         if structural and not result.certified:
             self.metrics.inc("structural_uncertified")
-        served, twin_p95, switched = result, None, False
-        if self.risk_aware:
-            served, twin_p95, switched = self._risk_select(devs, result, planner)
-        self._published = PlacementView(
-            result=served,
-            seq=self.fleet.seq,
-            fleet_seq=self.fleet.seq,
-            events_behind=0,
-            age_s=0.0,
-            # A switched tick serves a placement this tick did NOT produce;
-            # 'risk' keeps the mode field honest (see PlacementView).
-            mode="risk" if switched else mode,
-            key=key,
-            twin_p95_s=twin_p95,
-            risk_selected=switched,
-        )
+        with self.tracer.span("sched.publish") as pspan:
+            served, twin_p95, switched = result, None, False
+            if self.risk_aware:
+                served, twin_p95, switched = self._risk_select(
+                    devs, result, planner
+                )
+            self._published = PlacementView(
+                result=served,
+                seq=self.fleet.seq,
+                fleet_seq=self.fleet.seq,
+                events_behind=0,
+                age_s=0.0,
+                # A switched tick serves a placement this tick did NOT
+                # produce; 'risk' keeps the mode field honest (see
+                # PlacementView).
+                mode="risk" if switched else mode,
+                key=key,
+                twin_p95_s=twin_p95,
+                risk_selected=switched,
+            )
+            pspan.set_attr("mode", self._published.mode)
+            pspan.set_attr("certified", served.certified)
         self._published_at = time.monotonic()
         return self._published
 
@@ -531,6 +608,7 @@ class Scheduler:
         for attempt in range(attempts):
             if attempt:
                 self.metrics.inc("solve_retries")
+                self._span.add_event("solve_retry", attempt=attempt)
                 time.sleep(
                     min(
                         self.retry_backoff_s * (2 ** (attempt - 1)),
@@ -548,12 +626,35 @@ class Scheduler:
                 raise  # a miss is a tick-level outcome, not retryable
             except (RuntimeError, ValueError, NotImplementedError) as e:
                 self.metrics.inc("solve_attempt_failed")
+                self._span.add_event(
+                    "solve_attempt_failed",
+                    attempt=attempt,
+                    error=f"{type(e).__name__}: {e}",
+                )
                 last_exc = e
                 continue
             if attempt:
                 self.metrics.inc("solve_retry_success")
             return result
         raise last_exc  # every attempt failed
+
+    def _maybe_profiled_solve(self, planner, devs, tick_tm: dict):
+        """The solve, optionally wrapped in an XLA profiler trace.
+
+        ``jax_profile_dir`` (``serve --jax-profile-dir``) captures the
+        FIRST solve tick only — the cold solve whose compile+execute
+        profile the TPU-reclamation work wants — then steps aside; the
+        profiler is process-global, so the capture covers the solve even
+        when the deadline path runs it on the worker thread.
+        """
+        if self.jax_profile_dir is not None and not self._jax_profiled:
+            self._jax_profiled = True
+            import jax  # lazy: only a profiling run pays the import here
+
+            self._span.add_event("jax_profile", dir=str(self.jax_profile_dir))
+            with jax.profiler.trace(str(self.jax_profile_dir)):
+                return self._solve_with_guards(planner, devs, tick_tm)
+        return self._solve_with_guards(planner, devs, tick_tm)
 
     def _attempt(self, planner, devs, model, tick_tm: dict, attempt: int):
         if self.fault_hook is not None:
@@ -610,6 +711,7 @@ class Scheduler:
         if probing:
             # Half-open probe failed: straight back to open, full cooldown.
             self.metrics.inc("breaker_reopen")
+            self._span.add_event("breaker_reopen")
             self._breaker_cooldown_left = self.breaker_cooldown
             return
         if (
@@ -620,7 +722,13 @@ class Scheduler:
             self._breaker_open = True
             self._breaker_cooldown_left = self.breaker_cooldown
             self.metrics.inc("breaker_open")
-            self.health = HEALTH_BROKEN
+            self._span.add_event("breaker_open")
+            self._set_health(HEALTH_BROKEN)
+            if self._flight is not None:
+                # Post-mortem moment: the dump happens at the END of this
+                # handle (after the tick's own record lands in the ring),
+                # so the breaker-open tick is IN its own post-mortem.
+                self._flight_pending = "breaker_open"
 
     def _on_clean_solve(self, probing: bool) -> None:
         """A solve succeeded: close the breaker (if probing) and advance
@@ -630,21 +738,32 @@ class Scheduler:
             self._breaker_open = False
             self._breaker_cooldown_left = 0
             self.metrics.inc("breaker_close")
-            self.health = HEALTH_DEGRADED  # until the streak clears it
+            self._span.add_event("breaker_close")
+            self._set_health(HEALTH_DEGRADED)  # until the streak clears it
         self._clean_streak += 1
         if (
             self.health != HEALTH_HEALTHY
             and not self._breaker_open
             and self._clean_streak >= self.healthy_after
         ):
-            self.health = HEALTH_HEALTHY
+            self._set_health(HEALTH_HEALTHY)
             self.metrics.inc("health_recovered")
 
     def _note_fault(self) -> None:
         """Any fault (quarantine, miss, failure) degrades health and resets
         the clean streak; an open breaker pins health at broken."""
         self._clean_streak = 0
-        self.health = HEALTH_BROKEN if self._breaker_open else HEALTH_DEGRADED
+        self._set_health(
+            HEALTH_BROKEN if self._breaker_open else HEALTH_DEGRADED
+        )
+
+    def _set_health(self, state: str) -> None:
+        """Health assignment with the transition recorded as a span event
+        (only actual CHANGES — repeated faults at the same state are
+        already visible as their own events)."""
+        if state != self.health:
+            self.health = state
+            self._span.add_event("health", state=state)
 
     def _serve_stale(self, mode: str) -> PlacementView:
         """Re-serve the last-known-good placement under a degraded mode.
@@ -661,7 +780,43 @@ class Scheduler:
         if self._published.mode != mode:
             self._published = self._published._replace(mode=mode)
         self.metrics.inc(f"served_{mode}")
+        self._span.add_event("served_stale", mode=mode)
         return self.latest()
+
+    def _flight_note(self, event, view: Optional[PlacementView], span) -> None:
+        """Append this tick's flight record; fire any pending post-mortem.
+
+        Counter DELTAS, not totals: the record answers "what did THIS tick
+        do" (one quarantine? a retry plus a breaker transition?) without
+        the reader diffing snapshots. The span ids tie the record to the
+        trace when tracing is on (None otherwise). Runs only with a
+        recorder attached — the default path never builds the dicts.
+        """
+        counters = dict(self.metrics.counters)
+        prev = self._flight_prev_counters
+        delta = {
+            k: v - prev.get(k, 0)
+            for k, v in counters.items()
+            if v != prev.get(k, 0)
+        }
+        self._flight_prev_counters = counters
+        ctx = span.context()
+        rec = {
+            "seq": self.fleet.seq,
+            "kind": getattr(event, "kind", type(event).__name__),
+            "mode": view.mode if view is not None else "error",
+            "health": self.health,
+            "lp_backend": self._last_lp_backend,
+            "trace_id": ctx.trace_id if ctx is not None else None,
+            "span_id": ctx.span_id if ctx is not None else None,
+            "counters_delta": delta,
+        }
+        self._flight.record(self._flight_key, rec)
+        if self._flight_pending is not None:
+            reason, self._flight_pending = self._flight_pending, None
+            path = self._flight.trigger(self._flight_key, reason, rec)
+            if path is not None:
+                self.metrics.inc("flight_dumps")
 
     def health_snapshot(self) -> dict:
         """Plain-dict health view for the serve CLI / metrics endpoint."""

@@ -158,6 +158,15 @@ class StreamingReplanner:
         self.metrics = None
         self.last_tick_mode: Optional[str] = None  # 'cold'|'warm'|'margin'
         self.last_tick_escalations: int = 0
+        # The last tick's solver timings dict (build_ms/solve_ms/
+        # lp_backend/bnb_rounds/ipm_iters_executed/escalated...), kept as
+        # an attribute for DIRECT library users who drive step() in a loop
+        # and want the breakdown after the fact without threading a dict
+        # through every call site (same pattern as last_tick_mode /
+        # last_tick_escalations above; the scheduler reads its own tick_tm
+        # instead). Empty when the caller passed no timings dict — the
+        # solve never slows down to record one it wasn't asked for.
+        self.last_tick_timings: dict = {}
         self._last_shape: Optional[tuple] = None
         self._load_factors = None  # realized per-device load multipliers
         self._in_flight: list = []  # (PendingHalda, shape, devs, model, loads)
@@ -242,6 +251,7 @@ class StreamingReplanner:
 
         self.last = result
         self._last_shape = shape
+        self.last_tick_timings = dict(timings) if timings is not None else {}
         return result
 
     def _certify_or_fallback(
@@ -433,6 +443,7 @@ class StreamingReplanner:
         self.last_mapping = None
         self.last_tick_mode = None
         self.last_tick_escalations = 0
+        self.last_tick_timings = {}
         self._last_shape = None
         self._load_factors = None
         self._in_flight = []

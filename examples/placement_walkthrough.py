@@ -270,6 +270,55 @@ def main() -> int:
         "restored shards resume warm)"
     )
     restored.close()
+
+    # ------------------------------------------------------------------
+    # 11. Observability: where did each event's time go? Replay the
+    #     bundled 10-fleet gateway trace with span tracing on (`serve
+    #     --trace-spans-dir`), convert the span JSONL with `solver spans`
+    #     into Chrome trace-event JSON (load it in ui.perfetto.dev — one
+    #     track per worker thread, queue waits drawn as flow arrows), and
+    #     print the top-3 slowest spans (README "Observability").
+    # ------------------------------------------------------------------
+    import tempfile
+
+    from distilp_tpu.cli.solver_cli import serve_main, spans_main
+    from distilp_tpu.obs import read_spans, top_spans
+
+    with tempfile.TemporaryDirectory(prefix="distilp-obs-") as obs_dir:
+        rc = serve_main(
+            [
+                "--trace",
+                str(REPO / "tests" / "traces" / "gateway_smoke_10f.jsonl"),
+                "--profile",
+                str(REPO / "tests" / "profiles" / "llama_3_70b" / "online"),
+                "--workers", "2", "--k-candidates", "8,10", "--quiet",
+                "--trace-spans-dir", obs_dir,
+            ]
+        )
+        if rc != 0:
+            print(f"[11] traced replay failed (rc={rc})")
+            return rc
+        spans_path = Path(obs_dir) / "spans.jsonl"
+        spans = read_spans(spans_path)
+        spans_main([str(spans_path), "--quiet"])
+        chrome = spans_path.with_suffix(".chrome.json")
+        print(
+            f"[11] traced gateway replay: {len(spans)} spans from "
+            f"{len({s['trace_id'] for s in spans})} events -> "
+            f"{chrome.name} ({chrome.stat().st_size // 1024} KB Perfetto "
+            "file); top-3 slowest spans:"
+        )
+        for s in top_spans(spans, 3):
+            attrs = s.get("attrs") or {}
+            extra = "".join(
+                f" {k}={attrs[k]}"
+                for k in ("fleet", "kind", "mode", "lp_backend")
+                if k in attrs
+            )
+            print(
+                f"[11]   {s['dur_ms']:9.1f} ms  {s['name']:<18s} "
+                f"thread={s['thread']}{extra}"
+            )
     return 0
 
 

@@ -36,7 +36,7 @@ def test_registry_has_all_rule_codes():
     expected = {
         "DLP001", "DLP002", "DLP010", "DLP011",
         "DLP012", "DLP013", "DLP014", "DLP015", "DLP016", "DLP017",
-        "DLP018",
+        "DLP018", "DLP019",
     }
     assert expected <= set(RULES)
     for code, rule in RULES.items():
@@ -935,6 +935,119 @@ def test_blocking_async_outside_gateway_not_flagged():
             time.sleep(0.1)
         """)
     assert out == []
+
+
+# --------------------------------------------------------------------------
+# the obs/ layer joins the serving-layer contracts (DLP013/017/018)
+
+
+def test_obs_layer_joins_lazy_jax_contract():
+    out = findings_for("DLP013", "distilp_tpu/obs/exporter.py", """\
+        import jax
+
+        def export(spans):
+            return jax.numpy.asarray(spans)
+        """)
+    assert len(out) == 1 and "lazy" in out[0].message
+    ok = findings_for("DLP013", "distilp_tpu/obs/exporter.py", """\
+        def export(spans):
+            import jax
+
+            return jax.numpy.asarray(spans)
+        """)
+    assert ok == []
+
+
+def test_obs_layer_joins_silent_except_contract():
+    out = findings_for("DLP017", "distilp_tpu/obs/writer.py", """\
+        def write(self, rec):
+            try:
+                self.fh.write(rec)
+            except OSError:
+                pass
+        """)
+    assert len(out) == 1 and "metrics sink" in out[0].message
+
+
+def test_obs_layer_joins_blocking_async_contract():
+    out = findings_for("DLP018", "distilp_tpu/obs/pusher.py", """\
+        import time
+
+        async def push(self):
+            time.sleep(0.1)
+        """)
+    assert len(out) == 1 and "blocks the gateway event loop" in out[0].message
+
+
+# --------------------------------------------------------------------------
+# DLP019 — literal counter names must be registered in METRIC_REGISTRY
+
+
+def test_unregistered_literal_counter_flagged():
+    out = findings_for("DLP019", "distilp_tpu/sched/newpart.py", """\
+        def tick(self):
+            self.metrics.inc("totally_novel_counter")
+        """)
+    assert len(out) == 1
+    assert "METRIC_REGISTRY" in out[0].message
+    assert "totally_novel_counter" in out[0].message
+
+
+def test_registered_literal_counter_ok():
+    out = findings_for("DLP019", "distilp_tpu/sched/newpart.py", """\
+        def tick(self):
+            self.metrics.inc("events_total")
+            self.metrics.inc("breaker_open")
+        """)
+    assert out == []
+
+
+def test_conditional_literal_counter_checks_both_branches():
+    # The `"pool_hit" if hit else "pool_miss"` idiom: both branches must
+    # be registered; one rogue branch is one finding.
+    ok = findings_for("DLP019", "distilp_tpu/sched/pool.py", """\
+        def get(self, hit):
+            self.metrics.inc("pool_hit" if hit else "pool_miss")
+        """)
+    assert ok == []
+    bad = findings_for("DLP019", "distilp_tpu/sched/pool.py", """\
+        def get(self, hit):
+            self.metrics.inc("pool_hit" if hit else "rogue_branch")
+        """)
+    assert len(bad) == 1 and "rogue_branch" in bad[0].message
+
+
+def test_dynamic_counter_names_not_checked_by_dlp019():
+    # f-strings are covered by METRIC_FAMILIES (and the live-counter test
+    # in tests/test_obs.py), not by the literal rule.
+    out = findings_for("DLP019", "distilp_tpu/gateway/gw2.py", """\
+        def note(self, worker_id):
+            self.metrics.inc(f"worker_{worker_id}_events")
+        """)
+    assert out == []
+
+
+def test_dlp019_scoped_to_serving_layers():
+    # `.inc(` on arbitrary objects outside sched//gateway//obs/ (e.g. a
+    # solver-side accumulator) is not this rule's business.
+    out = findings_for("DLP019", "distilp_tpu/solver/acc.py", """\
+        def bump(self):
+            self.counts.inc("whatever_name")
+        """)
+    assert out == []
+    out = findings_for("DLP019", "tests/test_something.py", """\
+        def test_x(m):
+            m.inc("whatever_name")
+        """)
+    assert out == []
+
+
+def test_dlp019_obs_layer_in_scope():
+    out = findings_for("DLP019", "distilp_tpu/obs/flight2.py", """\
+        def dump(self):
+            self.metrics.inc("unregistered_flight_counter")
+        """)
+    assert len(out) == 1
 
 
 # --------------------------------------------------------------------------

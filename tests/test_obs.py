@@ -1,0 +1,779 @@
+"""Observability: span tracing, exporters, Prometheus exposition, flight
+recorder, and the metric registry.
+
+Pure-plumbing tests (tracer, exporters, registry) run without the solver;
+integration tests reuse the L=32 model + M=4 synthetic fleets and the
+[4, 8] k-grid of tests/test_sched.py so jit programs are shared across
+modules and each post-compile tick is milliseconds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from distilp_tpu.obs import (
+    NOOP_TRACER,
+    FlightRecorder,
+    JsonlSpanWriter,
+    Tracer,
+    now_ms,
+    parse_prometheus_text,
+    read_spans,
+    render_prometheus,
+    spans_to_chrome,
+    top_spans,
+)
+from distilp_tpu.sched import (
+    DeviceDegrade,
+    FaultPlan,
+    LoadTick,
+    Scheduler,
+    chaos_replay,
+    generate_trace,
+    registry_help,
+    replay,
+)
+from distilp_tpu.sched.metrics import FAULT_COUNTERS, METRIC_REGISTRY
+from distilp_tpu.utils import make_synthetic_fleet
+
+GAP = 1e-3
+KS = [4, 8]  # proper factors of L=32
+
+
+@pytest.fixture(scope="module")
+def model():
+    from distilp_tpu.profiler.api import profile_model
+
+    return profile_model(
+        "tests/configs/llama31_8b_4bit.json", batch_sizes=[1], sequence_length=128
+    ).to_model_profile()
+
+
+@pytest.fixture()
+def fleet():
+    return make_synthetic_fleet(4, seed=11)
+
+
+def make_scheduler(fleet, model, **kw):
+    kw.setdefault("mip_gap", GAP)
+    kw.setdefault("kv_bits", "4bit")
+    kw.setdefault("backend", "jax")
+    kw.setdefault("k_candidates", KS)
+    return Scheduler([d.model_copy(deep=True) for d in fleet], model, **kw)
+
+
+def by_trace(spans):
+    out = {}
+    for s in spans:
+        out.setdefault(s["trace_id"], []).append(s)
+    return out
+
+
+def roots_of(trace_spans):
+    return [s for s in trace_spans if s["parent_id"] is None]
+
+
+# -- the tracer core (no solver) -------------------------------------------
+
+
+def test_tracer_nesting_ring_and_json():
+    t = Tracer(capacity=8)
+    with t.span("outer", attrs={"kind": "load"}) as outer:
+        outer.add_event("decision", reason="because")
+        with t.span("inner") as inner:
+            assert t.current() == inner.context()
+        assert t.current() == outer.context()
+    assert t.current() is None
+    spans = t.spans()
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    inner_rec, outer_rec = spans
+    assert inner_rec["trace_id"] == outer_rec["trace_id"]
+    assert inner_rec["parent_id"] == outer_rec["span_id"]
+    assert outer_rec["parent_id"] is None
+    assert outer_rec["attrs"]["kind"] == "load"
+    assert outer_rec["events"][0]["name"] == "decision"
+    assert outer_rec["dur_ms"] >= inner_rec["dur_ms"] >= 0.0
+    json.dumps(spans)  # every record is wire-ready
+
+    # The ring is bounded: old spans fall off, nothing errors.
+    for i in range(20):
+        with t.span(f"s{i}"):
+            pass
+    assert len(t.spans()) == 8
+    # drain() empties it.
+    assert len(t.drain()) == 8
+    assert t.spans() == []
+
+
+def test_attr_values_are_coerced_json_safe():
+    import numpy as np
+
+    t = Tracer()
+    with t.span("s", attrs={"np": np.int64(3), "obj": object()}) as s:
+        s.set_attr("f32", np.float32(1.5))
+    rec = t.spans()[0]
+    assert rec["attrs"]["np"] == 3.0
+    assert rec["attrs"]["f32"] == 1.5
+    assert isinstance(rec["attrs"]["obj"], str)
+    json.dumps(rec)
+
+
+def test_cross_thread_attach_parents_correctly():
+    """The worker-adoption idiom: a foreign context attached on another
+    thread parents that thread's spans (and the after-the-fact queue-wait
+    record) under the original root."""
+    t = Tracer()
+    root = t.start_span("ingest", parent=None)
+    t_enq = now_ms()
+
+    def worker():
+        t.record_span("queue_wait", t_enq, parent=root.context())
+        with t.attach(root.context()):
+            with t.span("tick"):
+                pass
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    root.end()
+    spans = {s["name"]: s for s in t.spans()}
+    assert spans["queue_wait"]["parent_id"] == root.span_id
+    assert spans["tick"]["parent_id"] == root.span_id
+    assert spans["tick"]["trace_id"] == spans["ingest"]["trace_id"]
+    assert spans["tick"]["thread"] != spans["ingest"]["thread"]
+
+
+def test_noop_tracer_is_inert():
+    s = NOOP_TRACER.span("x")
+    with s:
+        s.add_event("y")
+        s.set_attr("a", 1)
+    assert s.context() is None
+    assert NOOP_TRACER.current() is None
+    assert NOOP_TRACER.record_span("q", 0.0) is None
+    assert NOOP_TRACER.spans() == [] and NOOP_TRACER.drain() == []
+    assert NOOP_TRACER.enabled is False
+
+
+def test_jsonl_writer_roundtrip(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    t = Tracer(writer=JsonlSpanWriter(path))
+    with t.span("a"):
+        with t.span("b"):
+            pass
+    t.close()
+    back = read_spans(path)
+    assert [s["name"] for s in back] == ["b", "a"]
+    assert back == t.spans()
+
+
+# -- Chrome trace conversion ------------------------------------------------
+
+
+def _synthetic_trace_spans():
+    """A hand-built ingest->route/queue_wait->tick tree on two threads."""
+    t = Tracer()
+    root = t.start_span("gateway.ingest", parent=None, attrs={"fleet": "f0"})
+    t.record_span("gateway.route", now_ms(), parent=root.context())
+    t_enq = now_ms()
+
+    def worker():
+        t.record_span(
+            "gateway.queue_wait", t_enq, parent=root.context(),
+            attrs={"worker": 0},
+        )
+        with t.attach(root.context()):
+            with t.span("sched.tick") as tick:
+                tick.add_event("health", state="degraded")
+
+    th = threading.Thread(target=worker, name="gw-worker-0")
+    th.start()
+    th.join()
+    root.end()
+    return t.spans()
+
+
+def test_chrome_conversion_schema_and_flows():
+    spans = _synthetic_trace_spans()
+    chrome = spans_to_chrome(spans)
+    # Loads as the Chrome trace-event JSON object form.
+    doc = json.loads(json.dumps(chrome))
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+
+    phases = {}
+    for ev in events:
+        assert {"name", "ph", "pid", "tid"} <= set(ev)
+        if ev["ph"] != "M":
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        phases.setdefault(ev["ph"], []).append(ev)
+    # One complete event per span, each on a named thread track.
+    assert len(phases["X"]) == len(spans)
+    for ev in phases["X"]:
+        assert ev["dur"] >= 0 and ev["args"]["trace_id"]
+    names = {m["args"]["name"] for m in phases["M"]}
+    assert "gw-worker-0" in names
+    # The queue wait became a flow arrow: an s/f pair sharing an id, the
+    # start on the enqueuing thread, the finish on the worker track.
+    assert len(phases["s"]) == 1 and len(phases["f"]) == 1
+    s_ev, f_ev = phases["s"][0], phases["f"][0]
+    assert s_ev["id"] == f_ev["id"]
+    assert s_ev["tid"] != f_ev["tid"]
+    # Span events became instants.
+    assert any(ev["name"] == "health" for ev in phases.get("i", []))
+
+
+def test_top_spans_orders_by_duration():
+    spans = [
+        {"name": "a", "dur_ms": 1.0},
+        {"name": "b", "dur_ms": 9.0},
+        {"name": "c", "dur_ms": 5.0},
+    ]
+    assert [s["name"] for s in top_spans(spans, 2)] == ["b", "c"]
+
+
+def test_spans_cli_roundtrip(tmp_path):
+    from distilp_tpu.cli.solver_cli import main as cli_main
+
+    path = tmp_path / "spans.jsonl"
+    with open(path, "w") as fh:
+        for s in _synthetic_trace_spans():
+            fh.write(json.dumps(s) + "\n")
+    out = tmp_path / "chrome.json"
+    rc = cli_main(["spans", str(tmp_path), "--out", str(out), "--top", "2"])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"]
+    # Empty/missing inputs are errors, not empty files.
+    assert cli_main(["spans", str(tmp_path / "nope.jsonl")]) == 2
+
+
+# -- metric registry + Prometheus exposition --------------------------------
+
+
+def _registered(sample_name: str):
+    assert sample_name.startswith("distilp_")
+    name = sample_name[len("distilp_"):]
+    help_txt = registry_help(name)
+    if help_txt is None:
+        for suffix in ("_sum", "_count"):
+            if name.endswith(suffix):
+                help_txt = registry_help(name[: -len(suffix)])
+    return help_txt
+
+
+def test_fault_counters_all_registered():
+    for name in FAULT_COUNTERS:
+        assert name in METRIC_REGISTRY, name
+    # Families resolve dynamic names; unknown names stay unresolved.
+    assert registry_help("tick_cold") and registry_help("fault_injected_nan_poison")
+    assert registry_help("no_such_counter_xyz") is None
+
+
+def test_render_parse_roundtrip_two_shards():
+    shards = [
+        {
+            "fleet": "f000", "shard": "f000::default", "worker": 0,
+            "health": "healthy",
+            "counters": {"events_total": 5, "tick_warm": 4, "tick_cold": 1},
+            "latency": {
+                "event_to_placement": {
+                    "count": 5, "mean_ms": 10.0, "window_count": 5,
+                    "window_mean_ms": 10.0, "p50_ms": 9.0, "p99_ms": 30.0,
+                    "max_ms": 30.0,
+                }
+            },
+        },
+        {
+            "fleet": "f001", "shard": "f001::default", "worker": 1,
+            "health": "degraded",
+            "counters": {"events_total": 7, "events_quarantined": 1},
+            "latency": {},
+        },
+    ]
+    text = render_prometheus(
+        shards,
+        gateway_counters={"gateway_events": 12, "worker_0_events": 5,
+                          "worker_1_events": 7},
+        gateway_latency={},
+    )
+    parsed = parse_prometheus_text(text)
+    assert parsed["samples"], "no samples rendered"
+    # Every sample line resolves to a registered name (summary suffixes
+    # resolve through their base metric).
+    for name, labels, value in parsed["samples"]:
+        assert _registered(name), f"unregistered sample {name}"
+    # HELP + TYPE present for every metric family that has samples.
+    base_names = set(parsed["help"])
+    assert base_names == set(parsed["type"])
+    for name, labels, value in parsed["samples"]:
+        base = name
+        if base not in base_names:
+            base = base.rsplit("_", 1)[0]  # _sum/_count
+        assert base in base_names, name
+    # Per-fleet labels distinguish the two shards.
+    ev_samples = [
+        (labels, value)
+        for name, labels, value in parsed["samples"]
+        if name == "distilp_events_total"
+    ]
+    fleets = {labels["fleet"]: value for labels, value in ev_samples}
+    assert fleets == {"f000": 5.0, "f001": 7.0}
+    for labels, _ in ev_samples:
+        # Health is deliberately NOT on counter series (a transition would
+        # churn every series identity); it lives on the health gauge.
+        assert set(labels) == {"fleet", "shard", "worker"}
+    # worker_<i>_events folded into one labeled metric.
+    wk = {
+        labels["worker"]: value
+        for name, labels, value in parsed["samples"]
+        if name == "distilp_worker_events"
+    }
+    assert wk == {"0": 5.0, "1": 7.0}
+    # The summary carries quantiles + sum/count.
+    q = {
+        labels.get("quantile"): value
+        for name, labels, value in parsed["samples"]
+        if name == "distilp_event_to_placement" and "quantile" in labels
+    }
+    assert q == {"0.5": 9.0, "0.99": 30.0}
+    counts = [
+        value
+        for name, _, value in parsed["samples"]
+        if name == "distilp_event_to_placement_count"
+    ]
+    assert counts == [5.0]
+    # Health gauge present and typed; the state string rides THIS metric's
+    # label, value = rank.
+    assert parsed["type"]["distilp_health_state"] == "gauge"
+    health = {
+        labels["fleet"]: (labels["health"], value)
+        for name, labels, value in parsed["samples"]
+        if name == "distilp_health_state"
+    }
+    assert health == {"f000": ("healthy", 0.0), "f001": ("degraded", 1.0)}
+    # Exact-sum passthrough: a snapshot carrying total_ms wins over the
+    # rounded-mean reconstruction (monotonicity of the summary _sum).
+    assert parse_prometheus_text(
+        render_prometheus(
+            [
+                {
+                    "fleet": "fz", "shard": "fz::d", "worker": 0,
+                    "health": "healthy", "counters": {},
+                    "latency": {
+                        "event_to_placement": {
+                            "count": 3, "total_ms": 10.001, "mean_ms": 3.334,
+                            "p50_ms": 3.0, "p99_ms": 4.0, "max_ms": 4.0,
+                        }
+                    },
+                }
+            ]
+        )
+    )["samples"]
+    # Escape round trip: backslash+n must survive as two characters.
+    tricky = render_prometheus(
+        [
+            {
+                "fleet": "a\\nightly", "shard": "s", "worker": 0,
+                "health": "healthy", "counters": {"events_total": 1},
+                "latency": {},
+            }
+        ]
+    )
+    got = [
+        labels["fleet"]
+        for name, labels, _v in parse_prometheus_text(tricky)["samples"]
+        if name == "distilp_events_total"
+    ]
+    assert got == ["a\\nightly"]
+
+
+def test_registry_covers_live_scheduler_counters(fleet, model):
+    """Replay a churn trace and check every counter the scheduler actually
+    emitted resolves through the registry — the drift test DLP019 cannot
+    do for f-string names."""
+    sched = make_scheduler(fleet, model)
+    trace = generate_trace("mixed", 12, seed=23, base_fleet=fleet)
+    replay(sched, trace)
+    for name in sched.metrics.counters:
+        assert registry_help(name), f"counter {name!r} not covered"
+    for name in sched.metrics.hists:
+        assert registry_help(name), f"hist {name!r} not covered"
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    fr = FlightRecorder(capacity=3, dump_dir=tmp_path)
+    for i in range(5):
+        fr.record("f0", {"seq": i})
+    assert [r["seq"] for r in fr.snapshot("f0")] == [2, 3, 4]
+    assert fr.snapshot("ghost") == []
+    path = fr.trigger("f0", "breaker_open", {"seq": 4})
+    assert path is not None and path.exists()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    header, records = lines[0], lines[1:]
+    assert header["reason"] == "breaker_open"
+    assert header["flight"] == "f0"
+    assert header["trigger"] == {"seq": 4}
+    assert header["records"] == 3 == len(records)
+    # The trigger also lands in the live ring as a marker.
+    assert any("flight_trigger" in r for r in fr.snapshot("f0"))
+    assert fr.dumps == [path]
+
+
+def test_flight_without_dump_dir_marks_ring_only():
+    fr = FlightRecorder(capacity=4)
+    fr.record("x", {"seq": 0})
+    assert fr.trigger("x", "chaos_violation") is None
+    assert fr.snapshot("x")[-1]["flight_trigger"] == "chaos_violation"
+    assert fr.dumps == []
+
+
+# -- scheduler integration --------------------------------------------------
+
+
+def test_scheduler_tick_span_tree_and_quarantine(fleet, model):
+    tracer = Tracer()
+    sched = make_scheduler(fleet, model, tracer=tracer)
+    sched.handle(LoadTick(t_comm_jitter={}))
+    sched.handle(DeviceDegrade(name=fleet[1].name, t_comm_scale=1.1))
+    # A poisoned event: quarantined, no solve.
+    sched.handle(DeviceDegrade(name=fleet[1].name, t_comm_scale=float("nan")))
+    traces = by_trace(tracer.spans())
+    assert len(traces) == 3  # one rooted trace per handled event
+    solved, quarantined = 0, 0
+    for spans in traces.values():
+        roots = roots_of(spans)
+        assert len(roots) == 1 and roots[0]["name"] == "sched.tick"
+        ids = {s["span_id"] for s in spans}
+        assert all(
+            s["parent_id"] in ids for s in spans if s["parent_id"] is not None
+        ), "orphan span"
+        names = {s["name"] for s in spans}
+        if "sched.solve" in names:
+            solved += 1
+            assert "sched.publish" in names
+            solve = next(s for s in spans if s["name"] == "sched.solve")
+            assert solve["parent_id"] == roots[0]["span_id"]
+            # The solver's timings dict rode the solve span.
+            assert "solve_ms" in solve["attrs"]
+            assert solve["attrs"]["lp_backend"] in ("ipm", "pdhg")
+        else:
+            quarantined += 1
+            events = [e["name"] for e in roots[0]["events"]]
+            assert "quarantined" in events
+            # The quarantined event re-served the previous view: the tick
+            # span carries the mode of what was actually served.
+            assert roots[0]["attrs"]["mode"] == "warm"
+    assert solved == 2 and quarantined == 1
+    # Tick spans carry the served mode (cold boot, warm drift, re-served).
+    modes = sorted(
+        roots_of(spans)[0]["attrs"]["mode"] for spans in traces.values()
+    )
+    assert modes == ["cold", "warm", "warm"]
+    # Direct library users get the same breakdown off the replanner — the
+    # timings the solve span carries are also the planner's attribute.
+    (_key, planner), = sched.pool.items()
+    assert planner.last_tick_timings.get("lp_backend") in ("ipm", "pdhg")
+    assert "solve_ms" in planner.last_tick_timings
+
+
+def test_untraced_scheduler_counters_identical(fleet, model):
+    """The byte-identical contract: same trace with and without a tracer
+    (and with a flight recorder) produces the same counters and the same
+    placements."""
+    trace = generate_trace("mixed", 10, seed=5, base_fleet=fleet)
+    plain = make_scheduler(fleet, model)
+    r1 = replay(plain, trace)
+    fr = FlightRecorder(capacity=64)
+    traced = make_scheduler(
+        fleet, model, tracer=Tracer(), flight=fr, flight_key="f"
+    )
+    r2 = replay(traced, trace)
+    assert plain.metrics.counters == traced.metrics.counters
+    assert [
+        (v.result.k, tuple(v.result.w), v.result.obj_value) for v in r1.views
+    ] == [
+        (v.result.k, tuple(v.result.w), v.result.obj_value) for v in r2.views
+    ]
+    assert len(fr.snapshot("f")) == len(trace)
+
+
+def test_flight_breaker_postmortem_reconciles_with_chaos(fleet, model, tmp_path):
+    """The chaos acceptance: the soak under the BUNDLED fault plan (the
+    `make smoke-chaos` plan, whose consecutive solver exceptions at ticks
+    7-8 open the breaker) produces a post-mortem dump whose records
+    reconcile with the ChaosReport — and the breaker-open tick is IN the
+    dump, span id attached."""
+    from distilp_tpu.sched import read_trace
+
+    tracer = Tracer()
+    fr = FlightRecorder(capacity=512, dump_dir=tmp_path)
+    sched = make_scheduler(
+        fleet, model,
+        max_retries=1, retry_backoff_s=0.001,
+        breaker_threshold=2, breaker_cooldown=1, healthy_after=2,
+        tracer=tracer, flight=fr, flight_key="default",
+    )
+    trace = read_trace("tests/traces/scheduler_smoke_20.jsonl")
+    plan = FaultPlan.from_json("tests/traces/chaos_plan.json")
+    report = chaos_replay(sched, trace, plan)
+    assert report.violations(model.L) == []
+    assert sched.metrics.counters["breaker_open"] == 1
+    assert sched.metrics.counters["flight_dumps"] == 1
+
+    assert len(fr.dumps) == 1
+    lines = [json.loads(ln) for ln in fr.dumps[0].read_text().splitlines()]
+    header, records = lines[0], lines[1:]
+    assert header["reason"] == "breaker_open"
+    # The triggering record is the breaker-open tick: broken health, a
+    # breaker_open counter delta, and the tick's span ids (tracing on).
+    trig = header["trigger"]
+    assert trig["health"] == "broken"
+    assert trig["counters_delta"].get("breaker_open") == 1
+    assert trig["span_id"] and trig["trace_id"]
+    assert records[-1] == trig  # the dump INCLUDES the breaker-open tick
+    # That span id is a real recorded sched.tick span.
+    tick_spans = {
+        s["span_id"]: s for s in tracer.spans() if s["name"] == "sched.tick"
+    }
+    assert trig["span_id"] in tick_spans
+    assert any(
+        e["name"] == "breaker_open"
+        for e in tick_spans[trig["span_id"]]["events"]
+    )
+
+    # Ring records reconcile with the ChaosReport: one record per handled
+    # event (trigger markers excluded), quarantine deltas sum to the
+    # report's quarantine count.
+    ring = fr.snapshot("default")
+    tick_recs = [r for r in ring if "flight_trigger" not in r]
+    assert len(tick_recs) == len(report.records)
+    quarantined_delta = sum(
+        r["counters_delta"].get("events_quarantined", 0) for r in tick_recs
+    )
+    assert quarantined_delta == report.summary()["quarantined"]
+    assert quarantined_delta == sched.metrics.counters["events_quarantined"]
+
+
+def test_jax_profile_dir_first_tick_smoke(fleet, model, tmp_path):
+    """serve --jax-profile-dir satellite: the first cold solve runs under
+    jax.profiler.trace and leaves a non-empty profile directory (CPU)."""
+    profile_dir = tmp_path / "xla"
+    sched = make_scheduler(fleet, model, jax_profile_dir=str(profile_dir))
+    sched.handle(LoadTick(t_comm_jitter={}))
+    files = [p for p in profile_dir.rglob("*") if p.is_file()]
+    assert files, "profiler trace produced no files"
+    # One capture only: the second tick must not re-enter the profiler.
+    before = len(files)
+    sched.handle(LoadTick(t_comm_jitter={}))
+    after = len([p for p in profile_dir.rglob("*") if p.is_file()])
+    assert after == before
+
+
+# -- gateway integration ----------------------------------------------------
+
+
+def _gateway_fleet(fleet_id: str, seed: int):
+    from distilp_tpu.gateway.traces import make_fleet_from_spec
+
+    return make_fleet_from_spec(fleet_id, {"m": 4, "seed": seed})
+
+
+def test_gateway_concurrent_span_trees(model):
+    """The acceptance gate: a concurrent multi-fleet async replay (3
+    fleets, 2 workers) yields ONE rooted span tree per event —
+    ingest -> {route, queue-wait, tick -> solve} — with no orphan spans,
+    even with coroutines interleaving on the loop thread."""
+    from distilp_tpu.gateway import Gateway
+
+    tracer = Tracer(capacity=65536)
+    gw = Gateway(
+        n_workers=2,
+        scheduler_kwargs=dict(
+            mip_gap=GAP, kv_bits="4bit", backend="jax", k_candidates=KS
+        ),
+        tracer=tracer,
+    )
+    events_per_fleet = 3
+    try:
+        fleets = ["oa", "ob", "oc"]
+        for i, fid in enumerate(fleets):
+            gw.register_fleet(fid, _gateway_fleet(fid, 60 + i), model)
+
+        async def drive(fid):
+            for _ in range(events_per_fleet):
+                await gw.handle_event_async(fid, LoadTick(t_comm_jitter={}))
+
+        async def main():
+            await asyncio.gather(*(drive(f) for f in fleets))
+
+        asyncio.run(main())
+    finally:
+        gw.close()
+
+    traces = by_trace(tracer.spans())
+    assert len(traces) == len(fleets) * events_per_fleet
+    for spans in traces.values():
+        roots = roots_of(spans)
+        assert len(roots) == 1, "multiple roots in one trace"
+        root = roots[0]
+        assert root["name"] == "gateway.ingest"
+        ids = {s["span_id"] for s in spans}
+        for s in spans:
+            if s["parent_id"] is not None:
+                assert s["parent_id"] in ids, f"orphan span {s['name']}"
+        named = {}
+        for s in spans:
+            named.setdefault(s["name"], []).append(s)
+        for required in (
+            "gateway.route", "gateway.queue_wait", "sched.tick", "sched.solve"
+        ):
+            assert required in named, f"missing {required}"
+        # Causal shape: route + queue-wait + tick under ingest, solve
+        # under tick; the tick ran on a worker thread, the ingest on the
+        # loop thread.
+        assert named["gateway.queue_wait"][0]["parent_id"] == root["span_id"]
+        tick = named["sched.tick"][0]
+        assert tick["parent_id"] == root["span_id"]
+        assert named["sched.solve"][0]["parent_id"] == tick["span_id"]
+        assert tick["thread"].startswith("gw-worker-")
+        assert tick["thread"] == named["gateway.queue_wait"][0]["thread"]
+        # Ingest is the outermost timed region of its trace.
+        assert root["dur_ms"] >= tick["dur_ms"]
+    # Concurrency really happened across both workers.
+    threads = {
+        s["thread"] for s in tracer.spans() if s["name"] == "sched.tick"
+    }
+    assert len(threads) == 2
+    # And the whole batch converts to loadable Chrome JSON.
+    chrome = spans_to_chrome(tracer.spans())
+    assert json.loads(json.dumps(chrome))["traceEvents"]
+
+
+def test_gateway_http_prom_flight_and_tracing(model, tmp_path):
+    """HTTP surface: /metrics content-negotiates Prometheus text (Accept
+    or ?format=prom) while JSON stays the default; /debug/flight serves
+    the live ring; a traced POST /events roots at http.request."""
+    import urllib.error
+    import urllib.request
+
+    from distilp_tpu.gateway import Gateway, GatewayHTTPServer
+
+    tracer = Tracer(capacity=65536)
+    fr = FlightRecorder(capacity=32, dump_dir=tmp_path)
+    gw = Gateway(
+        n_workers=2,
+        scheduler_kwargs=dict(
+            mip_gap=GAP, kv_bits="4bit", backend="jax", k_candidates=KS
+        ),
+        tracer=tracer,
+        flight=fr,
+    )
+
+    def get(port, path, accept=None):
+        req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+        if accept:
+            req.add_header("Accept", accept)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return r.status, r.headers.get("Content-Type", ""), r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.headers.get("Content-Type", ""), e.read()
+
+    def post(port, path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+
+    try:
+        gw.register_fleet("hx", _gateway_fleet("hx", 77), model)
+        gw.register_fleet("hy", _gateway_fleet("hy", 78), model)
+
+        async def main():
+            srv = GatewayHTTPServer(gw)
+            await srv.start()
+            loop = asyncio.get_running_loop()
+            port = srv.port
+            ev = {"kind": "load", "t_comm_jitter": {}}
+            for fid in ("hx", "hy"):
+                st, out = await loop.run_in_executor(
+                    None, post, port, "/events", {"fleet": fid, "event": ev}
+                )
+                assert st == 200 and out["view"]["certified"]
+
+            # Default /metrics stays the JSON snapshot.
+            st, ctype, body = await loop.run_in_executor(
+                None, get, port, "/metrics", None
+            )
+            assert st == 200 and ctype.startswith("application/json")
+            assert json.loads(body)["shards"] == 2
+
+            # Accept: text/plain negotiates the labeled exposition.
+            st, ctype, body = await loop.run_in_executor(
+                None, get, port, "/metrics", "text/plain"
+            )
+            assert st == 200 and ctype.startswith("text/plain")
+            parsed = parse_prometheus_text(body.decode())
+            for name, _labels, _v in parsed["samples"]:
+                assert _registered(name), f"unregistered sample {name}"
+            fleets = {
+                labels["fleet"]
+                for name, labels, _v in parsed["samples"]
+                if name == "distilp_events_total"
+            }
+            assert fleets == {"hx", "hy"}  # labels distinguish the shards
+            assert parsed["help"] and parsed["type"]
+
+            # ?format=prom forces it without the header.
+            st, ctype, body2 = await loop.run_in_executor(
+                None, get, port, "/metrics?format=prom", None
+            )
+            assert st == 200 and ctype.startswith("text/plain")
+            assert body2.decode().startswith("# HELP")
+
+            # Live flight ring over HTTP; unknown fleet 404s.
+            st, _ctype, body = await loop.run_in_executor(
+                None, get, port, "/debug/flight/hx", None
+            )
+            assert st == 200
+            flight = json.loads(body)
+            assert flight["fleet"] == "hx"
+            assert len(flight["records"]) == 1
+            assert flight["records"][0]["mode"] == "cold"
+            st, _ctype, _body = await loop.run_in_executor(
+                None, get, port, "/debug/flight/ghost", None
+            )
+            assert st == 404
+            await srv.close()
+
+        asyncio.run(main())
+    finally:
+        gw.close()
+
+    # Each traced POST rooted at http.request, ingest nested under it.
+    traces = by_trace(
+        [s for s in tracer.spans() if s["name"] != "gateway.route"]
+    )
+    http_traces = [
+        spans
+        for spans in traces.values()
+        if any(s["name"] == "http.request" for s in spans)
+    ]
+    assert len(http_traces) == 2
+    for spans in http_traces:
+        roots = roots_of(spans)
+        assert len(roots) == 1 and roots[0]["name"] == "http.request"
+        ingest = next(s for s in spans if s["name"] == "gateway.ingest")
+        assert ingest["parent_id"] == roots[0]["span_id"]

@@ -133,7 +133,31 @@ def test_latency_hist_quantiles():
     assert snap["p50_ms"] == pytest.approx(50.0, abs=1.0)
     assert snap["p99_ms"] == pytest.approx(99.0, abs=1.0)
     assert snap["max_ms"] == 100.0
+    # Below the cap the two views coincide.
+    assert snap["window_count"] == 100
+    assert snap["window_mean_ms"] == snap["mean_ms"]
     assert json.dumps(snap)  # plain types only
+
+
+def test_latency_hist_windowed_snapshot_after_overflow():
+    """Post-overflow coherence: all-time fields keep counting while the
+    quantiles/max/window_* describe only the cap-bounded recent window —
+    the snapshot says WHICH population each number comes from instead of
+    silently mixing them (the old mean_ms was all-time next to windowed
+    p50/p99)."""
+    h = LatencyHist(cap=4)
+    for v in range(1, 11):  # 1..10; window keeps 7,8,9,10
+        h.record(float(v))
+    snap = h.snapshot()
+    assert snap["count"] == 10
+    assert snap["mean_ms"] == pytest.approx(5.5)  # all-time
+    assert snap["window_count"] == 4
+    assert snap["window_mean_ms"] == pytest.approx(8.5)  # recent window
+    # Quantiles/max come from the SAME window the window_mean describes.
+    assert snap["p50_ms"] == pytest.approx(9.0, abs=1.0)
+    assert snap["p99_ms"] == 10.0
+    assert snap["max_ms"] == 10.0
+    assert json.dumps(snap)
 
 
 # -- the replanning core (JAX backend on CPU) ------------------------------

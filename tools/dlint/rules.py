@@ -52,6 +52,11 @@ LAZY_JAX_PREFIXES = (
     # top-level jax import here would drag backend init into every
     # process that merely parses a snapshot or a multi-fleet trace.
     "distilp_tpu/gateway/",
+    # The observability layer is pure plumbing (spans, exporters, flight
+    # rings): `solver spans` must convert a JSONL on a box with no
+    # backend at all, and a top-level jax import here would leak into the
+    # sched/gateway layers that import obs at module level.
+    "distilp_tpu/obs/",
 )
 LAZY_JAX_MODULES = {
     "distilp_tpu/__init__.py",
@@ -791,7 +796,14 @@ class SilentExceptInScheduler(Rule):
         "and every dashboard) claim nothing happened."
     )
 
-    _PATH_PREFIXES = ("distilp_tpu/sched/", "distilp_tpu/gateway/")
+    _PATH_PREFIXES = (
+        "distilp_tpu/sched/",
+        "distilp_tpu/gateway/",
+        # The obs layer makes the same promise one level up: a tracer or
+        # flight recorder that silently ate a failure would be the one
+        # component whose faults nothing else can observe.
+        "distilp_tpu/obs/",
+    )
     # Attribute calls that count as recording through the metrics sink.
     # `_quarantine` is the scheduler's fault recorder (it increments the
     # quarantine counters and the health state); delegating to it from a
@@ -846,7 +858,10 @@ class BlockingCallInAsyncGateway(Rule):
         "are the executor-closure idiom, judged where they run."
     )
 
-    _PATH_PREFIXES = ("distilp_tpu/gateway/",)
+    # obs/ has no event loop of its own today, but it is imported BY the
+    # gateway's async tier — the same contract applies the day it grows
+    # an async exporter.
+    _PATH_PREFIXES = ("distilp_tpu/gateway/", "distilp_tpu/obs/")
     # module -> function names that block the loop outright. Matched
     # through ALIASES too: `import time as t; t.sleep(...)` and
     # `from subprocess import run` block exactly as hard as the literal
@@ -935,3 +950,97 @@ class BlockingCallInAsyncGateway(Rule):
                         "loop.run_in_executor",
                     )
             stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class UnregisteredMetricName(Rule):
+    code = "DLP019"
+    name = "unregistered-metric-name"
+    rationale = (
+        "sched.metrics.METRIC_REGISTRY is the ONE enumeration of every "
+        "counter the serving layers emit: the Prometheus exposition takes "
+        "its `# HELP` lines from it and dashboards enumerate from it. A "
+        "string-literal `metrics.inc(\"...\")` in sched//gateway//obs/ "
+        "whose name is not an exact registry entry is a counter that "
+        "ships without help text — it renders as an unregistered sample, "
+        "and the dashboards drift from the code silently. Dynamically "
+        "composed names (f-strings over event kinds / tick modes / fault "
+        "kinds / worker ids) are covered by METRIC_FAMILIES prefixes "
+        "instead and are not checked here."
+    )
+
+    _PATH_PREFIXES = (
+        "distilp_tpu/sched/",
+        "distilp_tpu/gateway/",
+        "distilp_tpu/obs/",
+    )
+
+    _registry_cache: Optional[Dict[str, str]] = None
+
+    @classmethod
+    def _registry(cls) -> Dict[str, str]:
+        # The registry lives in the metrics module so there is exactly one
+        # copy; loading that FILE directly (not `import distilp_tpu...`)
+        # keeps dlint runnable in environments without the package's
+        # dependencies — the package __init__ chain pulls numpy/pydantic,
+        # while metrics.py itself is stdlib-only — and keeps a broken edit
+        # elsewhere in the package from taking the linter down with it.
+        if cls._registry_cache is None:
+            import importlib.util
+
+            from .core import REPO
+
+            path = REPO / "distilp_tpu" / "sched" / "metrics.py"
+            spec = importlib.util.spec_from_file_location(
+                "_dlint_metric_registry", path
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            cls._registry_cache = mod.METRIC_REGISTRY
+        return cls._registry_cache
+
+    @staticmethod
+    def _literal_names(arg: ast.AST) -> List[str]:
+        """The candidate metric names a literal-ish first argument names:
+        a plain string constant, or a conditional expression over string
+        constants (the `"pool_hit" if hit else "pool_miss"` idiom — both
+        branches must be registered)."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return [arg.value]
+        if isinstance(arg, ast.IfExp):
+            out: List[str] = []
+            for branch in (arg.body, arg.orelse):
+                if isinstance(branch, ast.Constant) and isinstance(
+                    branch.value, str
+                ):
+                    out.append(branch.value)
+            return out
+        return []
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.is_test or not any(
+            ctx.relpath.startswith(p) for p in self._PATH_PREFIXES
+        ):
+            return
+        registry = None
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "inc"
+            ):
+                continue
+            for name in self._literal_names(node.args[0]):
+                if registry is None:
+                    registry = self._registry()
+                if name not in registry:
+                    yield Finding(
+                        ctx.relpath,
+                        node.lineno,
+                        self.code,
+                        f"counter {name!r} is not in "
+                        "sched.metrics.METRIC_REGISTRY; register it (with "
+                        "help text) so the Prometheus exposition and "
+                        "dashboards cannot drift from the code",
+                    )
