@@ -18,7 +18,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec
 	python -m pytest tests/ -q
 
 .PHONY: bench
@@ -88,6 +88,42 @@ smoke-chaos: lint-strict
 		--fault-plan tests/traces/chaos_plan.json \
 		--deadline-ms 60000 --max-retries 2 --breaker-threshold 2 \
 		--chaos-check --quiet
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+		--trace tests/traces/scheduler_smoke_20.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--synthetic-fleet 4 --fleet-seed 11 --k-candidates 8,10 \
+		--fault-plan tests/traces/chaos_plan.json \
+		--deadline-ms 60000 --max-retries 2 --breaker-threshold 2 \
+		--chaos-check --quiet --speculate
+
+# Speculative-replanning smoke: the bundled burst trace (correlated
+# multi-device spikes that relax exactly) replayed with --speculate on the
+# same 4-device fleet the chaos smoke uses. The soak contract here:
+# speculative hits actually happened (hit_rate > 0 over the whole trace,
+# cold-bank warmup included), every probe is accounted (hits + misses ==
+# probed ticks, hits never exceed what was banked), no tick failed, and no
+# structural tick missed its certificate (--fail-uncertified). The chaos
+# interaction — spec counters reconciling under injected faults — is the
+# second smoke-chaos invocation above; the p99 speculation-on-vs-off gate
+# lives in the bench (`speculation` section, `make bench-compare`).
+.PHONY: smoke-spec
+smoke-spec: lint-strict
+	@T=$$(mktemp) && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+		--trace tests/traces/spec_burst.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--synthetic-fleet 4 --fleet-seed 11 --k-candidates 8,10 \
+		--speculate --quiet --fail-uncertified --metrics-out $$T && \
+	JAX_PLATFORMS=cpu python -c "import json; \
+		s=json.load(open('$$T')); sp=s['speculation']; r=s['replay']; \
+		assert sp['hits'] > 0, 'no speculative hits on the burst trace'; \
+		assert sp['hit_rate'] > 0, 'zero hit rate'; \
+		assert sp['hits'] + sp['misses'] <= r['events'], 'probe accounting'; \
+		assert r['failed_ticks'] == 0, 'failed ticks under speculation'; \
+		assert r['structural_uncertified'] == 0, 'uncertified structural tick'; \
+		print('smoke-spec OK: %d/%d ticks served from the bank (hit rate %.0f%%), 0 failures' \
+			% (sp['hits'], sp['hits'] + sp['misses'], 100 * sp['hit_rate']))"; \
+	rc=$$?; rm -f $$T; exit $$rc
 
 # Gateway smoke: the zero-downtime drain/restore contract, end to end.
 # Three serve runs over the bundled 10-fleet trace through 2 sharded

@@ -214,6 +214,34 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "--risk-seed", type=int, default=0,
         help="PRNG seed of the risk-aware perturbation draws",
     )
+    # Speculative replanning (sched.forecast + sched.speculate; README
+    # "Speculative replanning"). Default OFF = byte-identical serving.
+    p.add_argument(
+        "--speculate",
+        action="store_true",
+        help="speculative replanning: forecast drift from the applied "
+        "event stream (per-channel EWMA + trend), pre-solve the K most "
+        "likely near-future instances as ONE vmapped scenario batch "
+        "after each tick (warm-seeded from the incumbent, off the "
+        "serving path), and serve a matching event from the pre-solved "
+        "bank at cache-hit latency (published mode='spec'; honest "
+        "misses fall through to the normal tick path)",
+    )
+    p.add_argument(
+        "--spec-k",
+        type=int,
+        default=3,
+        help="forecast candidates pre-solved per speculation batch",
+    )
+    p.add_argument(
+        "--spec-tolerance",
+        type=float,
+        default=0.05,
+        help="relative tolerance of the speculation bank's instance "
+        "digest: a banked placement serves an event whose fleet is "
+        "within one tolerance bucket per drift channel of the instance "
+        "it was certified on",
+    )
     p.add_argument(
         "--fail-uncertified",
         action="store_true",
@@ -713,6 +741,9 @@ def serve_main(argv=None) -> int:
         risk_aware=args.risk_aware,
         risk_samples=args.risk_samples,
         risk_seed=args.risk_seed,
+        speculative=args.speculate,
+        spec_k=args.spec_k,
+        spec_tolerance=args.spec_tolerance,
         tracer=tracer,
         flight=flight,
         jax_profile_dir=args.jax_profile_dir,
@@ -766,6 +797,8 @@ def serve_main(argv=None) -> int:
             # recorder exists for — dump before the process reports it.
             if flight.trigger("default", "chaos_violation") is not None:
                 sched.metrics.inc("flight_dumps")
+    if args.speculate:
+        summary["speculation"] = sched.speculation_snapshot()
     if writer is not None or flight is not None:
         summary["obs"] = _obs_summary(writer, flight)
     if args.risk_aware:
@@ -917,6 +950,9 @@ def _serve_gateway(args) -> int:
         risk_aware=args.risk_aware,
         risk_samples=args.risk_samples,
         risk_seed=args.risk_seed,
+        speculative=args.speculate,
+        spec_k=args.spec_k,
+        spec_tolerance=args.spec_tolerance,
     )
     if args.deadline_ms is not None:
         scheduler_kwargs["solve_deadline_s"] = args.deadline_ms / 1e3
@@ -1071,6 +1107,20 @@ def _serve_gateway(args) -> int:
             summary["drift_warm_share"] = round(
                 drift_warm_share(gw.scheduler("default").metrics), 4
             )
+        if args.speculate:
+            # Tier-level speculation view: the shard-total counters (each
+            # shard's bank and forecaster are worker-owned; this is the
+            # aggregate the operator gates on).
+            s_hits = totals.get("spec_hit", 0)
+            s_probes = s_hits + totals.get("spec_miss", 0)
+            summary["speculation"] = {
+                "hits": s_hits,
+                "misses": totals.get("spec_miss", 0),
+                "presolved": totals.get("spec_presolve", 0),
+                "presolve_failed": totals.get("spec_presolve_failed", 0),
+                "stale": totals.get("spec_stale", 0),
+                "hit_rate": round(s_hits / s_probes, 4) if s_probes else 0.0,
+            }
         if chaos is not None:
             summary["chaos"] = chaos.summary()
             if flight is not None and chaos.violations(

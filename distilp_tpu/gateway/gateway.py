@@ -56,6 +56,11 @@ _AGGREGATED_SHARD_COUNTERS = (
     "deadline_missed",
     "breaker_open",
     "solver_escalations",
+    "spec_hit",
+    "spec_miss",
+    "spec_stale",
+    "spec_presolve",
+    "spec_presolve_failed",
 )
 
 

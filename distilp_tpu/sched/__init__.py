@@ -46,6 +46,7 @@ from .events import (
     write_trace,
 )
 from .fleet import FleetState
+from .forecast import ChurnForecaster
 from .metrics import (
     HEALTH_BROKEN,
     HEALTH_DEGRADED,
@@ -59,6 +60,12 @@ from .metrics import (
 )
 from .scheduler import PlacementView, Scheduler, WarmPool, drift_warm_share
 from .sim import ReplayReport, generate_trace, replay
+from .speculate import (
+    BankEntry,
+    SpeculationBank,
+    candidate_digest,
+    instance_digest,
+)
 
 __all__ = [
     "DeviceJoin",
@@ -97,4 +104,9 @@ __all__ = [
     "InjectedSolverFault",
     "ChaosReport",
     "chaos_replay",
+    "ChurnForecaster",
+    "SpeculationBank",
+    "BankEntry",
+    "instance_digest",
+    "candidate_digest",
 ]

@@ -378,6 +378,58 @@ class ChaosReport(NamedTuple):
                 f"quarantine accounting: {injected_q} poisoned/malformed "
                 f"events injected but only {got_q} quarantined"
             )
+        # Speculation accounting (active only when the scheduler ran with
+        # --speculate): every bank probe was counted exactly once (hit or
+        # miss) on a non-quarantined handle, every mode='spec' serve in
+        # the records maps to a counted hit, and no hit exists without a
+        # banked entry to have come from (a presolved instance or a real
+        # solved tick). A drifting reconciliation here means served
+        # placements and counters disagree about what speculation did.
+        spec_hits = counters.get("spec_hit", 0)
+        spec_probes = spec_hits + counters.get("spec_miss", 0)
+        if spec_probes or counters.get("spec_presolve", 0):
+            non_q = sum(1 for r in self.records if not r.quarantined)
+            if spec_probes > non_q:
+                out.append(
+                    f"speculation accounting: {spec_probes} bank probes "
+                    f"counted but only {non_q} non-quarantined events "
+                    "were handled"
+                )
+            spec_served = sum(
+                1
+                for r in self.records
+                # Re-serves of an older spec-published view must not
+                # count: a quarantined event re-serves latest() with the
+                # mode it was published under, and a FAILED solve does
+                # the same with the fleet seq already advanced — only a
+                # fresh serve (events_behind == 0, event accepted) is a
+                # hit the counter should match.
+                if not r.quarantined
+                and getattr(r.view, "mode", None) == "spec"
+                and getattr(r.view, "events_behind", 1) == 0
+            )
+            if counters.get("risk_eval", 0) == 0 and spec_served != spec_hits:
+                out.append(
+                    f"speculation accounting: {spec_served} mode='spec' "
+                    f"serves in the records but spec_hit={spec_hits}"
+                )
+            solved = sum(
+                counters.get(f"tick_{m}", 0)
+                for m in ("cold", "warm", "margin")
+            )
+            # NOT `hits <= presolves + solved`: one banked entry serves
+            # arbitrarily many hits (an oscillating trace re-hits the same
+            # entry every cycle — the probe never consumes it). The sound
+            # invariant is existential: a hit needs the bank to have been
+            # populated by SOMETHING, a presolve or a banked solved tick.
+            if spec_hits and not (
+                counters.get("spec_presolve", 0) or solved
+            ):
+                out.append(
+                    f"speculation accounting: spec_hit={spec_hits} but "
+                    "nothing was ever banked (no presolves, no solved "
+                    "ticks)"
+                )
         if self.ticks_to_healthy is None:
             out.append(
                 f"service did not return to healthy (final state: "

@@ -123,6 +123,12 @@ METRIC_REGISTRY = {
     "risk_switch": "Ticks that served a candidate over the fresh solve",
     "risk_error": "Risk scorings that failed (fresh solve served instead)",
     "risk_per_k_failed": "Per-k candidate enumerations that failed",
+    # -- speculative replanning (sched.forecast + sched.speculate) --------
+    "spec_hit": "Ticks served from the speculation bank (pre-solved placement)",
+    "spec_miss": "Bank probes that found no matching pre-solved placement",
+    "spec_stale": "Bank entries invalidated by a problem-identity change",
+    "spec_presolve": "Forecast instances pre-solved into the speculation bank",
+    "spec_presolve_failed": "Speculative presolve dispatches that failed",
     # -- snapshot / restore ----------------------------------------------
     "state_restored": "Scheduler warm-state restores (load_state)",
     "warm_resumes": "First post-restore ticks that rode warm (the proof)",
@@ -152,6 +158,8 @@ METRIC_REGISTRY = {
     "ipm_iters_executed": "LP iterations the tick's solve actually executed",
     "twin_p95": "Twin p95 latency of the served placement, ms",
     "gateway_event_to_placement": "Gateway ingest to placement (queue wait included), ms",
+    "spec_hit_ms": "Speculative-hit serve latency (bank probe to publish), ms",
+    "spec_presolve_ms": "Speculative presolve batch latency (off the serving path), ms",
 }
 
 # Longest-prefix fallback for dynamically composed names. Every f-string

@@ -31,11 +31,20 @@ from ..solver.result import HALDAResult
 from ..solver.streaming import StreamingReplanner
 from .events import validate_event
 from .fleet import FleetState
+from .forecast import ChurnForecaster
 from .metrics import (
     HEALTH_BROKEN,
     HEALTH_DEGRADED,
     HEALTH_HEALTHY,
     SchedulerMetrics,
+)
+from .speculate import (
+    DEFAULT_SPEC_K,
+    DEFAULT_SPEC_TOLERANCE,
+    BankEntry,
+    SpeculationBank,
+    candidate_digest,
+    presolve_candidates,
 )
 
 # Solver-timings keys worth attaching to the solve span: the wall-clock
@@ -127,12 +136,15 @@ class PlacementView(NamedTuple):
     fleet_seq: int  # fleet seq at read time
     events_behind: int  # fleet_seq - seq (0 = fresh)
     age_s: float  # wall-clock seconds since publication
-    # 'cold' | 'warm' | 'margin' tick that produced it; 'risk' when the
-    # risk-aware selector served a candidate OTHER than that tick's fresh
-    # solve (a cached incumbent or per-k alternative). Under degraded
-    # serving the field is REWRITTEN on the published view: 'stale' when a
-    # deadline miss (or poisoned fleet state) re-served the last-known-good
-    # placement, 'degraded' while the open circuit breaker skips solves.
+    # 'cold' | 'warm' | 'margin' tick that produced it; 'spec' when the
+    # speculation bank served a PRE-solved placement (certified on a
+    # forecast instance within the bank's tolerance of this one — no solve
+    # ran this tick); 'risk' when the risk-aware selector served a
+    # candidate OTHER than that tick's fresh solve (a cached incumbent or
+    # per-k alternative). Under degraded serving the field is REWRITTEN on
+    # the published view: 'stale' when a deadline miss (or poisoned fleet
+    # state) re-served the last-known-good placement, 'degraded' while the
+    # open circuit breaker skips solves.
     mode: str
     # Problem identity at publication time. For mode == 'risk' the served
     # placement may have been SOLVED under an earlier identity/tick — the
@@ -193,6 +205,14 @@ class WarmPool:
         reads cached incumbents without touching recency or hit counters."""
         return list(self._pool.items())
 
+    def peek(self, key: tuple) -> Optional[StreamingReplanner]:
+        """The key's live replanner, or None — no counters, no minting, no
+        recency bump. The speculative hit path donates warm state through
+        this: serving from the bank must not skew pool accounting, and it
+        must never mint (or LRU-evict) a planner for a tick that solves
+        nothing."""
+        return self._pool.get(key)
+
     def adopt(self, key: tuple, planner: StreamingReplanner) -> None:
         """Install a restored replanner under its key (snapshot restore).
 
@@ -248,6 +268,10 @@ class Scheduler:
         breaker_cooldown: int = 3,
         healthy_after: int = 3,
         fault_hook: Optional[Callable[[int], None]] = None,
+        speculative: bool = False,
+        spec_k: int = DEFAULT_SPEC_K,
+        spec_tolerance: float = DEFAULT_SPEC_TOLERANCE,
+        spec_bank_size: Optional[int] = None,
         tracer=None,
         flight=None,
         flight_key: str = "default",
@@ -317,6 +341,35 @@ class Scheduler:
         # every solve attempt; raising injects a solve failure, sleeping
         # injects a latency spike. None in production.
         self.fault_hook = fault_hook
+        # -- speculative replanning (sched.forecast + sched.speculate),
+        # default OFF = byte-identical serving: no forecaster, no bank, no
+        # probe, no presolve — every site below is behind `if speculative`.
+        # When on: applied events feed the forecaster, each solved tick
+        # pre-solves the K most likely futures as one vmapped scenario
+        # batch, and the next event's bank probe runs BEFORE the solve
+        # ladder — a hit serves the pre-solved placement (mode='spec') at
+        # cache-hit latency, an honest miss falls through unchanged.
+        self.speculative = speculative
+        self.spec_k = spec_k
+        self.spec_tolerance = spec_tolerance
+        self.forecaster = ChurnForecaster() if speculative else None
+        self.spec_bank = (
+            SpeculationBank(
+                capacity=(
+                    spec_bank_size
+                    if spec_bank_size is not None
+                    else max(4, 4 * spec_k)
+                ),
+                tolerance=spec_tolerance,
+            )
+            if speculative
+            else None
+        )
+        # Event->published-placement latency of the most recent tick, ms
+        # (presolve excluded — it runs after publish, off the serving
+        # path). The bench's speculation arms read this instead of timing
+        # handle(), which would bill background presolve work to serving.
+        self.last_serve_ms: float = 0.0
         self.health = HEALTH_HEALTHY
         self.quarantined: "deque[tuple]" = deque(maxlen=100)
         self._consec_failures = 0
@@ -426,6 +479,20 @@ class Scheduler:
         self.metrics.inc("events_total")
         self.metrics.inc(f"event_{event.kind}")
         self.metrics.inc("structural_events" if structural else "drift_events")
+        if self.speculative:
+            if structural:
+                # Identity changed: drop stale bank entries HERE, on the
+                # event path — the probe may be suppressed (unhealthy,
+                # half-open, post-restore) exactly when a structural
+                # event lands, and stale entries must not squat the LRU.
+                stale = self.spec_bank.invalidate(self.fleet.key())
+                if stale:
+                    self.metrics.inc("spec_stale", stale)
+                    self._span.add_event("spec_stale", dropped=stale)
+            # APPLIED events only: the quarantine gates above already
+            # returned for poisoned/contradictory input, so a NaN drift
+            # can never corrupt the forecaster's EWMA state silently.
+            self.forecaster.observe(self.fleet)
         return self._tick(structural=structural)
 
     def _quarantine(self, event, reason: str) -> PlacementView:
@@ -476,6 +543,23 @@ class Scheduler:
             self.metrics.inc("breaker_half_open_probe")
             self._span.add_event("breaker_half_open_probe")
         key = self.fleet.key()
+        # Speculation bank probe, BEFORE the solve ladder (and before
+        # pool.get — a hit must not skew pool hit-rate counters any more
+        # than a quarantined tick does). Suppressed on the half-open
+        # breaker probe and while unhealthy (a degraded service must
+        # actually solve to prove recovery — a bank that kept hitting
+        # would stall the clean streak forever), and on the first
+        # post-restore tick (that tick IS the warm-resume proof).
+        if (
+            self.speculative
+            and structural is not None
+            and not probing
+            and not self._restore_pending
+            and self.health == HEALTH_HEALTHY
+        ):
+            view = self._spec_probe(key, structural)
+            if view is not None:
+                return view
         planner, _hit = self.pool.get(key)
         devs = self.fleet.device_list()
         t0 = time.perf_counter()
@@ -564,6 +648,25 @@ class Scheduler:
             )
         if structural and not result.certified:
             self.metrics.inc("structural_uncertified")
+        view = self._publish(result, mode, key, planner, devs, ms)
+        if self.speculative and self.health == HEALTH_HEALTHY:
+            # AFTER publish: presolving likely futures is background work
+            # and must never sit between an event and its placement. Same
+            # health gate as the probe: while the service recovers, the
+            # bank cannot be served from, so presolving would only delay
+            # the recovery ticks it rides behind.
+            self._spec_presolve(key, planner, result)
+        return view
+
+    def _publish(
+        self, result: HALDAResult, mode: str, key, planner, devs, ms: float
+    ) -> PlacementView:
+        """Publish a tick's served placement — the ONE publication path
+        (solved ticks and speculative hits both land here, so risk
+        scoring, the publish span and the serve clock cannot diverge).
+        ``planner`` may be None (a spec hit whose pooled planner was
+        LRU-evicted): risk scoring then prices without load factors.
+        """
         with self.tracer.span("sched.publish") as pspan:
             served, twin_p95, switched = result, None, False
             if self.risk_aware:
@@ -587,7 +690,155 @@ class Scheduler:
             pspan.set_attr("mode", self._published.mode)
             pspan.set_attr("certified", served.certified)
         self._published_at = time.monotonic()
+        self.last_serve_ms = ms
         return self._published
+
+    # -- speculative replanning (sched.forecast + sched.speculate) ---------
+
+    def _spec_probe(self, key, structural) -> Optional[PlacementView]:
+        """Serve a pre-solved placement if the post-event fleet digests to
+        a banked entry; None = honest miss, fall through to the ladder.
+
+        A hit donates the scenario solve (incumbent, duals, LP iterates)
+        as the pooled replanner's warm seed — the next REAL tick starts
+        from the future that actually happened. A miss touches nothing:
+        speculative work never writes warm state it did not serve.
+        """
+        t0 = time.perf_counter()
+        digest = self.spec_bank.digest(self.fleet)
+        entry = self.spec_bank.probe(digest, key)
+        if entry is None or not entry.result.certified:
+            # Only certified placements are banked; the certificate guard
+            # is belt-and-braces against a blob restored from elsewhere.
+            self.metrics.inc("spec_miss")
+            return None
+        self.metrics.inc("spec_hit")
+        self._span.add_event(
+            "spec_hit", digest=digest, weight=round(entry.weight, 4)
+        )
+        devs = self.fleet.device_list()
+        # Warm donation: seed the next tick from the served scenario's
+        # iterates (shape recomputed the way StreamingReplanner.step
+        # would, so the seed engages instead of being shape-rejected).
+        # peek, not get: serving from the bank must neither skew the pool
+        # hit-rate counters nor mint/evict planners — a key whose planner
+        # was LRU-evicted simply forgoes the donation.
+        planner = self.pool.peek(key)
+        if planner is not None:
+            from ..solver.moe import model_has_moe_components
+
+            use_moe = (
+                model_has_moe_components(self.fleet.model)
+                if planner.moe is None
+                else bool(planner.moe)
+            )
+            planner.last = entry.result
+            planner._last_shape = (len(devs), self.fleet.model.L, use_moe)
+        ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.observe("event_to_placement", ms)
+        self.metrics.observe("spec_hit_ms", ms)
+        self.metrics.observe(
+            "structural_tick" if structural else "drift_tick", ms
+        )
+        self.metrics.inc(
+            f"{'structural' if structural else 'drift'}_tick_spec"
+        )
+        return self._publish(entry.result, "spec", key, planner, devs, ms)
+
+    def _spec_presolve(self, key, planner, result: HALDAResult) -> None:
+        """Refill the bank after a solved tick: bank the fresh solve under
+        its own digest (oscillating churn returns to it), then pre-solve
+        the forecaster's K candidate futures in ONE vmapped scenario
+        dispatch, warm-seeded from the incumbent.
+
+        Best-effort by design: any failure (out-of-class drift splitting
+        the static half, an infeasible future, a CPU-only build) costs
+        only this tick's speculation, never the serving path — and reads
+        the replanner's warm state without ever writing it.
+        """
+        bank = self.spec_bank
+        # Certified placements only, incumbents included: a banked entry
+        # is served verbatim later, with no ladder to escalate it — an
+        # uncertified one would silently bypass --fail-uncertified.
+        if result.certified:
+            bank.put(
+                bank.digest(self.fleet),
+                BankEntry(
+                    result=result, key=key, weight=1.0,
+                    solved_seq=self.fleet.seq,
+                ),
+            )
+        if self.backend != "jax":
+            return  # scenario batching is a JAX-backend path
+        candidates = self.forecaster.forecast(self.fleet, self.spec_k)
+        fresh = []
+        for devs_c, w in candidates:
+            d = candidate_digest(
+                devs_c, self.fleet.model, key, bank.tolerance
+            )
+            if d not in bank:
+                fresh.append((d, devs_c, w))
+        if not fresh:
+            return
+        t0 = time.perf_counter()
+        with self.tracer.span(
+            "sched.speculate", attrs={"batch": len(fresh)}
+        ) as span:
+            try:
+                results = presolve_candidates(
+                    [(devs_c, w) for _, devs_c, w in fresh],
+                    self.fleet.model,
+                    k_candidates=self.k_candidates,
+                    mip_gap=self.mip_gap,
+                    kv_bits=self.kv_bits,
+                    moe=self.moe,
+                    warm=result,
+                    load_factors=getattr(planner, "_load_factors", None),
+                    lp_backend=self.lp_backend,
+                    pdhg_iters=self.pdhg_iters,
+                    pdhg_restart_tol=self.pdhg_restart_tol,
+                )
+            except (RuntimeError, ValueError, NotImplementedError) as e:
+                self.metrics.inc("spec_presolve_failed")
+                span.add_event(
+                    "presolve_failed", error=f"{type(e).__name__}: {e}"
+                )
+                return
+            banked = 0
+            for (d, _devs_c, w), res in zip(fresh, results):
+                if not res.certified:
+                    continue  # never bank what --fail-uncertified rejects
+                banked += 1
+                bank.put(
+                    d,
+                    BankEntry(
+                        result=res, key=key, weight=w,
+                        solved_seq=self.fleet.seq,
+                    ),
+                )
+            if banked:
+                self.metrics.inc("spec_presolve", banked)
+            span.set_attr("banked", banked)
+        self.metrics.observe(
+            "spec_presolve_ms", (time.perf_counter() - t0) * 1e3
+        )
+
+    def speculation_snapshot(self) -> dict:
+        """Plain-dict speculation view (serve summary / tests)."""
+        c = self.metrics.counters
+        hits = c.get("spec_hit", 0)
+        misses = c.get("spec_miss", 0)
+        probes = hits + misses
+        return {
+            "enabled": self.speculative,
+            "hits": hits,
+            "misses": misses,
+            "presolved": c.get("spec_presolve", 0),
+            "presolve_failed": c.get("spec_presolve_failed", 0),
+            "stale": c.get("spec_stale", 0),
+            "bank_size": len(self.spec_bank) if self.speculative else 0,
+            "hit_rate": round(hits / probes, 4) if probes else 0.0,
+        }
 
     # -- fault-hardened solve path ----------------------------------------
 
@@ -811,6 +1062,14 @@ class Scheduler:
             "span_id": ctx.span_id if ctx is not None else None,
             "counters_delta": delta,
         }
+        if self.speculative:
+            # The post-mortem question speculation adds: was THIS tick a
+            # hit or a miss, and how full was the bank when it happened?
+            rec["spec"] = {
+                "hit": delta.get("spec_hit", 0) > 0,
+                "miss": delta.get("spec_miss", 0) > 0,
+                "bank": len(self.spec_bank),
+            }
         self._flight.record(self._flight_key, rec)
         if self._flight_pending is not None:
             reason, self._flight_pending = self._flight_pending, None
@@ -1033,8 +1292,21 @@ class Scheduler:
                 "twin_p95_s": v.twin_p95_s,
                 "risk_selected": v.risk_selected,
             }
+        state_spec = None
+        if self.speculative:
+            # Speculation state rides the snapshot (additive, versioned by
+            # the blob's top-level version): forecaster EWMA/trend plus the
+            # bank's entries with their LP iterates bit-exact, so a
+            # restored shard's first matching event still hits. Old blobs
+            # without the block restore clean — an empty bank refills from
+            # the first post-restore solved tick.
+            state_spec = {
+                "forecaster": self.forecaster.dump_state(),
+                "bank": self.spec_bank.dump_state(),
+            }
         return {
             "version": 1,
+            "spec": state_spec,
             "devices": [d.model_dump() for d in self.fleet.device_list()],
             "model": self.fleet.model.model_dump(),
             "seq": self.fleet.seq,
@@ -1098,6 +1370,10 @@ class Scheduler:
                 risk_selected=bool(pub.get("risk_selected", False)),
             )
             self._published_at = time.monotonic()
+        if self.speculative:
+            spec = state.get("spec") or {}
+            self.forecaster.load_state(spec.get("forecaster"))
+            self.spec_bank.load_state(spec.get("bank"))
         self._risk_per_k = []
         self._risk_per_k_key = None
         self._restore_pending = True
@@ -1107,18 +1383,27 @@ class Scheduler:
 
 
 def drift_warm_share(metrics: SchedulerMetrics) -> float:
-    """Fraction of drift events served by warm or margin ticks.
+    """Fraction of drift events served by warm, margin or speculative ticks.
 
     The streaming north star's health gauge: pure coefficient drift should
     essentially never pay a cold solve (the acceptance bar is >= 0.6; in
     practice it is ~1.0 — cold drift ticks mean the pool is thrashing).
-    Failed drift ticks count against the share; a tick the escalation
-    ladder restarted cold still counts by its ENTRY mode, since the entry
-    mode is what the event routing chose.
+    A speculative bank hit counts as fast — it is the fastest serve there
+    is — otherwise enabling --speculate would collapse the gauge exactly
+    when the feature works. Failed drift ticks count against the share; a
+    tick the escalation ladder restarted cold still counts by its ENTRY
+    mode, since the entry mode is what the event routing chose.
     """
     c = metrics.counters
     drift = c["drift_events"]
     if not drift:
         return 1.0
-    fast = c["drift_tick_warm"] + c["drift_tick_margin"]
+    # .get, not []: the counters dict is a defaultdict, and a bracket read
+    # here would MINT a speculation counter into the default (spec-off)
+    # path's summary output — breaking the byte-identical contract.
+    fast = (
+        c["drift_tick_warm"]
+        + c["drift_tick_margin"]
+        + c.get("drift_tick_spec", 0)
+    )
     return fast / drift

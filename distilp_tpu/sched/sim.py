@@ -14,7 +14,17 @@ real fleet sees:
   models, t_comm surges otherwise) that relax back — a surge is undone by
   the next burst event (the inverse jitter), so long replays measure
   spike-and-recover, not compounding degradation;
-- ``mixed``  — all of the above plus occasional permanent joins/leaves.
+- ``mixed``  — all of the above plus occasional permanent joins/leaves;
+- ``spec_burst`` — correlated multi-device drift spikes: one fixed cohort
+  of devices spikes t_comm by per-device factors (drawn once per trace)
+  and the next burst event relaxes the spike EXACTLY, over a tiny-drift
+  background — the fleet alternates between two nearby states, which is
+  the churn shape the speculative replanner (``sched.speculate``) banks;
+- ``spec_flap`` — oscillating up/down drift on a channel subset: a fixed
+  subset's t_comm multiplies by f, then 1/f, alternating per oscillation
+  event (no membership churn, unlike ``flap``) — the bundled
+  ``tests/traces/spec_burst.jsonl`` / ``spec_flap.jsonl`` are seeded
+  captures of these two (ROADMAP item 3's burst/flap traces).
 
 ``replay`` drives a scheduler through a trace and reports event→placement
 latency (p50/p99) and sustained events/sec — the numbers ``bench.py``
@@ -32,7 +42,9 @@ from ..common import DeviceProfile
 from ..utils import make_synthetic_fleet
 from .events import DeviceDegrade, DeviceJoin, DeviceLeave, LoadTick, is_structural
 
-SCENARIOS = ("drift", "decay", "flap", "burst", "mixed")
+SCENARIOS = (
+    "drift", "decay", "flap", "burst", "mixed", "spec_burst", "spec_flap"
+)
 
 
 def _joinable_device(idx: int, seed: int) -> DeviceProfile:
@@ -65,6 +77,8 @@ def generate_trace(
         raise ValueError(f"unknown scenario {scenario!r}; pick from {SCENARIOS}")
     rng = np.random.default_rng(seed)
     names = [d.name for d in base_fleet]
+    if scenario in ("spec_burst", "spec_flap"):
+        return _spec_trace(scenario, n_events, rng, names)
     profiles = {d.name: d.model_copy(deep=True) for d in base_fleet}
     head = names[0]
     live = list(names)  # membership tracking; order irrelevant here
@@ -178,6 +192,59 @@ def generate_trace(
         if ev is None:
             ev = drift_event()
         events.append(ev)
+    return events
+
+
+def _spec_trace(scenario: str, n_events: int, rng, names: List[str]) -> List:
+    """Speculation-friendly drift traces: predictable, t_comm-only churn.
+
+    Both scenarios keep membership fixed and drift ONLY t_comm (the
+    channel the forecaster models), so the fleet walks between a small
+    number of tolerance-bucket states:
+
+    - ``spec_burst``: a large cohort spikes together by per-device
+      factors drawn ONCE for the whole trace, and the next burst event
+      is the exact inverse — spike-and-recover between two states;
+    - ``spec_flap``: a smaller subset oscillates up/down per event at a
+      higher rate (the flapping-load shape, without ``flap``'s leaves).
+
+    The non-cohort background drifts by ±0.1% per event — real noise, but
+    small against the default 5% speculation tolerance, so background
+    ticks rarely change the instance digest (occasional bucket-boundary
+    crossings stay in as honest misses).
+    """
+    head = names[0]
+    others = [n for n in names[1:]] or [head]
+    if scenario == "spec_flap":
+        subset = others[: max(1, (len(others) + 1) // 2)]
+        factors = {n: float(rng.uniform(1.25, 1.5)) for n in subset}
+        p_osc = 0.7
+    else:  # spec_burst
+        subset = others[: max(1, (2 * len(others) + 2) // 3)]
+        factors = {n: float(rng.uniform(1.3, 1.7)) for n in subset}
+        p_osc = 0.5
+    background = [n for n in others if n not in subset] or [head]
+    events: List = []
+    t = 0.0
+    up = False  # whether the subset currently sits at its spiked state
+    for _ in range(n_events):
+        t += float(rng.exponential(1.0))
+        if rng.random() < p_osc:
+            jitter = (
+                {n: 1.0 / f for n, f in factors.items()}
+                if up
+                else dict(factors)
+            )
+            up = not up
+            events.append(LoadTick(t=t, t_comm_jitter=jitter))
+        else:
+            events.append(
+                DeviceDegrade(
+                    name=str(rng.choice(background)),
+                    t=t,
+                    t_comm_scale=float(rng.uniform(0.999, 1.001)),
+                )
+            )
     return events
 
 

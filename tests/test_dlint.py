@@ -1042,6 +1042,25 @@ def test_dlp019_scoped_to_serving_layers():
     assert out == []
 
 
+def test_spec_counters_registered_for_dlp019():
+    # The speculative-replanning counters are registry entries: literal
+    # inc() sites across sched//gateway//obs pass, and a near-miss name
+    # (e.g. a typo'd spec counter) still fails the gate.
+    ok = findings_for("DLP019", "distilp_tpu/sched/speculate2.py", """\
+        def probe(self, hit):
+            self.metrics.inc("spec_hit" if hit else "spec_miss")
+            self.metrics.inc("spec_presolve", 3)
+            self.metrics.inc("spec_stale", 2)
+            self.metrics.inc("spec_presolve_failed")
+        """)
+    assert ok == []
+    bad = findings_for("DLP019", "distilp_tpu/sched/speculate2.py", """\
+        def probe(self):
+            self.metrics.inc("spec_hits")
+        """)
+    assert len(bad) == 1 and "spec_hits" in bad[0].message
+
+
 def test_dlp019_obs_layer_in_scope():
     out = findings_for("DLP019", "distilp_tpu/obs/flight2.py", """\
         def dump(self):
