@@ -18,7 +18,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag
 	python -m pytest tests/ -q
 
 .PHONY: bench
@@ -172,6 +172,36 @@ smoke-gateway: lint-strict
 		--deadline-ms 60000 --max-retries 2 --breaker-threshold 2 \
 		--chaos-check --quiet --workers 2; \
 	rc=$$?; rm -rf $$D; exit $$rc
+
+# Convergence-diagnostics smoke: the 16-device north star solved with
+# solver-interior telemetry on (`solver diagnose`), per LP engine. The gate
+# asserts the report is NON-EMPTY with a certified final gap at mip_gap and
+# that the accounting is exact: the per-round LP iteration counts sum to
+# the ipm_iters_executed header counter, and the per-round gap trajectory
+# is monotone non-increasing (incumbent only improves, bound only rises).
+# Chained into `make test` so the diagnose path can't silently rot.
+.PHONY: smoke-diag
+smoke-diag: lint-strict
+	@T=$$(mktemp) && rc=0; \
+	for eng in ipm pdhg; do \
+		JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli diagnose \
+			--profile tests/profiles/llama_3_70b/online \
+			--synthetic-fleet 16 --fleet-seed 123 --mip-gap 1e-3 \
+			--lp-backend $$eng --json > $$T && \
+		JAX_PLATFORMS=cpu python -c "import json, sys; \
+			d = json.load(open('$$T')); eng = '$$eng'; \
+			assert d['rounds'], 'empty diagnose report'; \
+			assert d['lp_backend'] == eng, d['lp_backend']; \
+			assert d['certified'], 'north star not certified under ' + eng; \
+			assert d['final_gap'] is not None and d['final_gap'] <= 1e-3 + 1e-12; \
+			gaps = [r['gap'] for r in d['rounds'] if r['gap'] is not None]; \
+			assert all(a >= b - 1e-12 for a, b in zip(gaps, gaps[1:])), gaps; \
+			total = sum(r['lp_iters'] for r in d['rounds']); \
+			assert total == d['lp_iters_executed'], (total, d['lp_iters_executed']); \
+			print('smoke-diag OK [%s]: %d rounds, %d LP iters, %d restarts, gap %.2e' \
+				% (eng, len(d['rounds']), total, d['restarts'], d['final_gap']))" \
+		|| { rc=1; break; }; \
+	done; rm -f $$T; exit $$rc
 
 .PHONY: smoke-sched
 smoke-sched: lint-strict

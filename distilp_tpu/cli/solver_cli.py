@@ -389,6 +389,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "concurrent gateway workers would race the process-global "
         "profiler)",
     )
+    p.add_argument(
+        "--solver-diagnostics",
+        action="store_true",
+        help="solver-interior telemetry per tick (jax backend): every "
+        "solve records its branch-and-bound round log + root LP "
+        "convergence trace in-jit, and the conv_* digest (rounds, LP "
+        "iterations, restarts, final gap/residuals) rides the sched.solve "
+        "span and the flight recorder's tick records; see `solver "
+        "diagnose` for the one-shot report",
+    )
     return p
 
 
@@ -729,6 +739,7 @@ def serve_main(argv=None) -> int:
     sched = Scheduler(
         devices,
         model,
+        diagnostics=args.solver_diagnostics,
         mip_gap=args.mip_gap,
         kv_bits=args.kv_bits,
         backend=args.backend,
@@ -941,6 +952,7 @@ def _serve_gateway(args) -> int:
         mip_gap=args.mip_gap,
         kv_bits=args.kv_bits,
         backend=args.backend,
+        diagnostics=args.solver_diagnostics,
         k_candidates=k_candidates,
         warm_pool_size=args.warm_pool,
         cold_start=args.cold_start,
@@ -1317,6 +1329,179 @@ def spans_main(argv=None) -> int:
     return 0
 
 
+def build_diagnose_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solver diagnose",
+        description="solver-interior convergence report: run one HALDA "
+        "solve with in-jit telemetry on (per-branch-and-bound-round "
+        "search log + the root LP relaxations' per-chunk residual/"
+        "restart traces), render the per-round tables, and optionally "
+        "export the report as JSONL (reload with --load). The solve "
+        "itself is the normal certified solve — tracing rides the same "
+        "device program and only appends to its output",
+    )
+    p.add_argument(
+        "--profile", "-p", default=None,
+        help="folder containing model_profile.json and per-device JSONs "
+        "(required unless --load)",
+    )
+    p.add_argument(
+        "--synthetic-fleet", type=int, default=0, metavar="M",
+        help="solve M synthetic devices instead of the folder's device "
+        "JSONs (the 16-device north star: --synthetic-fleet 16 "
+        "--fleet-seed 123)",
+    )
+    p.add_argument("--fleet-seed", type=int, default=0)
+    p.add_argument("--mip-gap", type=float, default=1e-3)
+    p.add_argument("--kv-bits", default="4bit")
+    p.add_argument(
+        "--k-candidates", default=None,
+        help="comma-separated k values (default: all proper factors of L)",
+    )
+    p.add_argument(
+        "--moe", choices=["auto", "on", "off"], default="auto",
+        help="expert+layer co-assignment mode (see `solver --moe`)",
+    )
+    p.add_argument(
+        "--lp-backend", choices=["ipm", "pdhg", "auto"], default="auto",
+        help="LP relaxation engine to diagnose (the report's LP traces "
+        "carry the engine's own gauges: Mehrotra complementarity for "
+        "ipm, normalized duality gap + Halpern restart cadence for pdhg)",
+    )
+    p.add_argument("--pdhg-iters", type=int, default=None)
+    p.add_argument("--pdhg-restart-tol", type=float, default=None)
+    p.add_argument("--max-rounds", type=int, default=None)
+    p.add_argument("--beam", type=int, default=None)
+    p.add_argument("--ipm-iters", type=int, default=None)
+    p.add_argument("--ipm-warm-iters", type=int, default=None)
+    p.add_argument("--node-cap", type=int, default=None)
+    p.add_argument(
+        "--out", default=None, metavar="FILE",
+        help="also export the report as JSONL (one 'search' header line, "
+        "one 'round' line per round, one 'lp' line per root trace)",
+    )
+    p.add_argument(
+        "--load", default=None, metavar="FILE",
+        help="render a previously exported JSONL report instead of "
+        "solving (no backend needed)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the full report as one JSON object (SearchTrace "
+        "fields + digest + solver timings) instead of tables",
+    )
+    return p
+
+
+def diagnose_main(argv=None) -> int:
+    """``solver diagnose``: one traced solve -> convergence report."""
+    args = build_diagnose_parser().parse_args(argv)
+
+    from ..obs.convergence import (
+        build_search_trace,
+        search_trace_from_jsonl,
+        search_trace_to_jsonl,
+    )
+
+    tm: dict = {}
+    if args.load:
+        try:
+            trace = search_trace_from_jsonl(Path(args.load).read_text())
+        except (OSError, ValueError, TypeError) as e:
+            print(f"error: cannot load {args.load}: {e}", file=sys.stderr)
+            return 2
+    else:
+        if not args.profile:
+            print(
+                "error: --profile is required unless --load", file=sys.stderr
+            )
+            return 2
+
+        from ..axon_guard import force_cpu_if_env_requested
+
+        force_cpu_if_env_requested()
+
+        from ..common import load_from_profile_folder, load_model_profile
+        from ..solver import halda_solve
+        from ..utils import make_synthetic_fleet
+
+        folder = Path(args.profile)
+        if not folder.is_dir():
+            print(f"error: {folder} is not a directory", file=sys.stderr)
+            return 2
+        if args.synthetic_fleet > 0:
+            model = load_model_profile(folder / "model_profile.json")
+            devices = make_synthetic_fleet(
+                args.synthetic_fleet, seed=args.fleet_seed
+            )
+        else:
+            devices, model = load_from_profile_folder(folder)
+
+        k_candidates = None
+        if args.k_candidates:
+            k_candidates = [
+                int(x) for x in args.k_candidates.split(",") if x.strip()
+            ]
+
+        conv: dict = {}
+        try:
+            halda_solve(
+                devices,
+                model,
+                k_candidates=k_candidates,
+                mip_gap=args.mip_gap,
+                kv_bits=args.kv_bits,
+                backend="jax",
+                moe={"auto": None, "on": True, "off": False}[args.moe],
+                max_rounds=args.max_rounds,
+                beam=args.beam,
+                ipm_iters=args.ipm_iters,
+                ipm_warm_iters=args.ipm_warm_iters,
+                node_cap=args.node_cap,
+                lp_backend=args.lp_backend,
+                pdhg_iters=args.pdhg_iters,
+                pdhg_restart_tol=args.pdhg_restart_tol,
+                timings=tm,
+                convergence=conv,
+            )
+        except (ValueError, RuntimeError, NotImplementedError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        trace = build_search_trace(conv)
+
+    if not trace.rounds:
+        print(
+            "error: empty convergence report (no branch-and-bound round "
+            "executed — was the sweep structurally infeasible?)",
+            file=sys.stderr,
+        )
+        return 1
+
+    if args.json:
+        payload = trace.model_dump()
+        payload["digest"] = trace.digest()
+        if tm:
+            payload["timings"] = {
+                k: v for k, v in tm.items()
+                if isinstance(v, (int, float, str, bool))
+            }
+        print(json.dumps(payload))
+    else:
+        print(trace.render_text())
+        if tm.get("solve_ms") is not None:
+            print(
+                f"solve: {tm.get('solve_ms', 0.0):.1f} ms on-device "
+                f"(pack {tm.get('pack_ms', 0.0):.1f} ms, upload "
+                f"{tm.get('upload_ms', 0.0):.1f} ms)"
+                + (" [escalated]" if tm.get("escalated") else "")
+            )
+    if args.out:
+        Path(args.out).write_text(search_trace_to_jsonl(trace))
+        if not args.json:
+            print(f"wrote {args.out}")
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -1328,6 +1513,8 @@ def main(argv=None) -> int:
         return evaluate_main(argv[1:])
     if argv and argv[0] == "spans":
         return spans_main(argv[1:])
+    if argv and argv[0] == "diagnose":
+        return diagnose_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     from ..axon_guard import force_cpu_if_env_requested

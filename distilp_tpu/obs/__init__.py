@@ -1,8 +1,9 @@
-"""Observability for the serving stack: tracing, exporters, flight data.
+"""Observability for the serving stack: tracing, exporters, flight data,
+and solver-interior convergence reports.
 
-Three pieces, all opt-in and stdlib-only (the obs layer imports neither
-jax nor the solver — it is plumbing the serving layers thread data
-through):
+Four pieces, all opt-in and backend-free (the obs layer imports neither
+jax nor numpy nor the solver — it is plumbing the serving layers thread
+data through; ``convergence`` adds pydantic, already a core dependency):
 
 - ``trace``  — span-based tracing of the event path (HTTP ingest → shard
   routing → worker queue wait → scheduler tick → solve → publish), a
@@ -14,11 +15,26 @@ through):
   it in tests);
 - ``flight`` — the flight recorder: per-shard rings of the last N tick
   records, auto-dumped to a post-mortem JSONL on breaker-open or a
-  chaos-contract violation, readable live over HTTP.
+  chaos-contract violation, readable live over HTTP;
+- ``convergence`` — typed reports over the solver's in-jit telemetry
+  (per-chunk LP residual traces, the branch-and-bound round log): the
+  ``solver diagnose`` CLI and the bench ``convergence`` section render
+  these, and the digest rides ``timings`` onto the ``sched.solve`` span
+  and flight-recorder tick records.
 
-See README "Observability" for the span model and the label table.
+See README "Observability" / "Convergence diagnostics" for the span model,
+the label table, and the trace-buffer semantics.
 """
 
+from .convergence import (
+    ConvergenceTrace,
+    LPChunkSample,
+    RoundRecord,
+    SearchTrace,
+    build_search_trace,
+    search_trace_from_jsonl,
+    search_trace_to_jsonl,
+)
 from .export import (
     parse_prometheus_text,
     read_spans,
@@ -51,4 +67,11 @@ __all__ = [
     "render_prometheus",
     "parse_prometheus_text",
     "FlightRecorder",
+    "LPChunkSample",
+    "ConvergenceTrace",
+    "RoundRecord",
+    "SearchTrace",
+    "build_search_trace",
+    "search_trace_to_jsonl",
+    "search_trace_from_jsonl",
 ]

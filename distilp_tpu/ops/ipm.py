@@ -54,6 +54,32 @@ import jax.numpy as jnp  # noqa: E402
 
 BOUND_DTYPE = jnp.float64
 
+# -- in-jit convergence trace (decoded by obs/convergence.py) ---------------
+# With ``trace=True`` the solve loop records ONE row per executed ``chunk``
+# boundary into a fixed-size buffer riding the while-loop carry:
+#   [iters, rp_norm, rd_norm, gap, restarts, live]
+# iters is the element's CUMULATIVE executed-iteration count at the
+# boundary, rp/rd are the scaled-system residual inf-norms, gap is the
+# engine's convergence gauge (complementarity mu here; the normalized
+# duality gap in ops/pdhg.py), restarts is the cumulative count of chunks
+# in which the Halpern anchor restarted (the restart cadence; always 0
+# for the IPM — see ops/pdhg.py for why it is chunk-granular), and live
+# flags whether the element was still iterating when the chunk STARTED —
+# a decoded element's valid samples are exactly its live rows. The default path (``trace=False``)
+# carries no buffer and compiles to the identical program (pinned by the
+# bit-equality test in tests/test_convergence.py).
+TRACE_COLS = 6
+IPM_DEFAULT_CHUNK = 4
+
+
+def n_trace_rows(iters: int, chunk: int) -> int:
+    """Rows of the per-chunk trace buffer for an (iters, chunk) budget —
+    the ONE copy of the kernel's chunk-count arithmetic, so the packed
+    output decode in backend_jax can never disagree with the while-loop
+    bound about how many rows were allocated."""
+    chunk = max(1, min(int(chunk), int(iters)))
+    return -(-int(iters) // chunk)
+
 
 class LPBatch(NamedTuple):
     """One fleet instance's LP family: (shared or batched) A, batched b/c/l/u.
@@ -105,6 +131,10 @@ class IPMResult(NamedTuple):
     z_dual: jax.Array  # (B, n)
     f_dual: jax.Array  # (B, n)
     iters_run: jax.Array  # (B,) int32 iterations actually executed
+    # Per-chunk convergence trace, (B, n_trace_rows, TRACE_COLS) when the
+    # solve ran with ``trace=True``; None (a leafless pytree slot — vmap
+    # and jit cost nothing for it) on the default untraced path.
+    trace_buf: Optional[jax.Array] = None
 
 
 def _default_tol(dtype) -> float:
@@ -116,7 +146,7 @@ def _default_reg(dtype) -> float:
 
 
 def _ipm_single(A, b, c, l, u, iters: int, tol, reg, warm=None, skip=None,
-                chunk: int = 4):
+                chunk: int = IPM_DEFAULT_CHUNK, trace: bool = False):
     """Mehrotra predictor-corrector on one boxed LP. Runs under vmap.
 
     ``warm`` (an :class:`IPMWarmState` element) seeds the iteration from a
@@ -293,10 +323,10 @@ def _ipm_single(A, b, c, l, u, iters: int, tol, reg, warm=None, skip=None,
     # loop runs until EVERY element's cond is false): converged batches stop
     # paying factorizations instead of scanning out the full budget.
     chunk = max(1, min(int(chunk), iters))
-    n_chunks = -(-iters // chunk)
+    n_chunks = n_trace_rows(iters, chunk)
 
     def chunk_cond(carry):
-        state, ci = carry
+        state, ci = carry[0], carry[1]
         return (ci < n_chunks) & (state[5] <= 0.5)
 
     def chunk_body(carry):
@@ -307,9 +337,52 @@ def _ipm_single(A, b, c, l, u, iters: int, tol, reg, warm=None, skip=None,
         state, _ = jax.lax.scan(step, state, None, length=chunk)
         return (state, ci + 1)
 
-    (x, w, y, z, f, done, it), _ = jax.lax.while_loop(
-        chunk_cond, chunk_body, (init, jnp.zeros((), jnp.int32))
-    )
+    def chunk_diag(state):
+        """Trace-row diagnostics at a chunk boundary (scaled system, same
+        quantities as the final-residual block below): two matvecs, paid
+        only on the traced path."""
+        x_s, w_s, y_s, z_s, f_s = state[0], state[1], state[2], state[3], state[4]
+        rp_n = jnp.max(jnp.abs(b_hat - A @ (x_s * act)))
+        rd_n = jnp.max(jnp.abs((cm - A.T @ y_s - z_s + f_s) * act))
+        mu_n = (
+            jnp.vdot(x_s * act, z_s) + jnp.vdot(w_s * act, f_s)
+        ) / (2.0 * n_active)
+        return rp_n, rd_n, mu_n
+
+    def chunk_body_traced(carry):
+        state, ci, tbuf = carry
+        live = state[5] <= 0.5
+        # convergence gate: same bound as chunk_body — the enclosing
+        # while_loop's batch-wide done test ends the scan chunks.
+        state, _ = jax.lax.scan(step, state, None, length=chunk)
+        rp_n, rd_n, mu_n = chunk_diag(state)
+        row = jnp.stack(
+            [
+                state[6].astype(dtype),  # cumulative iterations executed
+                rp_n,
+                rd_n,
+                mu_n,
+                jnp.zeros((), dtype),  # restarts: a Mehrotra IPM has none
+                live.astype(dtype),
+            ]
+        )
+        return (state, ci + 1, tbuf.at[ci].set(row))
+
+    if trace:
+        (x, w, y, z, f, done, it), _, tbuf = jax.lax.while_loop(
+            chunk_cond,
+            chunk_body_traced,
+            (
+                init,
+                jnp.zeros((), jnp.int32),
+                jnp.zeros((n_chunks, TRACE_COLS), dtype),
+            ),
+        )
+    else:
+        (x, w, y, z, f, done, it), _ = jax.lax.while_loop(
+            chunk_cond, chunk_body, (init, jnp.zeros((), jnp.int32))
+        )
+        tbuf = None
 
     # Final residuals (iteration dtype, for diagnostics).
     rp = b_hat - A @ (x * act)
@@ -349,10 +422,11 @@ def _ipm_single(A, b, c, l, u, iters: int, tol, reg, warm=None, skip=None,
         z_dual=jnp.where(active, z / col_s, 0.0),
         f_dual=jnp.where(active, f / col_s, 0.0),
         iters_run=it,
+        trace_buf=tbuf,
     )
 
 
-@partial(jax.jit, static_argnames=("iters", "chunk"))
+@partial(jax.jit, static_argnames=("iters", "chunk", "trace"))
 def ipm_solve_batch(
     batch: LPBatch,
     iters: int = 30,
@@ -360,7 +434,8 @@ def ipm_solve_batch(
     reg: Optional[float] = None,
     warm: Optional[IPMWarmState] = None,
     skip: Optional[jax.Array] = None,
-    chunk: int = 4,
+    chunk: int = IPM_DEFAULT_CHUNK,
+    trace: bool = False,
 ) -> IPMResult:
     """Solve a batch of boxed LPs (shared (m, n) or per-instance (B, m, n) A).
 
@@ -374,7 +449,9 @@ def ipm_solve_batch(
     they stop gating the early exit. ``iters`` is the per-element budget,
     spent ``chunk`` iterations at a time with a batch-wide convergence test
     between chunks; ``iters_run`` in the result reports what was actually
-    executed.
+    executed. ``trace`` (static) additionally records one convergence-trace
+    row per executed chunk into ``trace_buf`` (see TRACE_COLS above); off by
+    default, and the untraced program is bit-identical to the pre-trace one.
     """
     dtype = batch.A.dtype
     tol_v = _default_tol(dtype) if tol is None else tol
@@ -382,7 +459,8 @@ def ipm_solve_batch(
 
     def single(A, b, c, l, u, wm, sk):
         return _ipm_single(
-            A, b, c, l, u, iters, tol_v, reg_v, warm=wm, skip=sk, chunk=chunk
+            A, b, c, l, u, iters, tol_v, reg_v, warm=wm, skip=sk, chunk=chunk,
+            trace=trace,
         )
 
     # TPU matmuls default to bf16 multiplication for f32 inputs; an IPM loses

@@ -66,7 +66,20 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
-from .ipm import BOUND_DTYPE, IPMResult, LPBatch  # noqa: E402
+from .ipm import (  # noqa: E402
+    BOUND_DTYPE,
+    TRACE_COLS,
+    IPMResult,
+    LPBatch,
+    n_trace_rows,
+)
+
+# Default convergence-test granularity (iterations per while-loop chunk).
+# Shared with the trace-row accounting: the packed-output decode in
+# backend_jax sizes the root trace from this constant, so it must be THE
+# value the kernel clamps against, not a copy.
+PDHG_DEFAULT_CHUNK = 32
+
 
 def _default_tol_pdhg(dtype) -> float:
     """First-order exit tolerance. The IPM's 1e-9 (f64) is a few Newton
@@ -104,7 +117,8 @@ class PDHGWarmState(NamedTuple):
 
 
 def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
-                 skip=None, chunk: int = 32):
+                 skip=None, chunk: int = PDHG_DEFAULT_CHUNK,
+                 trace: bool = False):
     """Restarted Halpern PDHG on one boxed LP. Runs under vmap.
 
     Mirrors ``_ipm_single``'s contract: ``warm`` seeds from a previous
@@ -204,18 +218,31 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
         qy = jnp.sum(jnp.where(sigma > 0, dy * dy, 0.0) / jnp.maximum(sigma, 1e-30))
         return jnp.sqrt(qx + qy)
 
-    def conv_of(x, y):
+    def conv_stats(x, y):
         """Convergence = primal feasibility + relative duality gap at the
         CURRENT iterate, both in iteration precision. The f64 certificate
-        is evaluated once at exit, like the IPM's."""
+        is evaluated once at exit, like the IPM's. Also returns the
+        trace-row diagnostics (rp/rd norms, normalized gap) — the untraced
+        path consumes only the flag and XLA drops the rest.
+        """
         rp = b_s - opA(x)
         obj = jnp.vdot(cm, x)
         red = cm - opAT(y)
         lag = jnp.vdot(b_s, y) + jnp.vdot(act, jnp.minimum(0.0, red))
         gap = jnp.abs(obj - lag)
-        return (jnp.max(jnp.abs(rp)) < tol * b_scale) & (
+        conv = (jnp.max(jnp.abs(rp)) < tol * b_scale) & (
             gap < tol * (b_scale + c_scale + jnp.abs(obj))
         )
+        rd = red - jnp.minimum(0.0, red) * act
+        return (
+            conv,
+            jnp.max(jnp.abs(rp)),
+            jnp.max(jnp.abs(rd)),
+            gap / (b_scale + c_scale),
+        )
+
+    def conv_of(x, y):
+        return conv_stats(x, y)[0]
 
     def step(state, _):
         x, y, xa, ya, res_a, t, done, it = state
@@ -296,10 +323,10 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
     )
 
     chunk = max(1, min(int(chunk), iters))
-    n_chunks = -(-iters // chunk)
+    n_chunks = n_trace_rows(iters, chunk)
 
     def chunk_cond(carry):
-        state, ci = carry
+        state, ci = carry[0], carry[1]
         return (ci < n_chunks) & (state[6] <= 0.5)
 
     def chunk_body(carry):
@@ -317,9 +344,54 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
         done = jnp.maximum(done, conv_of(x, y).astype(dtype))
         return ((x, y, xa, ya, res_a, t, done, it), ci + 1)
 
-    (x, y, _, _, _, _, done, it), _ = jax.lax.while_loop(
-        chunk_cond, chunk_body, (init, jnp.zeros((), jnp.int32))
-    )
+    def chunk_body_traced(carry):
+        state, ci, tbuf, nre = carry
+        live = state[6] <= 0.5
+        t_prev = state[5]
+        # convergence gate: same bound as chunk_body — the enclosing
+        # while_loop's batch-wide done test ends the scan chunks.
+        state, _ = jax.lax.scan(step, state, None, length=chunk)
+        conv, rp_n, rd_n, gap_n = conv_stats(state[0], state[1])
+        done = jnp.maximum(state[6], conv.astype(dtype))
+        state = state[:6] + (done,) + state[7:]
+        # Restart flag from the Halpern anchor counter ALONE (zero
+        # per-step cost, which is what keeps the traced kernel inside the
+        # bench's 5% ceiling): a live chunk with no restart advances t by
+        # exactly `chunk`, so any shortfall means the anchor reset at
+        # least once this chunk. The trace column is therefore the
+        # cumulative count of restart-CHUNKS — the restart cadence, exact
+        # whenever restarts are rarer than one per chunk (they are, by
+        # orders of magnitude, at the default sufficient-decay factor).
+        restarted = live & (state[5] != t_prev + chunk)
+        nre = nre + restarted.astype(jnp.int32)
+        row = jnp.stack(
+            [
+                state[7].astype(dtype),  # cumulative iterations executed
+                rp_n,
+                rd_n,
+                gap_n,
+                nre.astype(dtype),  # cumulative restart chunks
+                live.astype(dtype),
+            ]
+        )
+        return (state, ci + 1, tbuf.at[ci].set(row), nre)
+
+    if trace:
+        (x, y, _, _, _, _, done, it), _, tbuf, _ = jax.lax.while_loop(
+            chunk_cond,
+            chunk_body_traced,
+            (
+                init,
+                jnp.zeros((), jnp.int32),
+                jnp.zeros((n_chunks, TRACE_COLS), dtype),
+                jnp.zeros((), jnp.int32),
+            ),
+        )
+    else:
+        (x, y, _, _, _, _, done, it), _ = jax.lax.while_loop(
+            chunk_cond, chunk_body, (init, jnp.zeros((), jnp.int32))
+        )
+        tbuf = None
 
     # Final residuals (iteration dtype, diagnostics only; scaled units).
     rp = b_s - opA(x)
@@ -374,10 +446,11 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
         z_dual=z_dual,
         f_dual=f_dual,
         iters_run=it,
+        trace_buf=tbuf,
     )
 
 
-@partial(jax.jit, static_argnames=("iters", "chunk"))
+@partial(jax.jit, static_argnames=("iters", "chunk", "trace"))
 def pdhg_solve_batch(
     batch: LPBatch,
     iters: int = 1000,
@@ -385,7 +458,8 @@ def pdhg_solve_batch(
     restart_tol: Optional[float] = None,
     warm: Optional[PDHGWarmState] = None,
     skip: Optional[jax.Array] = None,
-    chunk: int = 32,
+    chunk: int = PDHG_DEFAULT_CHUNK,
+    trace: bool = False,
 ) -> IPMResult:
     """Solve a batch of boxed LPs matrix-free (shared (m, n) or per-instance
     (B, m, n) A) — the call-compatible first-order sibling of
@@ -398,6 +472,10 @@ def pdhg_solve_batch(
     fields). ``iters`` is the per-element budget, spent ``chunk`` iterations
     at a time with a batch-wide convergence test between chunks;
     ``restart_tol`` is the Halpern restart's sufficient-decay factor.
+    ``trace`` (static) records one convergence-trace row per executed chunk
+    — residual norms, normalized gap, the cumulative Halpern restart-chunk
+    count — into ``trace_buf`` (see ops/ipm.py TRACE_COLS); the untraced
+    program is bit-identical to the pre-trace one.
     """
     dtype = batch.A.dtype
     tol_v = _default_tol_pdhg(dtype) if tol is None else tol
@@ -405,7 +483,8 @@ def pdhg_solve_batch(
 
     def single(A, b, c, l, u, wm, sk):
         return _pdhg_single(
-            A, b, c, l, u, iters, tol_v, rt_v, warm=wm, skip=sk, chunk=chunk
+            A, b, c, l, u, iters, tol_v, rt_v, warm=wm, skip=sk, chunk=chunk,
+            trace=trace,
         )
 
     # Full f32 accumulation for the same reason as the IPM kernel: a bf16

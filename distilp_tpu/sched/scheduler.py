@@ -48,11 +48,18 @@ from .speculate import (
 )
 
 # Solver-timings keys worth attaching to the solve span: the wall-clock
-# breakdown plus the work/engine counters that attribute a slow tick.
+# breakdown plus the work/engine counters that attribute a slow tick, plus
+# the solver-diagnostics digest (Scheduler(diagnostics=True) / `serve
+# --solver-diagnostics`; present in timings only when the tick solved with
+# convergence tracing on). The digest key set is imported from its one
+# source of truth next to SearchTrace.digest() — a field added there
+# reaches the span and the flight records without touching this module.
+from ..obs.convergence import CONV_DIGEST_KEYS as _CONV_DIGEST_KEYS  # noqa: E402
+
 _SOLVE_SPAN_KEYS = (
     "build_ms", "pack_ms", "upload_ms", "solve_ms", "static_hit",
     "lp_backend", "bnb_rounds", "ipm_iters_executed", "escalated",
-)
+) + _CONV_DIGEST_KEYS
 
 
 class _DeadlineMiss(Exception):
@@ -276,6 +283,7 @@ class Scheduler:
         flight=None,
         flight_key: str = "default",
         jax_profile_dir: Optional[str] = None,
+        diagnostics: bool = False,
     ):
         self.fleet = FleetState(list(devices), model)
         self.mip_gap = mip_gap
@@ -294,6 +302,12 @@ class Scheduler:
         self.lp_backend = lp_backend
         self.pdhg_iters = pdhg_iters
         self.pdhg_restart_tol = pdhg_restart_tol
+        # Solver-interior diagnostics (`serve --solver-diagnostics`): every
+        # tick solves with convergence tracing on; the conv_* digest rides
+        # the timings dict onto the sched.solve span and the flight
+        # recorder's tick records. Off (default) = the exact untraced
+        # device program — counters and placements byte-identical.
+        self.diagnostics = diagnostics
         # Risk-aware serving (`serve --risk-aware`): every tick scores the
         # fresh solve AND the warm pool's cached incumbents on the digital
         # twin (Monte-Carlo p95 + feasibility-violation penalty, seeded so
@@ -403,6 +417,12 @@ class Scheduler:
         self._flight_prev_counters: dict = {}
         self._flight_pending: Optional[str] = None
         self._last_lp_backend: Optional[str] = None
+        # Per-tick diagnostics for the flight record: the exception CLASS
+        # behind this tick's solve_attempt_failed / spec_presolve_failed
+        # counters (a bare counter bump is invisible post-mortem), and the
+        # conv_* digest when solver diagnostics ran. Reset per handle().
+        self._tick_exc: dict = {}
+        self._tick_conv: Optional[dict] = None
         self.jax_profile_dir = jax_profile_dir
         self._jax_profiled = False
         if solve_on_init:
@@ -422,6 +442,7 @@ class Scheduler:
             moe=self.moe,
             cold_start=self.cold_start,
             search=search,
+            diagnostics=self.diagnostics,
         )
         planner.metrics = self.metrics  # tick modes funnel into one snapshot
         return planner
@@ -458,6 +479,8 @@ class Scheduler:
         )
         with span:
             self._span = span
+            self._tick_exc = {}
+            self._tick_conv = None
             view: Optional[PlacementView] = None
             try:
                 view = self._handle(event)
@@ -598,6 +621,8 @@ class Scheduler:
             for k in _SOLVE_SPAN_KEYS:
                 if k in tick_tm:
                     solve_span.set_attr(k, tick_tm[k])
+            conv = {k: tick_tm[k] for k in _CONV_DIGEST_KEYS if k in tick_tm}
+            self._tick_conv = conv or None
         finally:
             solve_span.end()
         self._on_clean_solve(probing)
@@ -800,6 +825,10 @@ class Scheduler:
                 )
             except (RuntimeError, ValueError, NotImplementedError) as e:
                 self.metrics.inc("spec_presolve_failed")
+                # Flight-record attribution: the known row-scale-crossing
+                # ValueError class of presolve failure must be visible in
+                # the post-mortem, not just a counter bump.
+                self._tick_exc["spec_presolve_failed"] = type(e).__name__
                 span.add_event(
                     "presolve_failed", error=f"{type(e).__name__}: {e}"
                 )
@@ -877,6 +906,9 @@ class Scheduler:
                 raise  # a miss is a tick-level outcome, not retryable
             except (RuntimeError, ValueError, NotImplementedError) as e:
                 self.metrics.inc("solve_attempt_failed")
+                # The exception CLASS rides into the tick's flight record
+                # (the counter alone is a bare bump post-mortem).
+                self._tick_exc["solve_attempt_failed"] = type(e).__name__
                 self._span.add_event(
                     "solve_attempt_failed",
                     attempt=attempt,
@@ -1062,6 +1094,15 @@ class Scheduler:
             "span_id": ctx.span_id if ctx is not None else None,
             "counters_delta": delta,
         }
+        if self._tick_exc:
+            # Exception classes behind this tick's failure counters
+            # (solve_attempt_failed / spec_presolve_failed): the counter
+            # says a solve raised, this says WHAT raised.
+            rec["exc"] = dict(self._tick_exc)
+        if self._tick_conv is not None:
+            # Solver-diagnostics digest (Scheduler(diagnostics=True)): the
+            # tick's convergence facts next to its mode/health/deltas.
+            rec["convergence"] = dict(self._tick_conv)
         if self.speculative:
             # The post-mortem question speculation adds: was THIS tick a
             # hit or a miss, and how full was the bank when it happened?

@@ -133,6 +133,7 @@ def halda_solve(
     lp_backend: str = "auto",
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    convergence: Optional[dict] = None,
 ) -> HALDAResult:
     """Pick the best (k, w, n[, y]) placement over all candidate segment counts.
 
@@ -185,6 +186,14 @@ def halda_solve(
     breakdown (build/pack/upload/solve+fetch milliseconds, see
     ``solve_sweep_jax``; ``build_ms`` is the host-side coefficient +
     instance assembly added here).
+
+    ``convergence``: pass a dict (JAX backend) to run the solve with
+    solver-interior telemetry on — the per-B&B-round search log and the
+    root LP relaxations' per-chunk convergence traces are decoded into it
+    (see ``solve_sweep_jax`` and ``obs.convergence.build_search_trace``),
+    and a flat ``conv_*`` digest additionally lands in ``timings``. The
+    default (None) runs the exact untraced device program; an escalated
+    retry re-fills the dict with the final solve's telemetry.
 
     ``margin_state``: a dict threaded across streaming MoE ticks enabling
     the margin fast path (previous tick's decomposition bounds reused
@@ -248,6 +257,7 @@ def halda_solve(
             lp_backend=lp_backend,
             pdhg_iters=pdhg_iters,
             pdhg_restart_tol=pdhg_restart_tol,
+            convergence=convergence,
         )
         # In-solver certification escalation (the ladder one-shot callers
         # could never reach while it lived only in StreamingReplanner,
@@ -313,6 +323,7 @@ def halda_solve(
                 timings=tm,
                 lp_backend=engine,
                 pdhg_restart_tol=pdhg_restart_tol,
+                convergence=convergence,
                 **esc_kw,
             )
             if best2 is not None:
@@ -396,6 +407,7 @@ def halda_solve_async(
     lp_backend: str = "auto",
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    convergence: Optional[dict] = None,
 ) -> PendingHalda:
     """Dispatch a HALDA solve and return without waiting for the result.
 
@@ -405,7 +417,9 @@ def halda_solve_async(
     collected result) is sound: hints are re-priced exactly on-device, so
     staleness only affects pruning speed, never correctness. The MoE
     margin chain (``margin_state``) works pipelined too: the bound reuse
-    is decided at dispatch, the anchor refresh at collect.
+    is decided at dispatch, the anchor refresh at collect — and so does
+    ``convergence``: the telemetry is recorded in-dispatch and decoded
+    into the dict when ``.collect()`` redeems the result.
     """
     try:
         from .backend_jax import PendingSweep, solve_sweep_jax
@@ -435,6 +449,7 @@ def halda_solve_async(
         lp_backend=lp_backend,
         pdhg_iters=pdhg_iters,
         pdhg_restart_tol=pdhg_restart_tol,
+        convergence=convergence,
     )
     if not isinstance(pending, PendingSweep):
         # Plain (results, None) tuple: structurally infeasible sweep

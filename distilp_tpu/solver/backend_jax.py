@@ -83,8 +83,19 @@ _configure_compile_cache()
 
 import jax.numpy as jnp  # noqa: E402
 
-from ..ops.ipm import IPMWarmState, LPBatch, ipm_solve_batch  # noqa: E402
-from ..ops.pdhg import DEFAULT_RESTART_TOL, pdhg_solve_batch  # noqa: E402
+from ..ops.ipm import (  # noqa: E402
+    IPM_DEFAULT_CHUNK,
+    TRACE_COLS,
+    IPMWarmState,
+    LPBatch,
+    ipm_solve_batch,
+    n_trace_rows,
+)
+from ..ops.pdhg import (  # noqa: E402
+    DEFAULT_RESTART_TOL,
+    PDHG_DEFAULT_CHUNK,
+    pdhg_solve_batch,
+)
 from .assemble import INACTIVE_RHS, MilpArrays, VarLayout  # noqa: E402
 from .coeffs import HaldaCoeffs  # noqa: E402
 from .result import ILPResult  # noqa: E402
@@ -146,6 +157,42 @@ PDHG_AUTO_M = 128
 # rounds start from the parent's iterate and keep a quarter of the budget.
 PDHG_ITERS = 2000
 PDHG_WARM_FLOOR = 200
+
+# -- branch-and-bound round log (the `diag` static flag) --------------------
+# One row per B&B round when diagnostics are on, riding the packed output
+# right after the root-iterate block (and BEFORE the m_y margin tail, which
+# stays last). Decoded by obs/convergence.py into SearchTrace.
+RL_COLS = 6  # [expanded, live_after, incumbent, best_bound, lp_iters, executed]
+
+
+def _round_row(before: "SearchState", after: "SearchState", nbeam: int):
+    """One round-log row from the states bracketing a B&B round. Pure
+    bookkeeping over values the search already carries — the round itself
+    is untouched, so the logged program's search trajectory is the
+    unlogged program's."""
+    return jnp.stack(
+        [
+            jnp.sum(before.active[:nbeam].astype(BDTYPE)),
+            jnp.sum(after.active.astype(BDTYPE)),
+            after.incumbent,
+            _best_bound(after),
+            after.stat_ipm_iters - before.stat_ipm_iters,
+            jnp.ones((), BDTYPE),
+        ]
+    )
+
+
+def _root_trace_rows(lp_backend: str, lp_iters: int, root_warm_chunk: bool) -> int:
+    """Trace rows of the ROOT round's LP solve — mirrors exactly the chunk
+    the root `_bnb_round` hands the kernel (PDHG always uses the kernel
+    default; a cold IPM root runs one full-length chunk, a warm one the
+    kernel default), so the packed-output decode and the while-loop buffer
+    allocation can never disagree."""
+    if lp_backend == "pdhg":
+        chunk = PDHG_DEFAULT_CHUNK
+    else:
+        chunk = IPM_DEFAULT_CHUNK if root_warm_chunk else lp_iters
+    return n_trace_rows(lp_iters, chunk)
 
 
 def default_pdhg_iters(M: int) -> int:
@@ -1331,6 +1378,7 @@ def _bnb_round(
     ipm_chunk: Optional[int] = None,
     lp_backend: str = "ipm",
     pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    lp_trace: bool = False,
 ):
     """One batched branch-and-bound round over the frontier (pure function;
     traced inside the fused solve loop or jitted standalone by callers).
@@ -1416,6 +1464,7 @@ def _bnb_round(
             restart_tol=pdhg_restart_tol,
             warm=warm,
             skip=~active_p,
+            trace=lp_trace,
         )
     else:
         chunk_kw = {} if ipm_chunk is None else {"chunk": ipm_chunk}
@@ -1424,6 +1473,7 @@ def _bnb_round(
             iters=ipm_iters,
             warm=warm,
             skip=~active_p,
+            trace=lp_trace,
             **chunk_kw,
         )
     bound = res.bound + obj_const
@@ -1952,7 +2002,7 @@ _PACKED_STATIC_ARGS = (
     "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
     "has_warm", "w_max", "e_max", "decomp_steps", "has_duals", "per_k",
     "has_margin", "ipm_warm_iters", "has_root_warm", "lp_backend",
-    "pdhg_restart_tol",
+    "pdhg_restart_tol", "diag",
 )
 
 
@@ -1979,6 +2029,7 @@ def _solve_packed_impl(
     has_root_warm: bool = False,
     lp_backend: str = "ipm",
     pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    diag: bool = False,
 ) -> jax.Array:
     """One-dispatch sweep: unpack the two blobs (``_pack_static`` stays
     device-resident across streaming ticks; ``_pack_dynamic`` is the per-tick
@@ -2009,6 +2060,14 @@ def _solve_packed_impl(
     caller persists them and ships them back through ``has_root_warm``'s
     dynamic-blob slot so the next streaming tick's root round starts from
     this tick's iterates instead of mid-box.
+
+    ``diag`` (static, the convergence-diagnostics path) appends the B&B
+    round log ``(max_rounds, RL_COLS)`` and the root round's per-chunk LP
+    trace ``(n_k, _root_trace_rows(...), TRACE_COLS)`` right after the
+    root-iterate block — BEFORE the m_y tail, which stays last so the
+    margin anchor's negative-index read in ``collect_sweep`` is unmoved.
+    With ``diag=False`` the output vector is byte-identical to the
+    pre-diagnostics program (pinned in tests/test_convergence.py).
     """
     if has_margin and not (has_duals and has_warm):
         # Static-arg invariant, so it must survive `python -O` (an assert
@@ -2215,7 +2274,7 @@ def _solve_packed_impl(
             ),
         )
 
-    state, root_iters = _run_bnb_loop(
+    loop_out = _run_bnb_loop(
         data,
         state,
         mip_gap,
@@ -2229,7 +2288,12 @@ def _solve_packed_impl(
         root_warm_chunk=has_root_warm,
         lp_backend=lp_backend,
         pdhg_restart_tol=pdhg_restart_tol,
+        collect_rounds=diag,
     )
+    if diag:
+        state, root_iters, (round_log, root_trace) = loop_out
+    else:
+        state, root_iters = loop_out
 
     parts = [
         jnp.stack(
@@ -2273,6 +2337,13 @@ def _solve_packed_impl(
         z_r[:n_k].astype(BDTYPE).ravel(),
         f_r[:n_k].astype(BDTYPE).ravel(),
     ]
+    if diag:
+        # Diagnostics tail (round log + root LP trace) sits BEFORE the m_y
+        # anchor so the margin tail's negative-index read stays valid.
+        parts += [
+            round_log.ravel(),
+            root_trace[:n_k].astype(BDTYPE).ravel(),
+        ]
     if out_m_y is not None:
         # y-profile tail (n_k*M*(e_max+1)), LAST so no earlier offset moves:
         # read back by solve_sweep_jax for the margin fast path; absent on
@@ -2424,6 +2495,7 @@ def _solve_scenarios_packed(
     has_root_warm: bool = False,
     lp_backend: str = "ipm",
     pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    diag: bool = False,
 ) -> jax.Array:
     return jax.vmap(
         lambda dyn: _solve_packed_impl(
@@ -2433,7 +2505,7 @@ def _solve_scenarios_packed(
             decomp_steps=decomp_steps, has_duals=has_duals, per_k=per_k,
             has_margin=has_margin, ipm_warm_iters=ipm_warm_iters,
             has_root_warm=has_root_warm, lp_backend=lp_backend,
-            pdhg_restart_tol=pdhg_restart_tol,
+            pdhg_restart_tol=pdhg_restart_tol, diag=diag,
         )
     )(dyn_blobs)
 
@@ -2486,6 +2558,7 @@ def _run_bnb_loop(
     root_beam: Optional[int] = None,
     lp_backend: str = "ipm",
     pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    collect_rounds: bool = False,
 ):
     """B&B rounds with the mip-gap test on-device. The single shared
     definition of the search loop (traced by both the packed single-dispatch
@@ -2512,6 +2585,13 @@ def _run_bnb_loop(
     exit after a few steps); a cold root needs its whole budget, so by
     default the root runs one full-length chunk and skips the while-loop
     overhead entirely.
+
+    ``collect_rounds=True`` (the diagnostics path) additionally threads a
+    fixed-size per-round log through the loop carry (one `_round_row` per
+    executed round, root at row 0) and runs the ROOT round's LP solve with
+    the kernel convergence trace on; the return grows a trailing
+    ``(round_log, root_trace)`` pair. Off (the default), the carry, the
+    cond and the body are byte-for-byte the pre-diagnostics program.
     """
     warm_iters = ipm_iters if ipm_warm_iters is None else ipm_warm_iters
     n_k = state.per_k_best.shape[0]
@@ -2540,22 +2620,53 @@ def _run_bnb_loop(
             st.node_f[:B0],
         )
 
-    if max_rounds >= 1:
+    if collect_rounds:
+        rlog0 = jnp.zeros((max_rounds, RL_COLS), BDTYPE)
+        rtrace0 = jnp.zeros(
+            (B0, _root_trace_rows(lp_backend, ipm_iters, root_warm_chunk),
+             TRACE_COLS),
+            DTYPE,
+        )
+
+    def root_solve(st, lp_trace):
+        ok = st.active[:B0]
+        st2, res = _bnb_round(
+            data, st, mip_gap, ipm_iters=ipm_iters, beam=B0,
+            moe=moe, per_k=per_k, return_res=True,
+            ipm_chunk=None if root_warm_chunk else ipm_iters,
+            lp_backend=lp_backend, pdhg_restart_tol=pdhg_restart_tol,
+            lp_trace=lp_trace,
+        )
+        return st2, (
+            ok,
+            res.v.astype(DTYPE),
+            res.y_dual.astype(DTYPE),
+            res.z_dual.astype(DTYPE),
+            res.f_dual.astype(DTYPE),
+        ), res
+
+    if max_rounds >= 1 and collect_rounds:
+        def root_fn_d(args):
+            st, rlog = args
+            st2, iters_t, res = root_solve(st, True)
+            rlog = rlog.at[0].set(_round_row(st, st2, B0))
+            return st2, iters_t, rlog, res.trace_buf.astype(DTYPE)
+
+        def pass_fn_d(args):
+            st, rlog = args
+            st2, iters_t = passthrough(st)
+            return st2, iters_t, rlog, rtrace0
+
+        state, root_iters, rlog, root_trace = jax.lax.cond(
+            jnp.any(state.active) & ~settled_of(state),
+            root_fn_d,
+            pass_fn_d,
+            (state, rlog0),
+        )
+    elif max_rounds >= 1:
         def root_fn(st):
-            ok = st.active[:B0]
-            st2, res = _bnb_round(
-                data, st, mip_gap, ipm_iters=ipm_iters, beam=B0,
-                moe=moe, per_k=per_k, return_res=True,
-                ipm_chunk=None if root_warm_chunk else ipm_iters,
-                lp_backend=lp_backend, pdhg_restart_tol=pdhg_restart_tol,
-            )
-            return st2, (
-                ok,
-                res.v.astype(DTYPE),
-                res.y_dual.astype(DTYPE),
-                res.z_dual.astype(DTYPE),
-                res.f_dual.astype(DTYPE),
-            )
+            st2, iters_t, _res = root_solve(st, False)
+            return st2, iters_t
 
         state, root_iters = jax.lax.cond(
             jnp.any(state.active) & ~settled_of(state),
@@ -2565,23 +2676,57 @@ def _run_bnb_loop(
         )
     else:
         state, root_iters = passthrough(state)
+        if collect_rounds:
+            rlog, root_trace = rlog0, rtrace0
 
-    def cond(carry):
-        state, i = carry
-        return (i < max_rounds) & jnp.any(state.active) & ~settled_of(state)
+    Bw = cap if beam is None else min(beam, cap)
 
-    def body(carry):
-        state, i = carry
-        return (
-            _bnb_round(
+    if collect_rounds:
+        def cond_d(carry):
+            state, i, _rlog = carry
+            return (
+                (i < max_rounds) & jnp.any(state.active) & ~settled_of(state)
+            )
+
+        def body_d(carry):
+            state, i, rlog = carry
+            st2 = _bnb_round(
                 data, state, mip_gap, ipm_iters=warm_iters, beam=beam,
                 moe=moe, per_k=per_k,
                 lp_backend=lp_backend, pdhg_restart_tol=pdhg_restart_tol,
-            ),
-            i + 1,
+            )
+            rlog = rlog.at[i].set(_round_row(state, st2, Bw))
+            return (st2, i + 1, rlog)
+
+        state, _, rlog = jax.lax.while_loop(
+            cond_d, body_d, (state, jnp.asarray(1, jnp.int32), rlog)
+        )
+    else:
+        def cond(carry):
+            state, i = carry
+            return (
+                (i < max_rounds) & jnp.any(state.active) & ~settled_of(state)
+            )
+
+        def body(carry):
+            state, i = carry
+            return (
+                _bnb_round(
+                    data, state, mip_gap, ipm_iters=warm_iters, beam=beam,
+                    moe=moe, per_k=per_k,
+                    lp_backend=lp_backend, pdhg_restart_tol=pdhg_restart_tol,
+                ),
+                i + 1,
+            )
+
+        state, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.asarray(1, jnp.int32))
         )
 
-    state, _ = jax.lax.while_loop(cond, body, (state, jnp.asarray(1, jnp.int32)))
+    if collect_root and collect_rounds:
+        return state, root_iters, (rlog, root_trace)
+    if collect_rounds:
+        return state, (rlog, root_trace)
     if collect_root:
         return state, root_iters
     return state
@@ -2724,8 +2869,20 @@ def solve_sweep_jax(
     lp_backend: Optional[str] = None,
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    convergence: Optional[dict] = None,
 ):
     """Solve the whole k-sweep on the accelerator.
+
+    ``convergence`` (pass a dict) turns on solver-interior telemetry: the
+    fused program additionally records a per-B&B-round log and the root
+    round's per-chunk LP convergence trace (see ops/ipm.py TRACE_COLS),
+    decoded into the dict at collect time — ``round_log`` (one
+    ``[round, expanded, live_after, incumbent, bound, lp_iters]`` entry
+    per executed round), ``root_trace`` (n_k × rows × TRACE_COLS nested
+    lists), plus the engine/header facts ``obs.convergence`` builds its
+    ``SearchTrace`` report from. A convergence digest (``conv_*`` keys)
+    also lands in ``timings``. Default None = the exact untraced program
+    (outputs byte-identical, pinned in tests/test_convergence.py).
 
     ``lp_backend`` picks the LP relaxation engine ('ipm' | 'pdhg' | 'auto',
     None = 'auto': pdhg at or above ``PDHG_AUTO_M`` devices). Both engines
@@ -2803,6 +2960,10 @@ def solve_sweep_jax(
     )
     if timings is not None:
         timings["lp_backend"] = engine
+    diag = convergence is not None
+    if diag:
+        # One solve, one report: an escalated retry re-fills from scratch.
+        convergence.clear()
     warm_tuple, duals_tuple, root_warm_tuple = _warm_and_duals(
         sf, arrays, warm, feasible
     )
@@ -2894,6 +3055,15 @@ def solve_sweep_jax(
         has_root_warm=root_warm_tuple is not None,
         lp_backend=engine,
         pdhg_restart_tol=restart_tol,
+        diag=diag,
+    )
+    n_rows_root = (
+        _root_trace_rows(engine, ipm_iters, root_warm_tuple is not None)
+        if diag
+        else 0
+    )
+    diag_len = (
+        max_rounds * RL_COLS + n_k * n_rows_root * TRACE_COLS if diag else 0
     )
     pending = PendingSweep(
         out=out_dev,
@@ -2917,6 +3087,19 @@ def solve_sweep_jax(
                 np.asarray(sf.Ws, np.float64),
             )
             if margin_state is not None and sf.moe
+            else None
+        ),
+        diag_len=diag_len,
+        conv_ctx=(
+            {
+                "dict": convergence,
+                "rounds": max_rounds,
+                "rows": n_rows_root,
+                "engine": engine,
+                "mip_gap": mip_gap,
+                "ks": [k for k, _ in feasible],
+            }
+            if diag
             else None
         ),
     )
@@ -2979,28 +3162,46 @@ class PendingSweep(NamedTuple):
     nf: int = 0
     m: int = 0
     stats: Optional[dict] = None
+    # Convergence-diagnostics context (`diag` runs only): diag_len floats
+    # of round log + root LP trace sit between the root-iterate block and
+    # the m_y tail; conv_ctx carries the decode shapes and the caller's
+    # convergence dict to fill at collect time.
+    diag_len: int = 0
+    conv_ctx: Optional[dict] = None
 
 
-def _expected_out_len(
-    M: int, n_k: int, moe: bool, w_max: int, per_k: bool,
-    has_margin: bool, Yn: int, nf: int, m: int,
+def _pre_diag_len(
+    M: int, n_k: int, moe: bool, w_max: int, per_k: bool, nf: int, m: int,
 ) -> int:
-    """Total ``_solve_packed`` output length implied by the static flags.
-
-    Mirrors the pack order at the end of ``_solve_packed_impl``: header +
-    incumbent vectors + per-k bests, then (when the decomposition context
-    exists) the duals block, then the per-k assignment block, then the
-    root-iterate block, then — LAST, and only on full-evaluation ticks —
-    the margin anchor's y-profile. The input side has the off64
-    layout-drift assert; this is its output twin, guarding the negative
-    tail slice the margin anchor is read with.
-    """
+    """Output length UP TO the diagnostics tail: header + incumbent vectors
+    + per-k bests + (optional) duals block + (optional) per-k block + the
+    root-iterate block. The diag tail (round log + root LP trace) starts
+    here; the m_y margin anchor, when present, stays last."""
     n = 6 + 3 * M + n_k
     if moe and w_max > 0:
         n += 3 * n_k + n_k * M  # lam, mu, tau, root_bounds
     if per_k:
         n += 3 * n_k * M + n_k  # per_k_w/n/y, per_k_bound
     n += n_k * (1 + 3 * nf + m)  # root-iterate block (ok, v, y, z, f)
+    return n
+
+
+def _expected_out_len(
+    M: int, n_k: int, moe: bool, w_max: int, per_k: bool,
+    has_margin: bool, Yn: int, nf: int, m: int, diag_len: int = 0,
+) -> int:
+    """Total ``_solve_packed`` output length implied by the static flags.
+
+    Mirrors the pack order at the end of ``_solve_packed_impl``: header +
+    incumbent vectors + per-k bests, then (when the decomposition context
+    exists) the duals block, then the per-k assignment block, then the
+    root-iterate block, then the ``diag_len``-float diagnostics tail
+    (round log + root LP trace, ``diag`` runs only), then — LAST, and only
+    on full-evaluation ticks — the margin anchor's y-profile. The input
+    side has the off64 layout-drift assert; this is its output twin,
+    guarding the negative tail slice the margin anchor is read with.
+    """
+    n = _pre_diag_len(M, n_k, moe, w_max, per_k, nf, m) + diag_len
     if moe and w_max > 0 and not has_margin:
         n += n_k * M * Yn  # m_y anchor profile
     return n
@@ -3018,6 +3219,8 @@ def collect_sweep(
         pending.debug, per_k=pending.per_k, nf=pending.nf, m=pending.m,
         stats=pending.stats,
     )
+    if pending.conv_ctx is not None:
+        _decode_convergence(out, pending)
     if pending.margin_ctx is not None:
         margin_state, has_margin, rd_np, ks_arr, Ws_arr = pending.margin_ctx
         # Tail reads below depend on 'm_y appended LAST'; verify the whole
@@ -3027,6 +3230,7 @@ def collect_sweep(
         expected = _expected_out_len(
             pending.M, pending.n_k, pending.moe, pending.w_max,
             pending.per_k, has_margin, Yn, pending.nf, pending.m,
+            diag_len=pending.diag_len,
         )
         if out.shape[0] != expected:
             # Explicit raise (not `assert`) so the guard survives
@@ -3066,6 +3270,60 @@ def collect_sweep(
             margin_state.pop("m_y", None)
             margin_state.pop("duals", None)
     return results, best
+
+
+def _decode_convergence(out: np.ndarray, pending: PendingSweep) -> None:
+    """Decode the diagnostics tail (round log + root LP trace) into the
+    caller's convergence dict and put the digest keys into ``stats``.
+
+    The dict carries PLAIN nested lists, not arrays — ``obs.convergence``
+    (the pydantic report layer) stays importable without numpy or jax.
+    """
+    cc = pending.conv_ctx
+    n_k = pending.n_k
+    rounds, rows = cc["rounds"], cc["rows"]
+    pre = _pre_diag_len(
+        pending.M, n_k, pending.moe, pending.w_max, pending.per_k,
+        pending.nf, pending.m,
+    )
+    need = pre + rounds * RL_COLS + n_k * rows * TRACE_COLS
+    if out.shape[0] < need:
+        # Explicit raise (not assert) for the same -O reason as the other
+        # layout guards: a short tail means the pack and this decode
+        # disagree about the diag layout, and a silent mis-slice would
+        # fabricate a convergence report.
+        raise AssertionError(
+            f"_solve_packed diagnostics tail layout drift: need {need} "
+            f"values, got {out.shape[0]}"
+        )
+    rl = out[pre : pre + rounds * RL_COLS].reshape(rounds, RL_COLS)
+    rt0 = pre + rounds * RL_COLS
+    rtr = out[rt0 : rt0 + n_k * rows * TRACE_COLS].reshape(
+        n_k, rows, TRACE_COLS
+    )
+    conv = cc["dict"]
+    conv.update(
+        lp_backend=cc["engine"],
+        mip_gap=float(cc["mip_gap"]),
+        ks=list(cc["ks"]),
+        incumbent=float(out[0]),
+        best_bound=float(out[1]),
+        ipm_iters_executed=float(out[4]),
+        bnb_rounds=float(out[5]),
+        # Executed rounds only, each prefixed with its round index (row 0
+        # is the root round; holes are legal — a settled warm tick skips
+        # the root but the while loop may still run).
+        round_log=[
+            [int(i)] + [float(v) for v in rl[i, : RL_COLS - 1]]
+            for i in range(rounds)
+            if rl[i, RL_COLS - 1] > 0.5
+        ],
+        root_trace=[[list(map(float, r)) for r in el] for el in rtr],
+    )
+    if pending.stats is not None:
+        from ..obs.convergence import build_search_trace
+
+        pending.stats.update(build_search_trace(conv).digest())
 
 
 def _decode_sweep_out(

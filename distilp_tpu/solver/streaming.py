@@ -120,6 +120,7 @@ class StreamingReplanner:
         moe: Optional[bool] = None,
         cold_start: bool = False,
         search: Optional[dict] = None,
+        diagnostics: bool = False,
     ) -> None:
         # Library users build a replanner and call step() in a loop; arm the
         # axon-wedge guard here too so the FIRST tick's backend init cannot
@@ -149,6 +150,14 @@ class StreamingReplanner:
                 f"unknown search override(s) {sorted(bad)}; "
                 f"valid keys: {list(self._SEARCH_KEYS)}"
             )
+        # Solver-interior telemetry (`serve --solver-diagnostics`): every
+        # sync tick solves with a convergence dict attached, the raw trace
+        # lands on ``last_convergence`` (obs.convergence decodes it) and
+        # the flat conv_* digest rides the tick's timings dict onto the
+        # sched.solve span / flight records. Off (default) = the exact
+        # untraced device program, byte-identical outputs.
+        self.diagnostics = diagnostics
+        self.last_convergence: dict = {}
         self.last: Optional[HALDAResult] = None
         self.last_mapping = None  # ExpertMapping of the last load-aware tick
         # Observability (see distilp_tpu.sched.metrics): an optional sink
@@ -219,6 +228,7 @@ class StreamingReplanner:
         if factors is not None and len(factors) != len(devs):
             factors = None  # fleet changed shape; restart the fixed point
 
+        conv = {} if (self.diagnostics and self.backend == "jax") else None
         result = halda_solve(
             devs,
             model,
@@ -231,11 +241,14 @@ class StreamingReplanner:
             load_factors=factors,
             timings=timings,
             margin_state=None if self.cold_start else self._margin_state,
+            convergence=conv,
             **self.search,
         )
         result = self._certify_or_fallback(
-            result, devs, model, k_candidates, factors, warm, timings
+            result, devs, model, k_candidates, factors, warm, timings,
+            convergence=conv,
         )
+        self.last_convergence = conv if conv is not None else {}
 
         if loads is not None and result.y is not None:
             from .moe import build_moe_arrays
@@ -263,6 +276,7 @@ class StreamingReplanner:
         factors,
         warm: Optional[HALDAResult],
         timings: Optional[dict],
+        convergence: Optional[dict] = None,
     ) -> HALDAResult:
         """The certification escalation ladder, shared by ``step()`` and
         ``collect()``.
@@ -299,6 +313,7 @@ class StreamingReplanner:
                 load_factors=factors,
                 timings=timings,
                 margin_state=self._margin_state,
+                convergence=convergence,
                 **self.search,
             )
             # The retry's own report is irrelevant here (the anchor was
@@ -317,6 +332,7 @@ class StreamingReplanner:
                 load_factors=factors,
                 timings=timings,
                 margin_state=self._margin_state,
+                convergence=convergence,
                 **self.search,
             )
             self._margin_state.pop("used", None)
@@ -378,6 +394,7 @@ class StreamingReplanner:
         if factors is not None and len(factors) != len(devs):
             factors = None
 
+        conv = {} if self.diagnostics else None
         pending = halda_solve_async(
             devs,
             model,
@@ -388,6 +405,7 @@ class StreamingReplanner:
             warm=warm,
             load_factors=factors,
             margin_state=None if self.cold_start else self._margin_state,
+            convergence=conv,
             **self.search,
         )
         # Snapshot the fleet AND the model: streaming callers mutate both in
@@ -407,7 +425,7 @@ class StreamingReplanner:
         model_snap = model.model_copy()
         self._in_flight.append(
             (pending, shape, devs_snap, model_snap, loads, k_candidates,
-             factors, warm)
+             factors, warm, conv)
         )
         return pending
 
@@ -416,13 +434,17 @@ class StreamingReplanner:
         if not self._in_flight:
             raise RuntimeError("no in-flight tick; call submit() first")
         (pending, shape, devs, model, loads, k_candidates, factors,
-         warm) = self._in_flight.pop(0)
+         warm, conv) = self._in_flight.pop(0)
         result = pending.collect()
         # Pipelined misses escalate synchronously — the pipeline hiccups,
-        # correctness does not.
+        # correctness does not. The telemetry dict (diagnostics mode) is
+        # decoded by the collect above and refilled by any escalation, so
+        # last_convergence always describes the tick just redeemed.
         result = self._certify_or_fallback(
-            result, devs, model, k_candidates, factors, warm, None
+            result, devs, model, k_candidates, factors, warm, None,
+            convergence=conv,
         )
+        self.last_convergence = conv if conv is not None else {}
         if loads is not None and result.y is not None:
             from .moe import build_moe_arrays
             from .routing import map_experts
@@ -444,6 +466,7 @@ class StreamingReplanner:
         self.last_tick_mode = None
         self.last_tick_escalations = 0
         self.last_tick_timings = {}
+        self.last_convergence = {}
         self._last_shape = None
         self._load_factors = None
         self._in_flight = []

@@ -372,6 +372,49 @@ def main() -> int:
         f"{sp['presolved']} futures pre-solved) — event->placement p99 "
         f"{off['p99'] / max(on['p99'], 1e-9):.0f}x lower with speculation"
     )
+
+    # ------------------------------------------------------------------
+    # 13. Convergence diagnostics: when a solve misses its certificate,
+    #     the solver-interior telemetry says WHY. Starve the round budget
+    #     on purpose (max_rounds=2 at a tight 1e-5 gap) and read the
+    #     per-round search log the jitted loop recorded about itself —
+    #     then give the full budget back and watch the gap close round by
+    #     round (README "Convergence diagnostics"; `solver diagnose` is
+    #     the CLI over the same report, `make smoke-diag` gates it).
+    # ------------------------------------------------------------------
+    import warnings
+
+    from distilp_tpu.obs import build_search_trace
+
+    conv = {}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the certificate miss is the point
+        starved = halda_solve(
+            devs, model, kv_bits="8bit", mip_gap=1e-5, backend="jax",
+            max_rounds=2, convergence=conv,
+        )
+    tr = build_search_trace(conv)
+    print(
+        f"[13] budget-starved solve: certified={starved.certified} after "
+        f"{len(tr.rounds)} round(s), gap stalled at "
+        f"{tr.final_gap:.2e} (> mip_gap 1e-05) — the round log shows "
+        f"{tr.rounds[-1].nodes_live} node(s) still live when the budget "
+        "ran out"
+    )
+    conv = {}
+    full = halda_solve(
+        devs, model, kv_bits="8bit", mip_gap=1e-5, backend="jax",
+        convergence=conv,
+    )
+    tr = build_search_trace(conv)
+    gaps = " -> ".join(
+        f"{r.gap:.1e}" for r in tr.rounds if r.gap is not None
+    )
+    print(
+        f"[13] full budget: certified={full.certified} in "
+        f"{len(tr.rounds)} rounds / {tr.lp_iters_executed} LP iters "
+        f"(gap {gaps})"
+    )
     return 0
 
 

@@ -1,0 +1,340 @@
+"""Solver-interior convergence telemetry: the in-jit LP trace, the B&B
+round log, the obs.convergence reports, and the `solver diagnose` CLI.
+
+The two load-bearing contracts pinned here:
+
+1. **Byte-identical off-path.** With tracing off, the kernels and the
+   packed sweep produce bit-for-bit the same outputs as with tracing on
+   (trace buffers excluded) — telemetry reads the iteration, it never
+   steers it.
+2. **Exact accounting.** The per-round LP iteration counts sum to the
+   `ipm_iters_executed` header counter, the per-round gap trajectory is
+   monotone non-increasing, and each element's last live trace row agrees
+   with its `iters_run`.
+
+Integration tests reuse the llama-70B profile + M=4 synthetic fleet and
+the [8, 10] k-grid other modules compile, so post-compile solves are fast.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from distilp_tpu.obs.convergence import (
+    build_search_trace,
+    search_trace_from_jsonl,
+    search_trace_to_jsonl,
+)
+
+GAP = 1e-3
+KS = [8, 10]  # proper factors of L=80
+
+
+@pytest.fixture(scope="module")
+def model():
+    from distilp_tpu.common import load_model_profile
+
+    return load_model_profile(
+        "tests/profiles/llama_3_70b/online/model_profile.json"
+    )
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    return make_synthetic_fleet(4, seed=11)
+
+
+def tiny_batch(B=3, m=5, n=9):
+    """A small feasible boxed-LP batch (shared A, b at the box midpoint)."""
+    import jax.numpy as jnp
+
+    from distilp_tpu.ops.ipm import LPBatch
+
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.normal(size=(m, n)), jnp.float32)
+    c = jnp.asarray(rng.normal(size=(B, n)), jnp.float32)
+    l = jnp.zeros((B, n), jnp.float32)
+    u = jnp.full((B, n), 2.0, jnp.float32)
+    b = jnp.einsum("mn,bn->bm", A, jnp.ones((B, n), jnp.float32))
+    return LPBatch(A=A, b=b, c=c, l=l, u=u)
+
+
+# -- kernel-level contracts -------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["ipm", "pdhg"])
+def test_trace_off_on_bit_identical(engine):
+    """The traced solve's result fields equal the untraced solve's bit for
+    bit — the trace rides the carry, it never feeds back."""
+    from distilp_tpu.ops.ipm import ipm_solve_batch
+    from distilp_tpu.ops.pdhg import pdhg_solve_batch
+
+    batch = tiny_batch()
+    if engine == "ipm":
+        r0 = ipm_solve_batch(batch, iters=20)
+        r1 = ipm_solve_batch(batch, iters=20, trace=True)
+    else:
+        r0 = pdhg_solve_batch(batch, iters=200)
+        r1 = pdhg_solve_batch(batch, iters=200, trace=True)
+    assert r0.trace_buf is None
+    assert r1.trace_buf is not None
+    for f in r0._fields:
+        if f == "trace_buf":
+            continue
+        assert np.array_equal(
+            np.asarray(getattr(r0, f)), np.asarray(getattr(r1, f))
+        ), f"{engine}: field {f} diverged under tracing"
+
+
+@pytest.mark.parametrize("engine", ["ipm", "pdhg"])
+def test_trace_rows_account_for_iters(engine):
+    """Per-element: live rows carry monotone cumulative iteration counts,
+    the last live row equals iters_run, and rows are finite."""
+    from distilp_tpu.ops.ipm import TRACE_COLS, ipm_solve_batch
+    from distilp_tpu.ops.pdhg import pdhg_solve_batch
+
+    batch = tiny_batch()
+    if engine == "ipm":
+        res = ipm_solve_batch(batch, iters=20, trace=True)
+    else:
+        res = pdhg_solve_batch(batch, iters=200, trace=True)
+    tb = np.asarray(res.trace_buf)
+    iters_run = np.asarray(res.iters_run)
+    assert tb.shape[0] == len(iters_run) and tb.shape[2] == TRACE_COLS
+    for e in range(tb.shape[0]):
+        live = tb[e][tb[e][:, 5] > 0.5]
+        assert len(live) >= 1
+        assert np.all(np.diff(live[:, 0]) > 0)  # iters strictly increase
+        assert live[-1, 0] == iters_run[e]
+        assert np.all(np.isfinite(live))
+        # restarts are cumulative: non-decreasing, and zero for the IPM.
+        assert np.all(np.diff(live[:, 4]) >= 0)
+        if engine == "ipm":
+            assert np.all(live[:, 4] == 0)
+
+
+def test_pdhg_skip_element_has_no_live_rows():
+    import jax.numpy as jnp
+
+    from distilp_tpu.ops.pdhg import pdhg_solve_batch
+
+    batch = tiny_batch()
+    skip = jnp.asarray([True, False, False])
+    res = pdhg_solve_batch(batch, iters=64, skip=skip, trace=True)
+    tb = np.asarray(res.trace_buf)
+    assert not np.any(tb[0][:, 5] > 0.5)  # skipped element never live
+    assert np.any(tb[1][:, 5] > 0.5)
+
+
+# -- sweep-level contracts --------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["ipm", "pdhg"])
+def test_sweep_convergence_report(model, fleet, engine):
+    """halda_solve(convergence=...) yields a SearchTrace whose per-round
+    LP iteration counts sum EXACTLY to the executed-iteration counter and
+    whose gap trajectory is monotone non-increasing; the digest rides the
+    timings dict."""
+    from distilp_tpu.solver import halda_solve
+
+    tm: dict = {}
+    conv: dict = {}
+    res = halda_solve(
+        fleet, model, k_candidates=KS, mip_gap=GAP, kv_bits="4bit",
+        backend="jax", lp_backend=engine, timings=tm, convergence=conv,
+    )
+    trace = build_search_trace(conv)
+    assert trace.lp_backend == engine
+    assert trace.rounds, "no rounds recorded"
+    assert sum(r.lp_iters for r in trace.rounds) == trace.lp_iters_executed
+    assert trace.lp_iters_executed == int(round(tm["ipm_iters_executed"]))
+    gaps = [r.gap for r in trace.rounds if r.gap is not None]
+    assert all(a >= b - 1e-12 for a, b in zip(gaps, gaps[1:])), gaps
+    if res.certified:
+        assert trace.certified
+        assert trace.final_gap is not None and trace.final_gap <= GAP + 1e-12
+        assert trace.rounds_to_certify is not None
+        assert trace.iters_to_certify is not None
+    # digest landed in timings for the span/flight plumbing
+    assert tm["conv_rounds"] == len(trace.rounds)
+    assert tm["conv_lp_iters"] == trace.lp_iters_executed
+    assert tm["conv_certified"] == trace.certified
+    # root traces cover the k grid and the PDHG engine reports restarts
+    assert [t.k for t in trace.root_traces] == KS
+    if engine == "pdhg":
+        assert trace.restarts > 0
+
+
+def test_untraced_solve_identical_to_traced(model, fleet):
+    """The byte-identical contract one level up: solving with and without
+    the convergence dict gives the same placement, objective, certificate
+    and device-side work counters."""
+    from distilp_tpu.solver import halda_solve
+
+    tm0: dict = {}
+    r0 = halda_solve(
+        fleet, model, k_candidates=KS, mip_gap=GAP, kv_bits="4bit",
+        backend="jax", timings=tm0,
+    )
+    tm1: dict = {}
+    r1 = halda_solve(
+        fleet, model, k_candidates=KS, mip_gap=GAP, kv_bits="4bit",
+        backend="jax", timings=tm1, convergence={},
+    )
+    assert (r0.k, r0.w, r0.n, r0.obj_value, r0.certified) == (
+        r1.k, r1.w, r1.n, r1.obj_value, r1.certified
+    )
+    assert tm0["ipm_iters_executed"] == tm1["ipm_iters_executed"]
+    assert tm0["bnb_rounds"] == tm1["bnb_rounds"]
+
+
+def test_streaming_diagnostics_flag(model, fleet):
+    from distilp_tpu.solver.streaming import StreamingReplanner
+
+    planner = StreamingReplanner(
+        mip_gap=GAP, kv_bits="4bit", backend="jax", diagnostics=True
+    )
+    tm: dict = {}
+    planner.step(list(fleet), model, k_candidates=KS, timings=tm)
+    assert planner.last_convergence.get("round_log")
+    assert "conv_rounds" in tm
+    trace = build_search_trace(planner.last_convergence)
+    assert trace.rounds
+    # a warm tick refreshes the report
+    planner.step(list(fleet), model, k_candidates=KS, timings=tm)
+    assert build_search_trace(planner.last_convergence).rounds
+
+
+def test_pipelined_diagnostics_refresh(model, fleet):
+    """submit()/collect() ticks refresh last_convergence too — a stale
+    sync-tick report must never be read as the pipelined tick's."""
+    from distilp_tpu.solver.streaming import StreamingReplanner
+
+    planner = StreamingReplanner(
+        mip_gap=GAP, kv_bits="4bit", backend="jax", diagnostics=True
+    )
+    planner.step(list(fleet), model, k_candidates=KS)
+    first = planner.last_convergence
+    assert first.get("round_log")
+    planner.submit(list(fleet), model, k_candidates=KS)
+    res = planner.collect()
+    assert res is not None
+    assert planner.last_convergence is not first
+    trace = build_search_trace(planner.last_convergence)
+    assert trace.rounds
+    assert sum(r.lp_iters for r in trace.rounds) == trace.lp_iters_executed
+
+
+# -- report layer -----------------------------------------------------------
+
+
+def test_jsonl_roundtrip(model, fleet):
+    from distilp_tpu.solver import halda_solve
+
+    conv: dict = {}
+    halda_solve(
+        fleet, model, k_candidates=KS, mip_gap=GAP, kv_bits="4bit",
+        backend="jax", convergence=conv,
+    )
+    trace = build_search_trace(conv)
+    back = search_trace_from_jsonl(search_trace_to_jsonl(trace))
+    assert back == trace
+    assert back.digest() == trace.digest()
+    assert "round" in trace.render_text()
+
+
+def test_digest_keys_match_registry(model, fleet):
+    """Every digest field is enumerated in CONV_DIGEST_KEYS (the one list
+    the scheduler's span/flight plumbing filters by), and a certified
+    solve emits the full set — a key added to digest() but not the
+    registry would silently vanish from spans and flight records."""
+    from distilp_tpu.obs.convergence import CONV_DIGEST_KEYS
+    from distilp_tpu.solver import halda_solve
+
+    conv: dict = {}
+    halda_solve(
+        fleet, model, k_candidates=KS, mip_gap=GAP, kv_bits="4bit",
+        backend="jax", convergence=conv,
+    )
+    digest = build_search_trace(conv).digest()
+    assert set(digest) <= set(CONV_DIGEST_KEYS)
+    assert set(digest) == set(CONV_DIGEST_KEYS)  # certified: every field
+
+
+def test_jsonl_rejects_malformed():
+    with pytest.raises(ValueError):
+        search_trace_from_jsonl('{"type": "round", "round": 0}\n')
+    with pytest.raises(ValueError):
+        search_trace_from_jsonl('{"type": "mystery"}\n')
+
+
+def test_build_search_trace_handles_sentinels():
+    """±inf sentinels (no incumbent / exhausted bound) decode to honest
+    None/0.0 facts, never to NaN-laden reports."""
+    conv = {
+        "lp_backend": "ipm",
+        "mip_gap": 1e-3,
+        "ks": [4],
+        "incumbent": float("inf"),
+        "best_bound": float("-inf"),
+        "ipm_iters_executed": 8.0,
+        "bnb_rounds": 1.0,
+        "round_log": [[0, 1.0, 2.0, float("inf"), float("-inf"), 8.0]],
+        "root_trace": [[[8.0, 1e-5, 1e-6, 1e-7, 0.0, 1.0]]],
+    }
+    tr = build_search_trace(conv)
+    assert tr.incumbent is None and tr.best_bound is None
+    assert not tr.certified and tr.final_gap is None
+    assert tr.rounds[0].gap is None
+    # exhausted (+inf) bound = gap closed
+    conv["best_bound"] = float("inf")
+    conv["incumbent"] = 5.0
+    assert build_search_trace(conv).final_gap == 0.0
+
+
+# -- the diagnose CLI -------------------------------------------------------
+
+
+def test_diagnose_cli_roundtrip(tmp_path, capsys):
+    from distilp_tpu.cli.solver_cli import diagnose_main
+
+    out = tmp_path / "diag.jsonl"
+    rc = diagnose_main(
+        [
+            "--profile", "tests/profiles/llama_3_70b/online",
+            "--synthetic-fleet", "4", "--fleet-seed", "11",
+            "--k-candidates", "8,10", "--mip-gap", str(GAP),
+            "--json", "--out", str(out),
+        ]
+    )
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out.strip())
+    assert payload["rounds"]
+    assert payload["digest"]["conv_rounds"] == len(payload["rounds"])
+    assert sum(r["lp_iters"] for r in payload["rounds"]) == payload[
+        "lp_iters_executed"
+    ]
+    # --load renders the export without a solve (or a backend)
+    rc = diagnose_main(["--load", str(out)])
+    assert rc == 0
+    assert "search:" in capsys.readouterr().out
+    # and the export round-trips through the report layer
+    trace = search_trace_from_jsonl(out.read_text())
+    assert trace.rounds and trace.lp_iters_executed == payload[
+        "lp_iters_executed"
+    ]
+
+
+def test_diagnose_cli_rejects_bad_input(tmp_path, capsys):
+    from distilp_tpu.cli.solver_cli import diagnose_main
+
+    assert diagnose_main([]) == 2  # no --profile, no --load
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("not json\n")
+    assert diagnose_main(["--load", str(bad)]) == 2
+    capsys.readouterr()

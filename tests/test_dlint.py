@@ -1279,3 +1279,54 @@ def test_repo_in_library_violations_stay_fixed():
         ],
     )
     assert found == [], "\n".join(f.render() for f in found)
+
+
+# --------------------------------------------------------------------------
+# obs/convergence.py (solver-interior telemetry) joins the obs-layer
+# contracts: lazy-jax (DLP013), accounted excepts (DLP017), registered
+# metric names (DLP019) — fixture-pinned so the prefix coverage cannot
+# silently regress out from under the new module.
+
+
+def test_convergence_module_joins_lazy_jax_contract():
+    out = findings_for("DLP013", "distilp_tpu/obs/convergence.py", """\
+        import jax
+
+        def decode(conv):
+            return jax.numpy.asarray(conv["round_log"])
+        """)
+    assert len(out) == 1 and "lazy" in out[0].message
+    # ...and importing an eager-jax distilp module is caught the same way
+    out = findings_for("DLP013", "distilp_tpu/obs/convergence.py", """\
+        from distilp_tpu.ops.ipm import TRACE_COLS
+        """)
+    assert len(out) == 1
+
+
+def test_convergence_module_joins_silent_except_contract():
+    out = findings_for("DLP017", "distilp_tpu/obs/convergence.py", """\
+        def load(path):
+            try:
+                return open(path).read()
+            except OSError:
+                return None
+        """)
+    assert len(out) == 1 and "metrics sink" in out[0].message
+
+
+def test_convergence_module_joins_metric_registry_contract():
+    out = findings_for("DLP019", "distilp_tpu/obs/convergence.py", """\
+        def tick(self):
+            self.metrics.inc("conv_totally_unregistered")
+        """)
+    assert len(out) == 1 and "METRIC_REGISTRY" in out[0].message
+
+
+def test_convergence_module_is_currently_clean():
+    """The REAL obs/convergence.py passes its layer's contracts (no jax
+    import, no silent excepts, no unregistered literal counters)."""
+    from pathlib import Path
+
+    src = Path("distilp_tpu/obs/convergence.py").read_text()
+    for code in ("DLP013", "DLP017", "DLP019"):
+        assert findings_for(code, "distilp_tpu/obs/convergence.py", src) == []

@@ -777,3 +777,177 @@ def test_gateway_http_prom_flight_and_tracing(model, tmp_path):
         assert len(roots) == 1 and roots[0]["name"] == "http.request"
         ingest = next(s for s in spans if s["name"] == "gateway.ingest")
         assert ingest["parent_id"] == roots[0]["span_id"]
+
+
+# -- Prometheus parser edge cases (round-trip against the renderer) ---------
+
+
+def test_prometheus_escaped_label_values_roundtrip():
+    """Backslashes, quotes, and newlines in label values survive the
+    render -> parse trip exactly — including the adversarial literal
+    backslash-then-n, which a naive sequential-replace unescaper would
+    corrupt into a newline."""
+    evil = {
+        "fleet": 'f"0\\n0',  # literal backslash + n, plus a quote
+        "shard": "s\nhard",  # a REAL newline
+        "worker": "0\\",  # trailing lone backslash
+    }
+    text = render_prometheus(
+        [
+            {
+                **evil,
+                "health": "healthy",
+                "counters": {"events_total": 3},
+                "latency": {},
+            }
+        ]
+    )
+    parsed = parse_prometheus_text(text)
+    sample = next(
+        (name, labels, v)
+        for name, labels, v in parsed["samples"]
+        if name == "distilp_events_total"
+    )
+    assert sample[1]["fleet"] == evil["fleet"]
+    assert sample[1]["shard"] == evil["shard"]
+    assert sample[1]["worker"] == evil["worker"]
+    assert sample[2] == 3.0
+
+
+def test_prometheus_interleaved_help_type_comments():
+    """HELP/TYPE comments interleaved BETWEEN samples (and plain comments
+    anywhere) parse: real scrape targets emit families in any order."""
+    text = "\n".join(
+        [
+            "# HELP m_a first metric",
+            "# TYPE m_a counter",
+            'm_a{x="1"} 1',
+            "# a stray comment",
+            "# HELP m_b second metric",
+            "# TYPE m_b gauge",
+            "m_b 2.5",
+            '# HELP m_a first metric',  # re-stated mid-stream
+            'm_a{x="2"} 3',
+            "",
+        ]
+    )
+    parsed = parse_prometheus_text(text)
+    assert parsed["help"] == {"m_a": "first metric", "m_b": "second metric"}
+    assert parsed["type"] == {"m_a": "counter", "m_b": "gauge"}
+    assert parsed["samples"] == [
+        ("m_a", {"x": "1"}, 1.0),
+        ("m_b", {}, 2.5),
+        ("m_a", {"x": "2"}, 3.0),
+    ]
+
+
+def test_prometheus_empty_label_set_roundtrip():
+    """Gateway-level counters render with NO label braces; the parser
+    must return them with an empty labels dict, and `{}` explicitly in
+    the text must parse the same way."""
+    text = render_prometheus([], gateway_counters={"gateway_events": 9})
+    parsed = parse_prometheus_text(text)
+    assert ("distilp_gateway_events", {}, 9.0) in parsed["samples"]
+    assert parse_prometheus_text("m_c{} 4\n")["samples"] == [("m_c", {}, 4.0)]
+    with pytest.raises(ValueError):
+        parse_prometheus_text("not a sample line at all{{{\n")
+
+
+# -- flight recorder: exception classes on failure counters -----------------
+
+
+def test_flight_records_solve_attempt_exception_class(fleet, model):
+    """A tick whose solve attempt raises leaves the exception CLASS in its
+    flight record next to the counter delta (the satellite contract: a
+    bare counter is invisible post-mortem)."""
+    fr = FlightRecorder(capacity=16)
+    boom = {"n": 0}
+
+    def hook(attempt):
+        boom["n"] += 1
+        if boom["n"] == 2:  # first tick publishes; second tick's solve dies
+            raise ValueError("injected")
+
+    sched = make_scheduler(
+        fleet, model, flight=fr, flight_key="f", fault_hook=hook,
+        breaker_threshold=0,
+    )
+    trace = generate_trace("mixed", 2, seed=3, base_fleet=fleet)
+    sched.handle(trace[0])
+    sched.handle(trace[1])  # solve fails; last-known-good is served
+    recs = fr.snapshot("f")
+    assert len(recs) == 2
+    assert "exc" not in recs[0]
+    assert recs[1]["exc"] == {"solve_attempt_failed": "ValueError"}
+    assert recs[1]["counters_delta"].get("solve_attempt_failed") == 1
+
+
+def test_flight_records_spec_presolve_exception_class(
+    fleet, model, monkeypatch
+):
+    import distilp_tpu.sched.scheduler as sched_mod
+
+    def explode(*a, **kw):
+        raise ValueError("row-scale crossing")
+
+    monkeypatch.setattr(sched_mod, "presolve_candidates", explode)
+    fr = FlightRecorder(capacity=16)
+    sched = make_scheduler(
+        fleet, model, flight=fr, flight_key="f", speculative=True
+    )
+    # Deterministic presolve trigger: the forecaster always proposes one
+    # candidate future whose drift puts it in a DIFFERENT digest bucket
+    # than the just-banked fresh solve, so every solved tick reaches the
+    # presolve dispatch — which the stub fails.
+    def always_one(fleet_state, k):
+        devs = [d.model_copy(deep=True) for d in fleet_state.device_list()]
+        for d in devs:
+            d.t_comm = d.t_comm * 3.0 + 1e-3
+        return [(devs, 0.5)]
+
+    sched.forecaster.forecast = always_one
+    trace = generate_trace("mixed", 2, seed=3, base_fleet=fleet)
+    for ev in trace:
+        sched.handle(ev)
+    recs = fr.snapshot("f")
+    failed = [r for r in recs if r.get("exc")]
+    assert failed, "no flight record carried the presolve exception class"
+    assert failed[0]["exc"]["spec_presolve_failed"] == "ValueError"
+    assert sched.metrics.counters["spec_presolve_failed"] >= 1
+
+
+# -- solver diagnostics digest on the span / flight path --------------------
+
+
+def test_scheduler_diagnostics_digest_on_span_and_flight(fleet, model):
+    """Scheduler(diagnostics=True): the conv_* digest attaches to the
+    sched.solve span and the flight record, while counters and placements
+    stay identical to the undiagnosed run (telemetry, not behavior)."""
+    trace = generate_trace("mixed", 4, seed=9, base_fleet=fleet)
+    plain = make_scheduler(fleet, model)
+    r1 = replay(plain, trace)
+
+    fr = FlightRecorder(capacity=16)
+    tracer = Tracer()
+    diag = make_scheduler(
+        fleet, model, diagnostics=True, tracer=tracer, flight=fr,
+        flight_key="f",
+    )
+    r2 = replay(diag, trace)
+    assert plain.metrics.counters == diag.metrics.counters
+    assert [
+        (v.result.k, tuple(v.result.w), v.result.obj_value) for v in r1.views
+    ] == [
+        (v.result.k, tuple(v.result.w), v.result.obj_value) for v in r2.views
+    ]
+    solve_spans = [s for s in tracer.spans() if s["name"] == "sched.solve"]
+    assert solve_spans
+    for s in solve_spans:
+        attrs = s["attrs"]
+        assert attrs["conv_rounds"] >= 1
+        assert attrs["conv_lp_iters"] == attrs["ipm_iters_executed"]
+        assert "conv_certified" in attrs
+    recs = fr.snapshot("f")
+    assert len(recs) == len(trace)
+    for rec in recs:
+        assert rec["convergence"]["conv_rounds"] >= 1
