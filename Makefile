@@ -18,7 +18,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload
 	python -m pytest tests/ -q
 
 .PHONY: bench
@@ -202,6 +202,33 @@ smoke-diag: lint-strict
 				% (eng, len(d['rounds']), total, d['restarts'], d['final_gap']))" \
 		|| { rc=1; break; }; \
 	done; rm -f $$T; exit $$rc
+
+# Overload smoke: the committed diurnal+burst open-loop capture replayed
+# at time-scale 0.001 — the whole 60 s schedule fires in ~60 ms, a
+# deterministic saturating flood (~190 events vs 2 workers). Two arms:
+# (1) a tiny bounded queue with NO coalescing must SHED, and --check
+# reconciles every shed record-by-record against the flight recorder
+# (counter == per-fleet monotone shed indices, parseable Retry-After on
+# every record) while every served placement stays structurally valid;
+# (2) the same flood with coalescing on must FOLD queued same-shard drift
+# into single solves (events_coalesced > 0) and serve everything without
+# shedding a deep queue. The graceful-saturation plateau gate (10x
+# sustainable on the 100-fleet trace) is the bench's job (`overload`
+# section, `make bench-compare`); this smoke pins the admission
+# machinery's accounting contract.
+.PHONY: smoke-overload
+smoke-overload: lint-strict
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli overload \
+		--trace tests/traces/openloop_diurnal_burst.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 2 --k-candidates 8,10 --time-scale 0.001 \
+		--max-queue-depth 2 --check --expect-sheds --quiet
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli overload \
+		--trace tests/traces/openloop_diurnal_burst.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 2 --k-candidates 8,10 --time-scale 0.001 \
+		--max-queue-depth 64 --coalesce --check --expect-coalesced \
+		--expect-no-sheds --quiet
 
 .PHONY: smoke-sched
 smoke-sched: lint-strict

@@ -245,6 +245,9 @@ _REGRESSION_GATED = (
 _REGRESSION_GATED_HIGHER = (
     "gateway_events_per_sec_100f_4w",
     "spec_hit_rate",
+    # Overload realism: the events/sec at which p99 first clears the SLO
+    # — the serving tier's real capacity headline under open-loop load.
+    "overload_max_sustainable_eps",
 )
 _REGRESSION_TOL = 0.20
 # Reported-only deltas (no gate): ms-like keys where lower is better,
@@ -255,6 +258,7 @@ _COMPARE_LOWER_BETTER = (
     "cold_process_ms", "cold_process_cached_ms",
     "fleet_scale_pdhg_512_solve_ms", "fleet_scale_pdhg_2048_solve_ms",
     "gateway_p99_ms_100f_4w",
+    "overload_p999_ms",
     "obs_overhead_pct",
     "spec_p99_hit_ms", "spec_p99_on_ms",
     "conv_ipm_iters_to_certify", "conv_pdhg_iters_to_certify",
@@ -276,7 +280,12 @@ _COMPARE_HIGHER_BETTER = (
     "fleet_scale_certified_m_max",
     "gateway_events_per_sec_100f_4w", "gateway_scaling_100f_4w",
     "spec_hit_rate",
+    "overload_max_sustainable_eps", "overload_plateau_ratio",
 )
+# Graceful-saturation floor, checked ABSOLUTE on the new capture (like
+# the obs ceiling): at 10x sustainable load, goodput must stay within
+# 20% of the ladder's best — a plateau, not a cliff.
+_OVERLOAD_PLATEAU_MIN = 0.8
 
 
 def _load_reference_payload(path: str) -> dict:
@@ -361,6 +370,24 @@ def _compare_against(payload: dict, against: str) -> int:
         failures.append(
             f"conv_overhead_pct {conv_pct:.1f} > {_CONV_OVERHEAD_MAX_PCT:g} "
             "(solver-interior telemetry cost ceiling on the traced arm)"
+        )
+    # Overload's absolute contracts: graceful saturation (plateau, not
+    # cliff) and every shed observable. Checked on the new capture, never
+    # relative — a collapse is a collapse even if the reference also
+    # collapsed.
+    plateau = payload.get("overload_plateau_ratio")
+    if (
+        isinstance(plateau, (int, float))
+        and plateau < _OVERLOAD_PLATEAU_MIN
+    ):
+        failures.append(
+            f"overload_plateau_ratio {plateau} < {_OVERLOAD_PLATEAU_MIN:g} "
+            "(throughput cliffed at 10x sustainable load)"
+        )
+    if payload.get("overload_shed_reconciled") is False:
+        failures.append(
+            "overload_shed_reconciled is false (sheds counted that the "
+            "flight recorder cannot explain — see overload.shed_violations)"
         )
     # Speculation's absolute contract (like the obs ceiling, not relative
     # to the reference): on the bundled burst trace, speculation-on p99
@@ -648,6 +675,20 @@ def main(against: str | None = None) -> int:
     except Exception as e:  # pragma: no cover - defensive bench path
         payload["gateway_error"] = f"{type(e).__name__}: {e}"
 
+    # Overload realism (distilp_tpu.traffic): OPEN-loop arrivals against
+    # the 100-fleet gateway — a rate ladder finds the max sustainable
+    # throughput (highest offered rate whose p99 meets the SLO), then a
+    # 10x-sustainable flood with admission control ON (bounded queues +
+    # coalescing) must PLATEAU: goodput within 20% of the ladder's best,
+    # every shed counted AND reconciled against the flight recorder.
+    # Gated in `--against` (overload_max_sustainable_eps regression,
+    # overload_plateau_ratio >= 0.8 absolute, shed reconciliation clean).
+    # A failure costs only these keys.
+    try:
+        payload.update(_overload_bench(model))
+    except Exception as e:  # pragma: no cover - defensive bench path
+        payload["overload_error"] = f"{type(e).__name__}: {e}"
+
     # Observability (distilp_tpu.obs): the 10-fleet loadgen arm replayed
     # with tracing + Prometheus exposition ON vs OFF; obs_overhead_pct is
     # the events/sec cost of full instrumentation, gated at <= 5% by
@@ -820,6 +861,169 @@ def _gateway_bench(model) -> dict:
             top["events_per_sec"] / base, 2
         )
     return out
+
+
+def _overload_bench(model) -> dict:
+    """overload_* section: saturation behavior under OPEN-loop arrivals.
+
+    Closed-loop replay (the gateway section above) cannot exceed
+    capacity by construction; this section can, and measures what
+    happens when it does. One warm 100-fleet gateway serves every arm
+    (the ~100 cold solves are paid once):
+
+    1. a closed-loop probe measures capacity C on the warm fleets;
+    2. a ladder of open-loop arms at ``DPERF_OVERLOAD_LADDER`` x C finds
+       ``overload_max_sustainable_eps`` — the highest offered rate whose
+       p99 still meets the SLO (``DPERF_OVERLOAD_SLO_MS``; default
+       max(250, 4 x closed-loop p50) recorded in the payload) — and
+       ``overload_p999_ms``, the p99.9 at that rate;
+    3. a flood at ``DPERF_OVERLOAD_FACTOR`` (10x) sustainable with
+       admission ON (bounded queues, coalescing, degrade pressure) must
+       hold ``overload_plateau_ratio`` = flood goodput / best ladder
+       goodput >= 0.8 — a plateau, not a cliff — with every shed
+       counted + flight-reconciled (``overload_shed_reconciled``).
+
+    Ladder arms run admission-OFF on purpose: the sustainable-rate
+    search characterizes the raw service; only the flood arm exercises
+    the gate.
+    """
+    import asyncio
+
+    from distilp_tpu.gateway.gateway import Gateway
+    from distilp_tpu.gateway.traces import make_fleet_from_spec
+    from distilp_tpu.obs import FlightRecorder
+    from distilp_tpu.traffic import ArrivalConfig, generate_openloop_schedule
+    from distilp_tpu.traffic.openloop import (
+        _warmup,
+        execute_openloop,
+        measure_closed_loop,
+        shed_violations,
+    )
+
+    n_fleets = int(_env_num("DPERF_OVERLOAD_FLEETS", 100))
+    n_workers = int(_env_num("DPERF_OVERLOAD_WORKERS", 2))
+    fleet_size = int(_env_num("DPERF_OVERLOAD_M", 3))
+    arm_s = _env_num("DPERF_OVERLOAD_SECONDS", 6.0)
+    slo_env = _env_num("DPERF_OVERLOAD_SLO_MS", 0.0)
+    factor = _env_num("DPERF_OVERLOAD_FACTOR", 10.0)
+    depth = int(_env_num("DPERF_OVERLOAD_DEPTH", 8))
+    ladder = [
+        float(x)
+        for x in os.environ.get(
+            "DPERF_OVERLOAD_LADDER", "0.5,0.75,1.0,1.25"
+        ).split(",")
+        if x.strip()
+    ]
+
+    def _cfg(seed: int, rate: float) -> ArrivalConfig:
+        return ArrivalConfig(
+            seed=seed,
+            duration_s=arm_s,
+            base_rate=rate,
+            scenario="drift",
+            fleet_size=fleet_size,
+            fleet_seed=0,
+        )
+
+    flight = FlightRecorder(capacity=8192)
+    gw = Gateway(
+        n_workers=n_workers,
+        scheduler_kwargs={
+            "mip_gap": MIP_GAP,
+            "kv_bits": "4bit",
+            "backend": "jax",
+            "k_candidates": [8, 10],
+        },
+        flight=flight,
+    )
+    try:
+        specs, _ = generate_openloop_schedule(_cfg(1, 1.0), n_fleets)
+        for fleet_id, spec in specs.items():
+            gw.register_fleet(
+                fleet_id, make_fleet_from_spec(fleet_id, spec), model
+            )
+        asyncio.run(_warmup(gw, specs, 2, seed=0))
+        closed = measure_closed_loop(gw, specs, events_per_fleet=3, seed=1)
+        capacity = max(1.0, closed["events_per_sec"])
+        slo_ms = slo_env if slo_env > 0 else max(250.0, 4 * closed["p50_ms"])
+
+        arms: dict = {}
+        sustainable = None  # (offered_eps, p999_ms)
+        best_goodput = 0.0
+        for i, frac in enumerate(ladder):
+            _, items = generate_openloop_schedule(
+                _cfg(100 + i, capacity * frac), n_fleets
+            )
+            if not items:
+                continue
+            rep = asyncio.run(execute_openloop(gw, items))
+            arms[f"{frac:g}x"] = {
+                k: rep[k]
+                for k in (
+                    "offered", "offered_eps", "goodput_eps",
+                    "p50_ms", "p99_ms", "p999_ms", "failed",
+                )
+            }
+            best_goodput = max(best_goodput, rep["goodput_eps"])
+            if rep["p99_ms"] <= slo_ms and (
+                sustainable is None or rep["offered_eps"] > sustainable[0]
+            ):
+                sustainable = (rep["offered_eps"], rep["p999_ms"])
+        if sustainable is None:
+            # Even the lowest rung blew the SLO: report the rung itself
+            # as the (non-)sustainable point rather than fabricating one.
+            first = arms[min(arms, key=lambda k: arms[k]["offered_eps"])]
+            sustainable = (first["offered_eps"], first["p999_ms"])
+
+        # The flood: 10x sustainable, admission ON.
+        gw.configure_admission(
+            max_queue_depth=depth,
+            coalesce=True,
+            degrade_depth=max(1, depth // 2),
+        )
+        _, flood_items = generate_openloop_schedule(
+            _cfg(997, sustainable[0] * factor), n_fleets
+        )
+        flood = asyncio.run(execute_openloop(gw, flood_items))
+        violations = shed_violations(gw, flight)
+        snap = gw.metrics_snapshot()
+        plateau_ratio = (
+            flood["goodput_eps"] / best_goodput if best_goodput else 0.0
+        )
+        out = {
+            "overload": {
+                "fleets": n_fleets,
+                "workers": n_workers,
+                "host_cores": os.cpu_count(),
+                "arm_seconds": arm_s,
+                "slo_ms": round(slo_ms, 3),
+                "closed_loop_eps": capacity,
+                "ladder": arms,
+                "flood": {
+                    **{
+                        k: flood[k]
+                        for k in (
+                            "offered", "offered_eps", "served", "shed",
+                            "goodput_eps", "p50_ms", "p99_ms", "p999_ms",
+                            "failed", "max_queue_depth_seen",
+                        )
+                    },
+                    "events_coalesced": snap["shard_totals"].get(
+                        "events_coalesced", 0
+                    ),
+                    "admission_depth": depth,
+                },
+                "shed_violations": violations,
+            },
+            "overload_max_sustainable_eps": sustainable[0],
+            "overload_p999_ms": sustainable[1],
+            "overload_plateau_ratio": round(plateau_ratio, 3),
+            "overload_sheds": flood["shed"],
+            "overload_shed_reconciled": not violations,
+        }
+        return out
+    finally:
+        gw.close()
 
 
 def _obs_bench(model) -> dict:

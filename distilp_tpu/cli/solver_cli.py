@@ -323,6 +323,37 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "JSON API (POST /events, GET /placement/<fleet>, /healthz, "
         "/metrics) until interrupted",
     )
+    # Admission control (README "Overload & admission control"). Gateway
+    # tier only; all default off — a sequential replay can never shed or
+    # coalesce (depth is 0 at every ingest), so these matter for --listen
+    # serving and the open-loop harness (`solver overload`).
+    p.add_argument(
+        "--max-queue-depth",
+        type=int,
+        default=None,
+        metavar="N",
+        help="bound each solve worker's queue at N commands; an event "
+        "arriving at a full queue is shed — counted (events_shed), "
+        "flight-recorded, and answered 429 + Retry-After over HTTP",
+    )
+    p.add_argument(
+        "--coalesce",
+        action="store_true",
+        help="fold drift events queued for the same shard into ONE solve "
+        "at the newest state (structural events are barriers; folded "
+        "events counted events_coalesced, fleet seq still advances per "
+        "event)",
+    )
+    p.add_argument(
+        "--degrade-depth",
+        type=int,
+        default=None,
+        metavar="N",
+        help="queue depth at which a speculative shard may serve a banked "
+        "NEAR-match (mode='spec_near', spec_near_hit counter) instead of "
+        "queueing the solve past its deadline; needs --speculate to have "
+        "anything banked",
+    )
     p.add_argument(
         "--snapshot-dir",
         default=None,
@@ -651,6 +682,11 @@ def serve_main(argv=None) -> int:
         or args.listen
         or args.snapshot_dir
         or args.resume
+        # Admission control lives in the gateway tier (bounded queues
+        # are per solve worker); asking for it engages that path.
+        or args.max_queue_depth is not None
+        or args.coalesce
+        or args.degrade_depth is not None
     )
     if not gateway_mode and Path(args.trace).is_file():
         from ..gateway.traces import is_gateway_trace
@@ -979,6 +1015,9 @@ def _serve_gateway(args) -> int:
         scheduler_kwargs=scheduler_kwargs,
         tracer=tracer,
         flight=flight,
+        max_queue_depth=args.max_queue_depth,
+        coalesce=args.coalesce,
+        degrade_depth=args.degrade_depth,
     )
     try:
         if args.resume:
@@ -1115,6 +1154,20 @@ def _serve_gateway(args) -> int:
             "health": gw.healthz(),
             "metrics": mx,
         }
+        if (
+            args.max_queue_depth is not None
+            or args.coalesce
+            or args.degrade_depth is not None
+        ):
+            summary["gateway"]["events_shed"] = mx["counters"].get(
+                "events_shed", 0
+            )
+            summary["gateway"]["events_coalesced"] = totals.get(
+                "events_coalesced", 0
+            )
+            summary["gateway"]["spec_near_hits"] = totals.get(
+                "spec_near_hit", 0
+            )
         if not multi:
             summary["drift_warm_share"] = round(
                 drift_warm_share(gw.scheduler("default").metrics), 4
@@ -1250,6 +1303,191 @@ def _chaos_to_replay_report(chaos, sched):
         structural_uncertified=uncert,
         failed_ticks=sched.metrics.counters["tick_failed"],
     )
+
+
+def build_overload_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solver overload",
+        description="replay an open-loop arrival schedule against the "
+        "gateway (events fire at their scheduled time — lateness "
+        "accumulates, the generator never throttles) and report "
+        "scheduled-time latency, sheds, coalesces and goodput; see "
+        "distilp_tpu.traffic and README 'Overload & admission control'",
+    )
+    p.add_argument(
+        "--trace",
+        required=True,
+        help="open-loop JSONL schedule (fleet-tagged, timestamped; "
+        "tests/traces/openloop_*.jsonl are committed seeded captures, "
+        "traffic.generate_openloop_schedule makes new ones)",
+    )
+    p.add_argument(
+        "--profile", "-p", required=True,
+        help="profile folder; model_profile.json is the served model",
+    )
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument(
+        "--time-scale",
+        type=float,
+        default=1.0,
+        help="compress (<1) or dilate (>1) the schedule's timeline: the "
+        "same committed capture replays in real time or as a saturating "
+        "flood, deterministically",
+    )
+    p.add_argument("--k-candidates", default=None)
+    p.add_argument("--mip-gap", type=float, default=1e-3)
+    p.add_argument("--kv-bits", default="4bit")
+    p.add_argument("--warmup", type=int, default=2,
+                   help="closed-loop warmup events per fleet (cold solve "
+                   "+ jit compile, excluded from the open-loop phase)")
+    p.add_argument(
+        "--max-queue-depth", type=int, default=None, metavar="N",
+        help="admission gate: shed events arriving at a queue holding N",
+    )
+    p.add_argument(
+        "--coalesce", action="store_true",
+        help="fold same-shard queued drift events into one solve",
+    )
+    p.add_argument(
+        "--degrade-depth", type=int, default=None, metavar="N",
+        help="queue depth past which speculative shards may serve a "
+        "banked near-match (mode='spec_near'); pair with --speculate",
+    )
+    p.add_argument(
+        "--speculate", action="store_true",
+        help="enable speculative replanning on every shard (the bank "
+        "degraded-mode serving draws from)",
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 unless the admission contract holds: every shed "
+        "counted AND flight-recorded with reconciling per-fleet indices, "
+        "every served placement structurally valid, no failed ticks",
+    )
+    p.add_argument(
+        "--expect-sheds", action="store_true",
+        help="with --check: additionally fail if NOTHING was shed (the "
+        "smoke must actually overload the gate it is testing)",
+    )
+    p.add_argument(
+        "--expect-coalesced", action="store_true",
+        help="with --check: additionally fail if nothing was coalesced",
+    )
+    p.add_argument(
+        "--expect-no-sheds", action="store_true",
+        help="with --check: additionally fail if ANYTHING was shed (the "
+        "coalesce smoke's contract: the flood folds instead of shedding)",
+    )
+    p.add_argument("--metrics-out", default=None,
+                   help="write the report JSON here too")
+    p.add_argument("--quiet", action="store_true", help="summary line only")
+    return p
+
+
+def overload_main(argv=None) -> int:
+    """``solver overload``: open-loop schedule -> gateway, admission on."""
+    args = build_overload_parser().parse_args(argv)
+
+    from ..axon_guard import force_cpu_if_env_requested
+
+    force_cpu_if_env_requested()
+
+    from ..common import load_model_profile
+    from ..obs import FlightRecorder
+    from ..traffic import read_openloop_trace, run_openloop
+
+    folder = Path(args.profile)
+    model_path = (
+        folder / "model_profile.json" if folder.is_dir() else folder
+    )
+    if not model_path.is_file():
+        print(f"error: no model profile at {model_path}", file=sys.stderr)
+        return 2
+    model = load_model_profile(model_path)
+    try:
+        specs, items = read_openloop_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot parse open-loop trace: {e}", file=sys.stderr)
+        return 2
+    if not items:
+        print("error: schedule has no events", file=sys.stderr)
+        return 2
+    k_candidates = None
+    if args.k_candidates:
+        k_candidates = [
+            int(x) for x in args.k_candidates.split(",") if x.strip()
+        ]
+    # A recorder is always attached here: the --check reconciliation is
+    # the point of the command, and sheds must be observable to audit.
+    flight = FlightRecorder(capacity=max(256, 2 * len(items)))
+    report = run_openloop(
+        model,
+        specs,
+        items,
+        args.workers,
+        time_scale=args.time_scale,
+        warmup_per_fleet=args.warmup,
+        k_candidates=k_candidates,
+        mip_gap=args.mip_gap,
+        kv_bits=args.kv_bits,
+        scheduler_kwargs=(
+            {"speculative": True} if args.speculate else None
+        ),
+        max_queue_depth=args.max_queue_depth,
+        coalesce=args.coalesce,
+        degrade_depth=args.degrade_depth,
+        flight=flight,
+    )
+    print(json.dumps(report))
+    if args.metrics_out:
+        Path(args.metrics_out).write_text(json.dumps(report, indent=2))
+    if not args.quiet:
+        print(
+            f"open-loop: {report['offered']} offered @ "
+            f"{report['offered_eps']} ev/s -> {report['served']} served "
+            f"({report['goodput_eps']} ev/s goodput), "
+            f"{report['shed']} shed, {report['events_coalesced']} "
+            f"coalesced, p99 {report['p99_ms']} ms / p99.9 "
+            f"{report['p999_ms']} ms",
+            file=sys.stderr,
+        )
+    if args.check:
+        problems = list(report.get("shed_violations", []))
+        if report["shed"] != report["events_shed"]:
+            problems.append(
+                f"shed accounting: executor saw {report['shed']} "
+                f"QueueFull raises but events_shed={report['events_shed']}"
+            )
+        if report["invalid"]:
+            problems.append(
+                f"{report['invalid']} served placement(s) structurally "
+                "invalid"
+            )
+        if report["failed"]:
+            problems.append(f"{report['failed']} tick(s) failed under load")
+        if args.expect_sheds and report["shed"] == 0:
+            problems.append(
+                "expected sheds but nothing was shed (the smoke did not "
+                "overload the admission gate)"
+            )
+        if args.expect_coalesced and report["events_coalesced"] == 0:
+            problems.append("expected coalescing but nothing was folded")
+        if args.expect_no_sheds and report["shed"]:
+            problems.append(
+                f"expected zero sheds but {report['shed']} event(s) were "
+                "shed (the flood should have folded, not overflowed)"
+            )
+        if problems:
+            for pmsg in problems:
+                print(f"overload violation: {pmsg}", file=sys.stderr)
+            return 1
+        print(
+            f"overload OK: {report['shed']} shed (reconciled record-by-"
+            f"record), {report['events_coalesced']} coalesced, "
+            f"{report['served']} served valid", file=sys.stderr,
+        )
+    return 0
 
 
 def build_spans_parser() -> argparse.ArgumentParser:
@@ -1515,6 +1753,8 @@ def main(argv=None) -> int:
         return spans_main(argv[1:])
     if argv and argv[0] == "diagnose":
         return diagnose_main(argv[1:])
+    if argv and argv[0] == "overload":
+        return overload_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     from ..axon_guard import force_cpu_if_env_requested

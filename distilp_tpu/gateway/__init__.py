@@ -25,7 +25,13 @@ MANY fleets at once (ROADMAP open item 2):
 Stdlib + the existing solver stack only — no new dependencies.
 """
 
-from .gateway import Gateway, ShardFacade, view_to_dict
+from .gateway import (
+    FleetReadView,
+    Gateway,
+    QueueFull,
+    ShardFacade,
+    view_to_dict,
+)
 from .http import GatewayHTTPServer
 from .loadgen import run_loadgen
 from .router import ConsistentHashRouter, shard_key
@@ -42,10 +48,13 @@ from .traces import (
     read_gateway_trace,
     write_gateway_trace,
 )
-from .worker import ShardWorker
+from .worker import ShardWorker, WorkerQueueFull
 
 __all__ = [
     "Gateway",
+    "QueueFull",
+    "FleetReadView",
+    "WorkerQueueFull",
     "ShardFacade",
     "view_to_dict",
     "GatewayHTTPServer",

@@ -24,11 +24,13 @@ margin anchors intact, so the first tick after a restore rides warm
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 from ..common import DeviceProfile, ModelProfile
 from ..obs.trace import NOOP_TRACER
+from ..sched.events import STRUCTURAL_KINDS
 from ..sched.metrics import (
     HEALTH_BROKEN,
     HEALTH_DEGRADED,
@@ -38,7 +40,7 @@ from ..sched.metrics import (
 from ..sched.scheduler import PlacementView, Scheduler
 from .router import ConsistentHashRouter, shard_key
 from .snapshot import GatewaySnapshot, ShardSnapshot
-from .worker import ShardWorker
+from .worker import ShardWorker, WorkerQueueFull
 
 # Counters aggregated across shards into the gateway metrics snapshot —
 # the serving-tier dashboard without grepping per-shard dumps.
@@ -61,7 +63,54 @@ _AGGREGATED_SHARD_COUNTERS = (
     "spec_stale",
     "spec_presolve",
     "spec_presolve_failed",
+    "spec_near_hit",
+    "spec_near_miss",
+    "events_coalesced",
 )
+
+
+class QueueFull(Exception):
+    """An event was shed at the admission gate (the HTTP tier's 429).
+
+    Raised by ingest when the owning worker's bounded queue is full.
+    ``retry_after_s`` is the backoff hint a client should honor (HTTP
+    ``Retry-After``): the observed queue depth times the gateway's recent
+    mean event-to-placement latency — roughly when the present backlog
+    will have drained. Every raise was already counted (``events_shed``)
+    and flight-recorded before it left the gateway.
+    """
+
+    def __init__(
+        self, fleet_id: str, depth: int, retry_after_s: float
+    ):
+        super().__init__(
+            f"fleet {fleet_id!r}: worker queue full ({depth} queued); "
+            f"retry after {retry_after_s:.3f}s"
+        )
+        self.fleet_id = fleet_id
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+class FleetReadView(NamedTuple):
+    """A shard fleet's state captured in ONE worker-side closure.
+
+    What ``ShardFacade.fleet`` hands to sequential harnesses: membership,
+    model and seq observed at a tick boundary of the owning worker's
+    timeline (never mid-tick), plus the published placement's seq from
+    the same instant — so a reader can assert tick-boundary consistency
+    (``seq == published_seq`` on a clean trace) even under live ingest.
+    Device profiles are the live objects (chaos injection deep-copies
+    before mutating); the dict itself is a snapshot copy.
+    """
+
+    seq: int
+    model: object
+    devices: Dict[str, object]
+    published_seq: Optional[int]
+
+    def device_list(self) -> list:
+        return list(self.devices.values())
 
 
 class Gateway:
@@ -83,6 +132,9 @@ class Gateway:
         metrics: Optional[SchedulerMetrics] = None,
         tracer=None,
         flight=None,
+        max_queue_depth: Optional[int] = None,
+        coalesce: bool = False,
+        degrade_depth: Optional[int] = None,
     ):
         # Library entry point that dispatches backend work (via the
         # schedulers it builds): arm the axon-wedge guard exactly like
@@ -117,6 +169,44 @@ class Gateway:
         # resume point a trace replay skips to after a restore.
         self._handled: Dict[str, int] = {}
         self._closed = False
+        # -- admission control (README "Overload & admission control").
+        # All knobs default OFF: ingest below then routes through the
+        # exact pre-admission path — no depth reads, no new counters, no
+        # pending buffers (byte-identical serving, pinned by test).
+        #
+        #   max_queue_depth — bound on a worker's command queue; an event
+        #       arriving at a full queue is SHED: counted, flight-recorded
+        #       and raised as QueueFull (HTTP 429 + Retry-After);
+        #   coalesce       — drift events queued for the same shard fold
+        #       into ONE solve at the newest state (structural events are
+        #       barriers); the queue holds at most one tick closure per
+        #       shard, so bursts compress instead of queueing;
+        #   degrade_depth  — depth at which ingest marks the tick as
+        #       under PRESSURE: a speculative shard whose exact bank probe
+        #       misses may serve a certified near-match (mode='spec_near')
+        #       instead of queueing a solve past its deadline.
+        self.max_queue_depth = max_queue_depth
+        self.coalesce = coalesce
+        self.degrade_depth = degrade_depth
+        self._admission = bool(
+            max_queue_depth is not None
+            or coalesce
+            or degrade_depth is not None
+        )
+        # Pending coalesce batches: shard key -> the batch dict its queued
+        # drain closure will consume. Guarded by one lock (ingest may come
+        # from the asyncio loop AND sync callers on other threads).
+        self._admission_lock = threading.Lock()
+        self._pending: Dict[str, dict] = {}
+        # Per-fleet shed counters + monotone per-fleet shed index: the
+        # record-by-record reconciliation key (each shed flight record
+        # carries its index, so counter vs records can be audited even
+        # after the bounded ring overflowed). Own lock — _shed runs inside
+        # _submit_coalesced's admission-lock hold, so it cannot share it.
+        self._shed_lock = threading.Lock()
+        self._shed_counts: Dict[str, int] = {}
+        # EWMA of event->placement ms, the Retry-After estimate's input.
+        self._serve_ewma_ms: Optional[float] = None
 
     # -- shard lifecycle ---------------------------------------------------
 
@@ -200,15 +290,59 @@ class Gateway:
 
         Main-thread reads are only sound while the owning worker is
         quiescent (sequential replay, post-drain inspection, chaos
-        arming) — event ticks always go through the worker queue.
+        arming) — event ticks always go through the worker queue. For
+        reads that must be sound under LIVE ingest, use ``read_shard``.
         """
         key, worker = self._lookup(fleet_id)
         return worker.shards[key]
 
+    def read_shard(self, fleet_id: str, fn: Callable):
+        """Run ``fn(scheduler)`` as a queued closure ON the owning worker.
+
+        The sound way to read a shard under live ingest: the closure runs
+        behind every queued tick, so whatever ``fn`` computes is observed
+        at a tick boundary of that shard's timeline — never mid-tick.
+        (``ShardFacade``'s ``.fleet``/``.metrics`` reads route through
+        here; the PR 7 facade read caller-side and was only sound while
+        the worker was quiescent.) Blocks for the round trip.
+        """
+        key, worker = self._lookup(fleet_id)
+        return worker.call(lambda: fn(worker.shards[key]))
+
     # -- ingest ------------------------------------------------------------
 
+    def configure_admission(
+        self,
+        max_queue_depth: Optional[int] = None,
+        coalesce: bool = False,
+        degrade_depth: Optional[int] = None,
+    ) -> None:
+        """Reconfigure the admission knobs (see ``__init__``).
+
+        Call on a quiescent gateway only (between arms of a bench sweep,
+        after a warmup phase): ingest reads the knobs without a lock, and
+        flipping them mid-flight would split one burst across two
+        policies. All-default arguments turn admission OFF — back to the
+        byte-identical pre-admission ingest path.
+        """
+        with self._admission_lock:
+            if self._pending:
+                raise RuntimeError(
+                    "cannot reconfigure admission with coalesce batches "
+                    "pending (the gateway is not quiescent)"
+                )
+            self.max_queue_depth = max_queue_depth
+            self.coalesce = coalesce
+            self.degrade_depth = degrade_depth
+            self._admission = bool(
+                max_queue_depth is not None
+                or coalesce
+                or degrade_depth is not None
+            )
+
     def _tick_closure(
-        self, fleet_id: str, key: str, worker, event, parent=None, t_enq=None
+        self, fleet_id: str, key: str, worker, event, parent=None,
+        t_enq=None, pressure: bool = False, depth: Optional[int] = None,
     ):
         """The queued unit of ingest: tick the shard AND advance the
         fleet's resume cursor, both ON the worker thread. The cursor must
@@ -223,16 +357,22 @@ class Gateway:
         worker thread is recording the **queue-wait span** — submit to
         pickup, the number that diagnoses worker thrash — and attaching
         the ingest context so the tick's own spans parent under it. With
-        tracing off both are shared no-ops (parent is None).
+        tracing off both are shared no-ops (parent is None). ``depth`` is
+        the queue depth observed at enqueue (the admission-control input),
+        attached to the queue-wait span when tracing is on; ``pressure``
+        rides through to the scheduler's degraded-serving seam.
         """
 
         def _do() -> PlacementView:
+            attrs = {"worker": worker.worker_id}
+            if depth is not None:
+                attrs["depth"] = depth
             self.tracer.record_span(
                 "gateway.queue_wait",
                 t_enq if t_enq is not None else 0.0,
                 None,
                 parent=parent,
-                attrs={"worker": worker.worker_id},
+                attrs=attrs,
             )
             with self.tracer.attach(parent):
                 # finally, not on success: a raising handle() may still
@@ -242,6 +382,10 @@ class Gateway:
                 # rejected-and-raised event too only skips a repeat
                 # rejection on resume — always safe.
                 try:
+                    if pressure:
+                        return worker.shards[key].handle(
+                            event, pressure=True
+                        )
                     return worker.shards[key].handle(event)
                 finally:
                     self._handled[fleet_id] = (
@@ -250,12 +394,227 @@ class Gateway:
 
         return _do
 
+    def _submit_tick(
+        self, fleet_id: str, key: str, worker, event, parent, t_enq,
+        on_done=None,
+    ):
+        """Route one event through the admission gate onto its worker.
+
+        Returns the ``(box, done)`` pair the waiter resolves on. With
+        admission OFF this is exactly the pre-admission submit — no depth
+        reads beyond the traced span's, no new code paths. With it on:
+
+        - a full queue (``max_queue_depth``) sheds the event — counted,
+          flight-recorded, raised as ``QueueFull`` (the bound itself is
+          enforced inside ``ShardWorker.submit`` under its lock, so racing
+          submitters cannot overshoot it);
+        - past ``degrade_depth`` the tick is marked under pressure
+          (degraded-mode serving from the speculation bank);
+        - with ``coalesce`` on, drift events for a shard that already has
+          a queued-but-unstarted tick closure JOIN that closure's batch
+          instead of queueing their own — the shard solves once, at the
+          newest state, and every waiter gets that view. Structural
+          events are barriers: they detach the open batch (its closure
+          still drains exactly the events that joined before the barrier)
+          and queue behind it, preserving per-fleet order.
+        """
+        depth: Optional[int] = None
+        if self._admission or self.tracer.enabled:
+            depth = worker.depth()
+        if not self._admission:
+            return worker.submit(
+                self._tick_closure(
+                    fleet_id, key, worker, event,
+                    parent=parent, t_enq=t_enq, depth=depth,
+                ),
+                on_done,
+            )
+        pressure = (
+            self.degrade_depth is not None and depth >= self.degrade_depth
+        )
+        structural = getattr(event, "kind", None) in STRUCTURAL_KINDS
+        if self.coalesce and not structural:
+            return self._submit_coalesced(
+                fleet_id, key, worker, event, parent, t_enq,
+                pressure, depth, on_done,
+            )
+        if self.coalesce and structural:
+            # Barrier: later drift must not join a batch whose closure
+            # was enqueued BEFORE this structural event — that would
+            # reorder it ahead. Pop AND submit under ONE lock hold: with
+            # the lock released in between, a racing drift ingest could
+            # open (and submit) a fresh batch that lands in the worker
+            # FIFO ahead of this structural closure — exactly the
+            # reordering the barrier exists to rule out. The detached
+            # batch still drains exactly the events that joined it.
+            closure = self._tick_closure(
+                fleet_id, key, worker, event,
+                parent=parent, t_enq=t_enq, pressure=pressure, depth=depth,
+            )
+            with self._admission_lock:
+                self._pending.pop(key, None)
+                try:
+                    return worker.submit(
+                        closure, on_done, bound=self.max_queue_depth
+                    )
+                except WorkerQueueFull as e:
+                    raise self._shed(
+                        fleet_id, event, worker, e.depth
+                    ) from None
+        closure = self._tick_closure(
+            fleet_id, key, worker, event,
+            parent=parent, t_enq=t_enq, pressure=pressure, depth=depth,
+        )
+        try:
+            return worker.submit(closure, on_done, bound=self.max_queue_depth)
+        except WorkerQueueFull as e:
+            raise self._shed(fleet_id, event, worker, e.depth) from None
+
+    def _submit_coalesced(
+        self, fleet_id, key, worker, event, parent, t_enq,
+        pressure, depth, on_done,
+    ):
+        box: dict = {}
+        done = threading.Event()
+        with self._admission_lock:
+            batch = self._pending.get(key)
+            if batch is not None:
+                # Joining an open batch queues NOTHING: the burst
+                # compresses into the one already-queued solve (this is
+                # why a coalescing gateway's queue depth stays ~#shards
+                # under a same-shard flood).
+                batch["events"].append(event)
+                batch["waiters"].append((box, done, on_done))
+                batch["pressure"] = batch["pressure"] or pressure
+                return box, done
+            batch = {
+                "events": [event],
+                "waiters": [(box, done, on_done)],
+                "pressure": pressure,
+            }
+            self._pending[key] = batch
+            closure = self._batch_closure(
+                fleet_id, key, worker, batch, parent, t_enq, depth
+            )
+            # Submit INSIDE the admission lock: once the batch is in
+            # _pending another ingest thread may join it, and a joined
+            # waiter must never be stranded by this submit shedding —
+            # under the lock, join and shed cannot interleave. (Lock
+            # order admission->submit is taken nowhere in reverse;
+            # _shed's own counting uses the separate _shed_lock.)
+            try:
+                worker.submit(closure, bound=self.max_queue_depth)
+            except WorkerQueueFull as e:
+                del self._pending[key]
+                raise self._shed(
+                    fleet_id, event, worker, e.depth
+                ) from None
+        return box, done
+
+    def _batch_closure(
+        self, fleet_id, key, worker, batch, parent, t_enq, depth
+    ):
+        """The queued drain of one coalesce batch: runs on the worker
+        thread, detaches the batch (late joiners up to this instant are
+        included — maximal coalescing), ticks the shard ONCE via the
+        scheduler's coalescing hook, and resolves every waiter with the
+        one resulting view. The resume cursor advances by the whole batch
+        inside the closure, same consistency argument as
+        ``_tick_closure``."""
+
+        def _do() -> None:
+            with self._admission_lock:
+                if self._pending.get(key) is batch:
+                    del self._pending[key]
+                events = list(batch["events"])
+                waiters = list(batch["waiters"])
+                pressure = batch["pressure"]
+            attrs = {"worker": worker.worker_id, "batch": len(events)}
+            if depth is not None:
+                attrs["depth"] = depth
+            self.tracer.record_span(
+                "gateway.queue_wait",
+                t_enq if t_enq is not None else 0.0,
+                None,
+                parent=parent,
+                attrs=attrs,
+            )
+            shared: dict = {}
+            with self.tracer.attach(parent):
+                try:
+                    shared["result"] = worker.shards[key].handle_coalesced(
+                        events, pressure=pressure
+                    )
+                except BaseException as e:
+                    # Counted here (not re-raised to the worker loop): the
+                    # waiters below are the real consumers and each gets
+                    # the exception; the worker's own box has no reader.
+                    self.metrics.inc("worker_exception")
+                    shared["exc"] = e
+                finally:
+                    self._handled[fleet_id] = (
+                        self._handled.get(fleet_id, 0) + len(events)
+                    )
+                    for w_box, w_done, w_on_done in waiters:
+                        w_box.update(shared)
+                        w_done.set()
+                        if w_on_done is not None:
+                            try:
+                                w_on_done(w_box)
+                            except Exception:
+                                # Same contract as ShardWorker._run: a
+                                # dead completion callback must not kill
+                                # the worker thread.
+                                self.metrics.inc("worker_callback_error")
+
+        return _do
+
+    def _shed(self, fleet_id: str, event, worker, depth: int) -> QueueFull:
+        """Account one shed, then hand back the exception to raise.
+
+        Every shed is (1) counted — ``events_shed`` plus the per-fleet
+        tally ``shed_counts()`` — and (2) flight-recorded with a monotone
+        per-fleet ``shed_index``, so counters and records reconcile
+        record by record even after the bounded ring overflows (the
+        contract ``traffic.shed_violations`` audits). ``retry_after_s``
+        estimates when the backlog drains: depth x the EWMA of recent
+        event-to-placement latency.
+        """
+        self.metrics.inc("events_shed")
+        with self._shed_lock:
+            idx = self._shed_counts.get(fleet_id, 0) + 1
+            self._shed_counts[fleet_id] = idx
+        ewma_ms = self._serve_ewma_ms
+        retry_after = min(
+            30.0, max(0.05, depth * ((ewma_ms or 1000.0) / 1e3))
+        )
+        if self.flight is not None:
+            self.flight.record(
+                fleet_id,
+                {
+                    "shed": True,
+                    "shed_index": idx,
+                    "fleet": fleet_id,
+                    "kind": getattr(event, "kind", type(event).__name__),
+                    "worker": worker.worker_id,
+                    "depth": depth,
+                    "retry_after_s": round(retry_after, 4),
+                },
+            )
+        return QueueFull(fleet_id, depth, retry_after)
+
+    def shed_counts(self) -> Dict[str, int]:
+        """Per-fleet shed tallies (reconciled against flight records)."""
+        with self._shed_lock:
+            return dict(self._shed_counts)
+
     def handle_event(self, fleet_id: str, event) -> PlacementView:
         """Apply one event to its fleet's shard; blocks for the view.
 
         Latency observed here (``gateway_event_to_placement``) includes
         the queue wait on the owning worker — the number a client sees,
-        not just the solve.
+        not just the solve. Raises ``QueueFull`` when admission control
+        sheds the event (already counted and flight-recorded).
         """
         span = self.tracer.start_span(
             "gateway.ingest", parent=None, attrs={"fleet": fleet_id}
@@ -270,12 +629,14 @@ class Gateway:
                 parent=span.context(),
                 attrs={"shard": key, "worker": worker.worker_id},
             )
-            view = worker.call(
-                self._tick_closure(
-                    fleet_id, key, worker, event,
-                    parent=span.context(), t_enq=t0 * 1e3,
-                )
+            box, done = self._submit_tick(
+                fleet_id, key, worker, event,
+                parent=span.context(), t_enq=t0 * 1e3,
             )
+            done.wait()
+            if "exc" in box:
+                raise box["exc"]
+            view = box["result"]
             self._note_handled(worker, t0)
             return view
         finally:
@@ -321,11 +682,9 @@ class Gateway:
                 else:
                     fut.set_result(box["result"])
 
-            worker.submit(
-                self._tick_closure(
-                    fleet_id, key, worker, event,
-                    parent=span.context(), t_enq=t0 * 1e3,
-                ),
+            self._submit_tick(
+                fleet_id, key, worker, event,
+                parent=span.context(), t_enq=t0 * 1e3,
                 on_done=lambda box: loop.call_soon_threadsafe(_resolve, box),
             )
             view = await fut
@@ -341,6 +700,14 @@ class Gateway:
         self.metrics.inc("gateway_events")
         self.metrics.inc(f"worker_{worker.worker_id}_events")
         self.metrics.observe("gateway_event_to_placement", ms)
+        if self._admission:
+            # Retry-After's input: a cheap EWMA of what one event costs
+            # end to end. Racy float write, deliberately unlocked — it is
+            # a backoff hint, not an accounting counter.
+            prev = self._serve_ewma_ms
+            self._serve_ewma_ms = (
+                ms if prev is None else 0.9 * prev + 0.1 * ms
+            )
 
     def latest(self, fleet_id: str) -> PlacementView:
         """The fleet's most recent published placement (via its worker, so
@@ -446,6 +813,15 @@ class Gateway:
             entries,
             gateway_counters=gw["counters"],
             gateway_latency=gw["latency"],
+            # Live queue depth per worker: THE admission-control input as
+            # a labeled gauge, next to the counters it explains (a scrape
+            # that sees events_shed climbing reads the depth that caused
+            # it in the same exposition).
+            worker_gauges={
+                "worker_queue_depth": {
+                    str(w.worker_id): w.depth() for w in self.workers
+                }
+            },
         )
 
     def flight_snapshot(self, fleet_id: str) -> List[dict]:
@@ -571,18 +947,24 @@ class ShardFacade:
     ``sched.faults.chaos_replay``) drive a scheduler-shaped object:
     ``handle``/``latest``/``metrics``/``fleet``/``health``/``fault_hook``.
     This facade routes ``handle`` through the owning worker's queue (so
-    the multi-worker path is what is actually exercised) and delegates
-    the rest to the live scheduler — sound because those harnesses are
-    strictly sequential, so the worker is quiescent at every read.
+    the multi-worker path is what is actually exercised) and — fixing the
+    PR 7 quiescence hazard — routes every READ through a queued
+    worker-side closure too (``Gateway.read_shard``), so harness reads
+    are sound under live ingest, not only while the worker is quiescent:
+    a read lands behind every queued tick and observes the shard at a
+    tick boundary. ``.fleet`` returns a ``FleetReadView`` captured in one
+    closure (seq, model, membership AND the published seq from the same
+    instant — the consistency the concurrent-ingest test pins);
+    ``.metrics`` returns the live thread-safe sink, the round trip being
+    the sequencing point.
     """
 
     def __init__(self, gateway: Gateway, fleet_id: str):
         object.__setattr__(self, "_gw", gateway)
         object.__setattr__(self, "_fleet", fleet_id)
 
-    @property
-    def _sched(self) -> Scheduler:
-        return self._gw.scheduler(self._fleet)
+    def _read(self, fn):
+        return self._gw.read_shard(self._fleet, fn)
 
     def handle(self, event) -> PlacementView:
         return self._gw.handle_event(self._fleet, event)
@@ -591,41 +973,50 @@ class ShardFacade:
         return self._gw.latest(self._fleet)
 
     def metrics_snapshot(self) -> dict:
-        return self._sched.metrics_snapshot()
+        return self._read(lambda s: s.metrics_snapshot())
 
     def health_snapshot(self) -> dict:
-        return self._sched.health_snapshot()
+        return self._read(lambda s: s.health_snapshot())
 
     def close(self) -> None:
         """No-op: the gateway owns worker/scheduler lifecycle."""
 
     @property
     def metrics(self):
-        return self._sched.metrics
+        return self._read(lambda s: s.metrics)
 
     @property
-    def fleet(self):
-        return self._sched.fleet
+    def fleet(self) -> FleetReadView:
+        def _capture(s: Scheduler) -> FleetReadView:
+            pub = s._published
+            return FleetReadView(
+                seq=s.fleet.seq,
+                model=s.fleet.model,
+                devices=dict(s.fleet.devices),
+                published_seq=None if pub is None else pub.seq,
+            )
+
+        return self._read(_capture)
 
     @property
     def health(self):
-        return self._sched.health
+        return self._read(lambda s: s.health)
 
     @property
     def quarantined(self):
-        return self._sched.quarantined
+        return self._read(lambda s: list(s.quarantined))
 
     @property
     def fault_hook(self):
-        return self._sched.fault_hook
+        return self._read(lambda s: s.fault_hook)
 
     def __setattr__(self, name, value):
         # chaos_replay installs its injector via `scheduler.fault_hook =`;
-        # forward that one write to the live scheduler (the worker only
-        # READS the hook, inside a tick this sequential caller isn't
-        # running) — everything else stays local.
+        # forward that one write to the live scheduler as a queued
+        # closure (serialized behind in-flight ticks, like the reads) —
+        # everything else stays local.
         if name == "fault_hook":
-            self._sched.fault_hook = value
+            self._read(lambda s: setattr(s, "fault_hook", value))
         else:
             object.__setattr__(self, name, value)
 

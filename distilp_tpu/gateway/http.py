@@ -4,7 +4,11 @@ Five routes:
 
     POST /events                 {"fleet": <id>, "event": {<sched.events>}}
                                  -> 200 {"view": {...}} after the shard
-                                 ticks (the response IS the placement)
+                                 ticks (the response IS the placement), OR
+                                 429 + Retry-After when admission control
+                                 sheds the event (bounded worker queue
+                                 full; see README "Overload & admission
+                                 control")
     GET  /placement/<fleet_id>   -> 200 {"view": {...}} (latest, no solve)
     GET  /healthz                -> 200/503 per-shard health + overall
     GET  /metrics                -> 200 gateway metrics snapshot (JSON), OR
@@ -34,10 +38,11 @@ from __future__ import annotations
 
 import asyncio
 import json
+from math import ceil
 from typing import Optional, Tuple
 
 from ..obs.trace import now_ms
-from .gateway import Gateway, view_to_dict
+from .gateway import Gateway, QueueFull, view_to_dict
 
 _MAX_BODY = 8 * 1024 * 1024  # a DeviceJoin carries a full profile; 8 MB is generous
 _MAX_HEADER_LINES = 64
@@ -46,7 +51,12 @@ _JSON = "application/json"
 _PROM = "text/plain; version=0.0.4; charset=utf-8"
 
 
-def _response(status: int, payload, content_type: str = _JSON) -> bytes:
+def _response(
+    status: int,
+    payload,
+    content_type: str = _JSON,
+    extra_headers: Optional[dict] = None,
+) -> bytes:
     if isinstance(payload, (dict, list)):
         body = json.dumps(payload).encode()
     elif isinstance(payload, bytes):
@@ -56,12 +66,17 @@ def _response(status: int, payload, content_type: str = _JSON) -> bytes:
     reason = {
         200: "OK", 400: "Bad Request", 404: "Not Found",
         405: "Method Not Allowed", 409: "Conflict", 413: "Payload Too Large",
+        429: "Too Many Requests",
         500: "Internal Server Error", 503: "Service Unavailable",
     }.get(status, "OK")
+    extras = "".join(
+        f"{k}: {v}\r\n" for k, v in (extra_headers or {}).items()
+    )
     head = (
         f"HTTP/1.1 {status} {reason}\r\n"
         f"Content-Type: {content_type}\r\n"
         f"Content-Length: {len(body)}\r\n"
+        f"{extras}"
         f"Connection: close\r\n\r\n"
     )
     return head.encode() + body
@@ -98,8 +113,24 @@ class GatewayHTTPServer:
 
     async def _handle_conn(self, reader, writer) -> None:
         ctype = _JSON
+        headers = None
         try:
             status, payload, ctype = await self._dispatch(reader)
+        except QueueFull as e:
+            # Load shed at the admission gate (bounded worker queue full).
+            # The 429 contract: a parseable integer Retry-After header
+            # (RFC delta-seconds, ceiling of the gateway's drain
+            # estimate) plus the precise float in the JSON body. The shed
+            # itself was already counted and flight-recorded inside the
+            # gateway before the exception reached this tier.
+            self.gateway.metrics.inc("http_too_many_requests")
+            status, payload = 429, {
+                "error": str(e),
+                "fleet": e.fleet_id,
+                "depth": e.depth,
+                "retry_after_s": e.retry_after_s,
+            }
+            headers = {"Retry-After": str(max(1, ceil(e.retry_after_s)))}
         except (EOFError, ConnectionError) as e:
             # IncompleteReadError (an EOFError) = the client closed before
             # its advertised body arrived: a client fault, not a server
@@ -121,7 +152,7 @@ class GatewayHTTPServer:
             self.gateway.metrics.inc("http_internal_error")
             status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
         try:
-            writer.write(_response(status, payload, ctype))
+            writer.write(_response(status, payload, ctype, headers))
             await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
             self.gateway.metrics.inc("http_client_gone")

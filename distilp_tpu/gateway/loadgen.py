@@ -12,6 +12,14 @@ serialization), cross-fleet parallelism bounded only by the workers.
 Reported: sustained ``events_per_sec`` over the timed phase, p50/p99
 event→placement latency (queue wait INCLUDED — it is what a client
 sees), per-worker event counts, and failure/certification tallies.
+
+This harness is CLOSED-loop by construction: each fleet's next event
+waits for the previous placement, so offered load can never exceed
+capacity and the numbers here are throughput at-or-below saturation.
+The OPEN-loop side — timestamped arrival schedules fired regardless of
+completion, against the gateway's admission control — lives in
+``distilp_tpu.traffic`` (``execute_openloop`` reuses this module's
+``replay_concurrent`` for its closed-loop capacity probe).
 ``bench.py``'s gateway section runs this at K ∈ {10, 100} through
 1/2/4 workers and derives the scaling ratio; on a box with C cores the
 honest ceiling is min(workers, C)×, so read the ratio next to the

@@ -26,6 +26,24 @@ from ..sched.metrics import SchedulerMetrics
 from ..sched.scheduler import Scheduler
 
 
+class WorkerQueueFull(Exception):
+    """A bounded ``submit`` found the worker's queue at its limit.
+
+    Deliberately NOT a RuntimeError: the HTTP tier maps RuntimeError to
+    409 (retriable server state) and this to 429 + Retry-After — an
+    aliasing subclass would silently misclassify sheds. Carries the depth
+    observed under the submit lock (the authoritative reading — a racing
+    caller-side ``depth()`` probe is advisory only).
+    """
+
+    def __init__(self, worker_id: int, depth: int):
+        super().__init__(
+            f"worker {worker_id} queue is full ({depth} queued)"
+        )
+        self.worker_id = worker_id
+        self.depth = depth
+
+
 class ShardWorker:
     """One solve thread + the shards it owns (shard_key -> Scheduler)."""
 
@@ -51,7 +69,10 @@ class ShardWorker:
     # -- the queue protocol ------------------------------------------------
 
     def submit(
-        self, fn: Callable, on_done: Optional[Callable[[dict], None]] = None
+        self,
+        fn: Callable,
+        on_done: Optional[Callable[[dict], None]] = None,
+        bound: Optional[int] = None,
     ):
         """Enqueue ``fn`` for the worker thread.
 
@@ -61,12 +82,25 @@ class ShardWorker:
         asyncio ingest path uses it to resolve a loop future via
         ``call_soon_threadsafe`` instead of parking an executor thread per
         in-flight event.
+
+        ``bound`` is the admission gate: when the queue already holds that
+        many commands, raise ``WorkerQueueFull`` instead of enqueueing.
+        The check runs under the submit lock, so the bound cannot be
+        overshot by racing submitters — this is where load shedding is
+        DECIDED; the gateway turns the raise into a counted, flight-
+        recorded 429. Control-plane submits (health probes, snapshots,
+        stop) pass no bound: observability must stay answerable exactly
+        when the queue is full.
         """
         box: dict = {}
         done = threading.Event()
         with self._submit_lock:
             if self._stopped:
                 raise RuntimeError(f"worker {self.worker_id} is stopped")
+            if bound is not None:
+                depth = self._q.qsize()
+                if depth >= bound:
+                    raise WorkerQueueFull(self.worker_id, depth)
             self._q.put((fn, box, done, on_done))
         return box, done
 

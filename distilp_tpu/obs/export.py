@@ -234,6 +234,7 @@ def render_prometheus(
     shards: List[dict],
     gateway_counters: Optional[dict] = None,
     gateway_latency: Optional[dict] = None,
+    worker_gauges: Optional[Dict[str, Dict[str, float]]] = None,
 ) -> str:
     """Prometheus v0.0.4 text for gateway + per-shard scheduler metrics.
 
@@ -243,6 +244,9 @@ def render_prometheus(
     same gateway stay distinguishable in one scrape. Gateway-level
     counters render unlabeled, except the ``worker_<i>_events`` family,
     which folds into one ``worker_events`` metric with a ``worker`` label.
+    ``worker_gauges`` maps gauge name -> {worker id -> live value} (e.g.
+    ``worker_queue_depth``, the admission-control input), rendered as one
+    ``worker``-labeled gauge per name.
     """
     doc = _PromDoc()
     for entry in shards:
@@ -278,6 +282,9 @@ def render_prometheus(
             doc.add(name, value, {})
     for name, snap in sorted((gateway_latency or {}).items()):
         doc.add_summary(name, snap, {})
+    for name, per_worker in sorted((worker_gauges or {}).items()):
+        for worker_id, value in sorted(per_worker.items()):
+            doc.add(name, value, {"worker": str(worker_id)}, mtype="gauge")
     return doc.render()
 
 

@@ -63,6 +63,7 @@ from .sim import ReplayReport, generate_trace, replay
 from .speculate import (
     BankEntry,
     SpeculationBank,
+    bucket_vector,
     candidate_digest,
     instance_digest,
 )
@@ -109,4 +110,5 @@ __all__ = [
     "BankEntry",
     "instance_digest",
     "candidate_digest",
+    "bucket_vector",
 ]

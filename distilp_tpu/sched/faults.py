@@ -430,6 +430,25 @@ class ChaosReport(NamedTuple):
                     "nothing was ever banked (no presolves, no solved "
                     "ticks)"
                 )
+        # Admission-control accounting: a sequential soak drives one event
+        # at a time, so the bounded-queue gate can never legitimately fire
+        # (depth is always 0 at ingest) and coalescing can never fold
+        # events (each tick completes before the next is submitted). A
+        # nonzero shed or coalesce counter here means the serving path
+        # rejected or folded trace events it had no overload reason to —
+        # the same "counters must be explained by records" contract the
+        # quarantine accounting enforces. The record-by-record shed
+        # reconciliation under REAL overload (counter vs per-fleet flight
+        # records) lives in traffic.shed_violations, which the overload
+        # smoke and bench run.
+        for c_name in ("events_shed", "events_coalesced"):
+            stray = counters.get(c_name, 0)
+            if stray:
+                out.append(
+                    f"admission accounting: {c_name}={stray} in a "
+                    "sequential chaos soak (nothing was concurrently "
+                    "queued, so nothing could be shed or coalesced)"
+                )
         if self.ticks_to_healthy is None:
             out.append(
                 f"service did not return to healthy (final state: "

@@ -129,6 +129,11 @@ METRIC_REGISTRY = {
     "spec_stale": "Bank entries invalidated by a problem-identity change",
     "spec_presolve": "Forecast instances pre-solved into the speculation bank",
     "spec_presolve_failed": "Speculative presolve dispatches that failed",
+    # -- admission control / overload (gateway + traffic) -----------------
+    "events_shed": "Events rejected at the admission gate (429 + Retry-After)",
+    "events_coalesced": "Queued drift events folded into a newer tick's solve",
+    "spec_near_hit": "Pressure ticks served a banked near-match (mode='spec_near')",
+    "spec_near_miss": "Pressure ticks that found no banked near-match to serve",
     # -- snapshot / restore ----------------------------------------------
     "state_restored": "Scheduler warm-state restores (load_state)",
     "warm_resumes": "First post-restore ticks that rode warm (the proof)",
@@ -139,6 +144,8 @@ METRIC_REGISTRY = {
     "shards_restored": "Shards registered from a snapshot blob",
     "gateway_events": "Events ingested through the gateway",
     "worker_events": "Events routed, by worker (worker label)",
+    "worker_queue_depth": "Commands queued on a shard worker, by worker "
+    "(gauge; the admission-control input)",
     "snapshots_taken": "Gateway warm-state snapshots taken",
     "worker_exception": "Closures that raised on a shard worker thread",
     "worker_callback_error": "Completion callbacks that raised (dead loop)",
@@ -147,6 +154,7 @@ METRIC_REGISTRY = {
     "http_bad_request": "HTTP 400s (malformed request or body)",
     "http_not_found": "HTTP 404s (unknown route or fleet)",
     "http_conflict": "HTTP 409s (shard exists but nothing servable yet)",
+    "http_too_many_requests": "HTTP 429s (queue full; Retry-After returned)",
     "http_internal_error": "HTTP 500s (unexpected server-side failure)",
     # -- observability layer ----------------------------------------------
     "flight_dumps": "Flight-recorder post-mortem dumps written",

@@ -43,9 +43,19 @@ from .speculate import (
     DEFAULT_SPEC_TOLERANCE,
     BankEntry,
     SpeculationBank,
+    bucket_vector,
     candidate_digest,
     presolve_candidates,
 )
+
+# Default near-match radius for degraded-mode serving, in tolerance
+# buckets: a banked placement may serve an instance up to this many
+# tolerance steps away on its worst drift channel (~(1+tol)^radius
+# relative — ~22% at the default 5% tolerance). Wide enough to cover a
+# burst's excursion from the pre-burst instance the bank holds; narrow
+# enough that the served placement was certified on a genuinely nearby
+# problem.
+DEFAULT_SPEC_NEAR_RADIUS = 4
 
 # Solver-timings keys worth attaching to the solve span: the wall-clock
 # breakdown plus the work/engine counters that attribute a slow tick, plus
@@ -146,12 +156,15 @@ class PlacementView(NamedTuple):
     # 'cold' | 'warm' | 'margin' tick that produced it; 'spec' when the
     # speculation bank served a PRE-solved placement (certified on a
     # forecast instance within the bank's tolerance of this one — no solve
-    # ran this tick); 'risk' when the risk-aware selector served a
-    # candidate OTHER than that tick's fresh solve (a cached incumbent or
-    # per-k alternative). Under degraded serving the field is REWRITTEN on
-    # the published view: 'stale' when a deadline miss (or poisoned fleet
-    # state) re-served the last-known-good placement, 'degraded' while the
-    # open circuit breaker skips solves.
+    # ran this tick); 'spec_near' when a PRESSURE tick (gateway admission
+    # control, shard behind) served the bank's nearest certified match
+    # within spec_near_radius tolerance buckets — approximate by
+    # construction, not merely stale; 'risk' when the risk-aware selector
+    # served a candidate OTHER than that tick's fresh solve (a cached
+    # incumbent or per-k alternative). Under degraded serving the field is
+    # REWRITTEN on the published view: 'stale' when a deadline miss (or
+    # poisoned fleet state) re-served the last-known-good placement,
+    # 'degraded' while the open circuit breaker skips solves.
     mode: str
     # Problem identity at publication time. For mode == 'risk' the served
     # placement may have been SOLVED under an earlier identity/tick — the
@@ -279,6 +292,7 @@ class Scheduler:
         spec_k: int = DEFAULT_SPEC_K,
         spec_tolerance: float = DEFAULT_SPEC_TOLERANCE,
         spec_bank_size: Optional[int] = None,
+        spec_near_radius: int = DEFAULT_SPEC_NEAR_RADIUS,
         tracer=None,
         flight=None,
         flight_key: str = "default",
@@ -366,6 +380,12 @@ class Scheduler:
         self.speculative = speculative
         self.spec_k = spec_k
         self.spec_tolerance = spec_tolerance
+        # Degraded-mode serving: how far (in tolerance buckets, worst
+        # channel) a banked placement may be from the live instance and
+        # still be served under queue pressure (gateway admission control
+        # passes pressure=True; mode='spec_near'). Only consulted on
+        # pressure ticks — plain serving never near-matches.
+        self.spec_near_radius = spec_near_radius
         self.forecaster = ChurnForecaster() if speculative else None
         self.spec_bank = (
             SpeculationBank(
@@ -449,8 +469,16 @@ class Scheduler:
 
     # -- the event loop body ----------------------------------------------
 
-    def handle(self, event) -> PlacementView:
+    def handle(self, event, pressure: bool = False) -> PlacementView:
         """Apply one event and replan; returns the freshly published view.
+
+        ``pressure`` is the admission-control hint (gateway ingest sets it
+        when the owning worker's queue is past its degrade threshold): a
+        pressure tick whose exact speculation probe misses may serve a
+        banked NEAR-match (``mode='spec_near'``, see ``_spec_near_probe``)
+        instead of queueing a solve it is already late for. False (the
+        default, and the only value non-gateway callers pass) leaves the
+        tick path byte-identical.
 
         Structural events route through the warm pool under their new key;
         drift events tick the current key's replanner warm. A failed solve
@@ -483,7 +511,7 @@ class Scheduler:
             self._tick_conv = None
             view: Optional[PlacementView] = None
             try:
-                view = self._handle(event)
+                view = self._handle(event, pressure=pressure)
                 return view
             finally:
                 span.set_attr("mode", view.mode if view is not None else "error")
@@ -491,7 +519,52 @@ class Scheduler:
                     self._flight_note(event, view, span)
                 self._span = NOOP_SPAN
 
-    def _handle(self, event) -> PlacementView:
+    def handle_coalesced(
+        self, events: Sequence, pressure: bool = False
+    ) -> PlacementView:
+        """Apply a run of queued events and solve ONCE, at the newest state.
+
+        The gateway's admission-control coalescing hook: when several
+        drift events for the same shard are queued behind one solve, each
+        is still validated, quarantined-or-applied and counted exactly as
+        ``handle`` would (fleet ``seq`` advances per applied event — the
+        per-shard seq accounting the shed contract audits), but only the
+        final state pays a solve; the folded events are counted
+        ``events_coalesced``. All waiters are served the one resulting
+        view. A single-event batch IS ``handle`` — same path, same spans.
+
+        Callers coalesce drift runs (the gateway treats structural events
+        as barriers); a structural event in the batch is still handled
+        correctly — it just makes the one solve a structural tick.
+        """
+        events = list(events)
+        if not events:
+            raise ValueError("handle_coalesced needs at least one event")
+        if len(events) == 1:
+            return self.handle(events[0], pressure=pressure)
+        last = events[-1]
+        span = self.tracer.span(
+            "sched.tick",
+            attrs={
+                "kind": getattr(last, "kind", type(last).__name__),
+                "coalesced": len(events),
+            },
+        )
+        with span:
+            self._span = span
+            self._tick_exc = {}
+            self._tick_conv = None
+            view: Optional[PlacementView] = None
+            try:
+                view = self._handle_coalesced(events, pressure)
+                return view
+            finally:
+                span.set_attr("mode", view.mode if view is not None else "error")
+                if self._flight is not None:
+                    self._flight_note(last, view, span)
+                self._span = NOOP_SPAN
+
+    def _handle(self, event, pressure: bool = False) -> PlacementView:
         reason = validate_event(event)
         if reason is not None:
             return self._quarantine(event, reason)
@@ -499,6 +572,41 @@ class Scheduler:
             structural = self.fleet.apply(event)
         except (ValueError, TypeError) as e:
             return self._quarantine(event, f"{type(e).__name__}: {e}")
+        self._absorbed(event, structural)
+        return self._tick(structural=structural, pressure=pressure)
+
+    def _handle_coalesced(self, events, pressure: bool) -> PlacementView:
+        applied = 0
+        structural = False
+        for ev in events:
+            reason = validate_event(ev)
+            if reason is not None:
+                self._quarantine_note(ev, reason)
+                continue
+            try:
+                s = self.fleet.apply(ev)
+            except (ValueError, TypeError) as e:
+                self._quarantine_note(ev, f"{type(e).__name__}: {e}")
+                continue
+            self._absorbed(ev, s)
+            if applied:
+                # Every applied event beyond the first folds into the one
+                # solve below instead of paying its own.
+                self.metrics.inc("events_coalesced")
+            applied += 1
+            structural = structural or s
+        if not applied:
+            if self._published is None:
+                raise ValueError(
+                    "every coalesced event was quarantined before any "
+                    "placement was published; nothing safe to serve"
+                )
+            return self.latest()
+        return self._tick(structural=structural, pressure=pressure)
+
+    def _absorbed(self, event, structural: bool) -> None:
+        """Post-apply bookkeeping shared by the single and coalesced
+        paths: routing counters, bank invalidation, forecaster feed."""
         self.metrics.inc("events_total")
         self.metrics.inc(f"event_{event.kind}")
         self.metrics.inc("structural_events" if structural else "drift_events")
@@ -512,14 +620,13 @@ class Scheduler:
                 if stale:
                     self.metrics.inc("spec_stale", stale)
                     self._span.add_event("spec_stale", dropped=stale)
-            # APPLIED events only: the quarantine gates above already
-            # returned for poisoned/contradictory input, so a NaN drift
-            # can never corrupt the forecaster's EWMA state silently.
+            # APPLIED events only: the quarantine gates already returned
+            # for poisoned/contradictory input, so a NaN drift can never
+            # corrupt the forecaster's EWMA state silently.
             self.forecaster.observe(self.fleet)
-        return self._tick(structural=structural)
 
-    def _quarantine(self, event, reason: str) -> PlacementView:
-        """Record a rejected event and keep serving the last-known-good."""
+    def _quarantine_note(self, event, reason: str) -> None:
+        """Count and record a rejected event (the fleet stays untouched)."""
         kind = getattr(event, "kind", type(event).__name__)
         self.metrics.inc("events_quarantined")
         self.metrics.inc(f"quarantine_{kind}")
@@ -527,17 +634,26 @@ class Scheduler:
         self.quarantined.append((self.fleet.seq, kind, reason))
         self._last_error = f"quarantined {kind}: {reason}"
         self._note_fault()
+
+    def _quarantine(self, event, reason: str) -> PlacementView:
+        """Record a rejected event and keep serving the last-known-good."""
+        self._quarantine_note(event, reason)
         if self._published is None:
+            kind = getattr(event, "kind", type(event).__name__)
             raise ValueError(
                 f"poisoned {kind} event before any placement was published "
                 f"({reason}); nothing safe to serve"
             )
         return self.latest()
 
-    def _tick(self, structural: Optional[bool]) -> PlacementView:
+    def _tick(
+        self, structural: Optional[bool], pressure: bool = False
+    ) -> PlacementView:
         """One replan; ``structural=None`` marks the eventless init solve
         (it times and mode-counts like any tick but belongs to neither
-        routing class, so the per-class counters keep summing to events)."""
+        routing class, so the per-class counters keep summing to events).
+        ``pressure`` widens a missed speculation probe to the bank's
+        nearest certified match (degraded-mode serving under overload)."""
         # Second quarantine layer: a poisoned fleet state (however it got
         # here) must never reach build_coeffs. Cheap O(M) scalar scan.
         # Both short-circuits run BEFORE pool.get: a tick that will not
@@ -583,6 +699,12 @@ class Scheduler:
             view = self._spec_probe(key, structural)
             if view is not None:
                 return view
+            if pressure:
+                # Behind under load: a certified placement from a NEARBY
+                # instance beats queueing this solve past its deadline.
+                view = self._spec_near_probe(key, structural)
+                if view is not None:
+                    return view
         planner, _hit = self.pool.get(key)
         devs = self.fleet.device_list()
         t0 = time.perf_counter()
@@ -770,6 +892,45 @@ class Scheduler:
         )
         return self._publish(entry.result, "spec", key, planner, devs, ms)
 
+    def _spec_near_probe(self, key, structural) -> Optional[PlacementView]:
+        """Degraded-mode serving: the bank's nearest certified match.
+
+        Runs ONLY on pressure ticks whose exact probe missed. A hit serves
+        a placement certified on an instance within ``spec_near_radius``
+        tolerance buckets of the live one (worst channel), published as
+        ``mode='spec_near'`` so readers can see the answer is approximate
+        by construction, not merely stale. No warm-state donation: the
+        entry's iterates belong to a nearby-but-different instance, and
+        the next unpressured solve should seed from the incumbent chain
+        as usual. A miss (nothing close enough banked) falls through to
+        the normal solve — the queue is behind either way, and solving is
+        the only remaining answer.
+        """
+        t0 = time.perf_counter()
+        devs = self.fleet.device_list()
+        found = self.spec_bank.nearest(
+            devs, self.fleet.model, key, max_radius=self.spec_near_radius
+        )
+        if found is None:
+            self.metrics.inc("spec_near_miss")
+            return None
+        entry, dist = found
+        self.metrics.inc("spec_near_hit")
+        self._span.add_event(
+            "spec_near_hit", distance=dist, weight=round(entry.weight, 4)
+        )
+        ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.observe("event_to_placement", ms)
+        self.metrics.observe(
+            "structural_tick" if structural else "drift_tick", ms
+        )
+        self.metrics.inc(
+            f"{'structural' if structural else 'drift'}_tick_spec_near"
+        )
+        return self._publish(
+            entry.result, "spec_near", key, self.pool.peek(key), devs, ms
+        )
+
     def _spec_presolve(self, key, planner, result: HALDAResult) -> None:
         """Refill the bank after a solved tick: bank the fresh solve under
         its own digest (oscillating churn returns to it), then pre-solve
@@ -791,6 +952,10 @@ class Scheduler:
                 BankEntry(
                     result=result, key=key, weight=1.0,
                     solved_seq=self.fleet.seq,
+                    buckets=bucket_vector(
+                        self.fleet.device_list(), self.fleet.model,
+                        bank.tolerance,
+                    ),
                 ),
             )
         if self.backend != "jax":
@@ -834,7 +999,7 @@ class Scheduler:
                 )
                 return
             banked = 0
-            for (d, _devs_c, w), res in zip(fresh, results):
+            for (d, devs_c, w), res in zip(fresh, results):
                 if not res.certified:
                     continue  # never bank what --fail-uncertified rejects
                 banked += 1
@@ -843,6 +1008,9 @@ class Scheduler:
                     BankEntry(
                         result=res, key=key, weight=w,
                         solved_seq=self.fleet.seq,
+                        buckets=bucket_vector(
+                            devs_c, self.fleet.model, bank.tolerance
+                        ),
                     ),
                 )
             if banked:
@@ -862,6 +1030,7 @@ class Scheduler:
             "enabled": self.speculative,
             "hits": hits,
             "misses": misses,
+            "near_hits": c.get("spec_near_hit", 0),
             "presolved": c.get("spec_presolve", 0),
             "presolve_failed": c.get("spec_presolve_failed", 0),
             "stale": c.get("spec_stale", 0),
@@ -1446,5 +1615,6 @@ def drift_warm_share(metrics: SchedulerMetrics) -> float:
         c["drift_tick_warm"]
         + c["drift_tick_margin"]
         + c.get("drift_tick_spec", 0)
+        + c.get("drift_tick_spec_near", 0)
     )
     return fast / drift

@@ -68,6 +68,34 @@ def _bucket_log(value: Optional[float], width: float) -> int:
     return round(math.log(max(float(value), _EPS)) / width)
 
 
+def bucket_vector(devices, model, tolerance: float) -> Tuple[int, ...]:
+    """The instance's drift coordinates as a flat tuple of tolerance
+    buckets (the same channels, widths and order ``candidate_digest``
+    hashes, minus the device names).
+
+    Unlike the digest — which only answers "same bucket on every channel,
+    yes or no" — the vector supports a DISTANCE: two instances of the same
+    identity differ by ``max |bucket_i - bucket_j|`` tolerance steps on
+    their worst channel. The degraded-mode near-match probe
+    (``SpeculationBank.nearest``) ranks banked entries by exactly that.
+    """
+    w = math.log1p(tolerance)
+    out: List[int] = []
+    for dev in devices:
+        out.extend(
+            (
+                _bucket_log(dev.t_comm, w),
+                _bucket_log(dev.comm_bandwidth, w),
+                _bucket_log(float(dev.d_avail_ram), w),
+                _bucket_log(_accel_pool(dev), w),
+            )
+        )
+    loads = model.expert_loads
+    if loads is not None:
+        out.extend(round(v / tolerance) for v in loads)
+    return tuple(out)
+
+
 def candidate_digest(devices, model, key, tolerance: float) -> str:
     """Tolerance-bucketed digest of one instance's DRIFT coordinates.
 
@@ -119,6 +147,10 @@ class BankEntry(NamedTuple):
     key: Tuple[str, str]  # fleet/model identity the solve priced
     weight: float  # forecast confidence (1.0 for banked real ticks)
     solved_seq: int  # fleet seq the presolve was dispatched at
+    # Bucket coordinates of the instance the entry was certified on
+    # (``bucket_vector``); None on entries banked before the near-match
+    # probe existed — they still serve exact hits, just never near ones.
+    buckets: Optional[Tuple[int, ...]] = None
 
 
 class SpeculationBank:
@@ -173,6 +205,47 @@ class SpeculationBank:
         self._entries.move_to_end(digest)
         return entry
 
+    def nearest(
+        self, devices, model, key: Tuple[str, str], max_radius: int
+    ) -> Optional[Tuple[BankEntry, int]]:
+        """The closest certified banked entry within ``max_radius``
+        tolerance buckets of the live instance, or None.
+
+        Degraded-mode serving's probe (``mode='spec_near'``): when a shard
+        is behind, a placement certified on an instance a few tolerance
+        steps away beats queueing the solve past the deadline. Distance is
+        the worst channel's bucket gap (L-inf over ``bucket_vector``), so
+        ``max_radius`` bounds staleness per channel: every coefficient of
+        the served instance is within ~``(1 + tolerance)^max_radius`` of
+        the instance the placement was certified on. Identity must match
+        exactly (a near-match across fleets/models is a different problem,
+        not a stale one); entries without bucket coordinates never match.
+        A hit renews LRU recency, like ``probe``.
+        """
+        live = bucket_vector(devices, model, self.tolerance)
+        best: Optional[Tuple[str, BankEntry, int]] = None
+        for digest, e in self._entries.items():
+            if (
+                e.key != key
+                or e.buckets is None
+                or len(e.buckets) != len(live)
+                or not e.result.certified
+            ):
+                continue
+            dist = (
+                max(abs(a - b) for a, b in zip(live, e.buckets))
+                if live
+                else 0
+            )
+            if dist > max_radius:
+                continue
+            if best is None or dist < best[2]:
+                best = (digest, e, dist)
+        if best is None:
+            return None
+        self._entries.move_to_end(best[0])
+        return best[1], best[2]
+
     def invalidate(self, key: Tuple[str, str]) -> int:
         """Drop entries NOT priced under ``key``; returns how many (the
         ``spec_stale`` count after a structural identity change)."""
@@ -201,6 +274,9 @@ class SpeculationBank:
                     "key": list(e.key),
                     "weight": e.weight,
                     "solved_seq": e.solved_seq,
+                    "buckets": (
+                        list(e.buckets) if e.buckets is not None else None
+                    ),
                     "result": e.result.model_dump(),
                     "ipm_state": _encode_state(e.result.ipm_state),
                 }
@@ -219,6 +295,7 @@ class SpeculationBank:
         for rec in state.get("entries", []):
             result = HALDAResult.model_validate(rec["result"])
             result.ipm_state = _decode_state(rec.get("ipm_state"))
+            buckets = rec.get("buckets")
             self.put(
                 rec["digest"],
                 BankEntry(
@@ -226,6 +303,11 @@ class SpeculationBank:
                     key=tuple(rec["key"]),
                     weight=float(rec.get("weight", 1.0)),
                     solved_seq=int(rec.get("solved_seq", 0)),
+                    buckets=(
+                        tuple(int(b) for b in buckets)
+                        if buckets is not None
+                        else None
+                    ),
                 ),
             )
 

@@ -1,0 +1,354 @@
+"""Open-loop execution: fire the schedule on time, measure the fallout.
+
+The executor's one rule is the open-loop contract: an event fires at its
+scheduled instant whether or not earlier events have completed — lateness
+accumulates in the queues instead of throttling the generator. That is
+exactly what closed-loop replay cannot do, and it is why these numbers
+can show collapse: offered load is an input here, not an emergent
+property of service speed.
+
+Latency is measured from the event's SCHEDULED time to placement
+publication — the client clock. Under overload that includes dispatch
+lateness and queue wait, so p99/p99.9 here degrade the way a user's
+would; the solve-only view lives in the gateway's own histograms.
+
+``shed_violations`` is the admission-control accounting contract
+(``ChaosReport.violations()`` extended to overload): every shed the
+gateway counted must be explained record-by-record by the flight
+recorder, per fleet, with monotone shed indices — a shed that is counted
+but unrecorded (or vice versa) is a contract violation, exactly like an
+unaccounted quarantine in the chaos soak.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Sequence
+
+from ..gateway.gateway import Gateway, QueueFull
+from ..sched.metrics import _quantile
+from ..sched.sim import generate_trace
+from .arrivals import ScheduledEvent
+
+
+def _view_invalid(view) -> bool:
+    """The ChaosReport structural-validity check, minus the L cross-check
+    (open-loop traces are drift-only by default; a coalesced or near-match
+    serve still must be a well-formed placement)."""
+    r = view.result
+    return r.k < 1 or len(r.w) != len(r.n) or any(w < 0 for w in r.w)
+
+
+async def execute_openloop(
+    gateway: Gateway,
+    items: Sequence[ScheduledEvent],
+    time_scale: float = 1.0,
+    on_event=None,
+) -> dict:
+    """Fire ``items`` at their (scaled) scheduled times; gather results.
+
+    ``time_scale`` compresses (<1) or dilates (>1) the schedule: the
+    committed captures carry a leisurely real-time horizon, and the
+    overload smokes replay them at a tiny scale to drive the same event
+    sequence past saturation deterministically. Returns the report dict
+    (see keys below); per-event outcomes stream through ``on_event(item,
+    outcome)`` with outcome one of 'served'/'shed'/'failed'.
+    """
+    if time_scale <= 0:
+        raise ValueError("time_scale must be > 0")
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    lat_ms: List[float] = []
+    dispatch_late_ms: List[float] = []
+    sheds: List[dict] = []
+    counts = {"offered": 0, "served": 0, "shed": 0, "failed": 0, "invalid": 0}
+    max_depth_seen = 0
+    tasks: List[asyncio.Task] = []
+
+    async def _fire(item: ScheduledEvent, target: float) -> None:
+        try:
+            view = await gateway.handle_event_async(item.fleet_id, item.event)
+        except QueueFull as e:  # dlint: disable=DLP017 the shed was already counted (events_shed) and flight-recorded INSIDE the gateway before this raise; here it only lands in the report
+            counts["shed"] += 1
+            sheds.append(
+                {
+                    "fleet": e.fleet_id,
+                    "depth": e.depth,
+                    "retry_after_s": e.retry_after_s,
+                }
+            )
+            if on_event is not None:
+                on_event(item, "shed")
+            return
+        done_ms = (loop.time() - target) * 1e3
+        if view.events_behind > 0:
+            # The tick produced no fresh placement (solve failed); the
+            # served answer is the previous one — an error under open
+            # loop just like under replay.
+            counts["failed"] += 1
+            if on_event is not None:
+                on_event(item, "failed")
+            return
+        if _view_invalid(view):
+            counts["invalid"] += 1
+        counts["served"] += 1
+        lat_ms.append(done_ms)
+        if on_event is not None:
+            on_event(item, "served")
+
+    for item in sorted(items, key=lambda it: it.at_s):
+        target = t0 + item.at_s * time_scale
+        delay = target - loop.time()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        # Negative delay = the dispatcher itself is behind; fire NOW and
+        # record the lateness — never skip, never throttle.
+        counts["offered"] += 1
+        dispatch_late_ms.append(max(0.0, (loop.time() - target) * 1e3))
+        for w in gateway.workers:
+            max_depth_seen = max(max_depth_seen, w.depth())
+        tasks.append(asyncio.ensure_future(_fire(item, target)))
+    if tasks:
+        await asyncio.gather(*tasks)
+    wall_s = loop.time() - t0
+    srt = sorted(lat_ms)
+    horizon_s = (
+        max(it.at_s for it in items) * time_scale if items else 0.0
+    )
+    return {
+        **counts,
+        "wall_s": round(wall_s, 3),
+        "offered_eps": (
+            round(counts["offered"] / horizon_s, 2) if horizon_s > 0 else 0.0
+        ),
+        # Goodput: events actually served per second of wall clock — the
+        # plateau-vs-cliff gauge. Sheds and failures are not goodput.
+        "goodput_eps": (
+            round(counts["served"] / wall_s, 2) if wall_s > 0 else 0.0
+        ),
+        "p50_ms": round(_quantile(srt, 0.50), 3),
+        "p99_ms": round(_quantile(srt, 0.99), 3),
+        "p999_ms": round(_quantile(srt, 0.999), 3),
+        "max_ms": round(srt[-1], 3) if srt else 0.0,
+        "dispatch_p99_late_ms": round(
+            _quantile(sorted(dispatch_late_ms), 0.99), 3
+        ),
+        "max_queue_depth_seen": max_depth_seen,
+        "shed_samples": sheds[:5],
+    }
+
+
+def shed_violations(gateway: Gateway, flight) -> List[str]:
+    """Record-by-record shed reconciliation (empty = contract held).
+
+    Checks, per fleet: the flight recorder's shed records carry strictly
+    increasing ``shed_index`` values whose last equals the gateway's
+    per-fleet shed tally, each record names a positive Retry-After, and
+    the per-fleet tallies sum to the ``events_shed`` counter. The same
+    shape as the chaos soak's quarantine accounting: counters must be
+    explained by records.
+
+    Ring-overflow semantics: shed records share the fleet's bounded ring
+    with ordinary tick records, and eviction is strictly oldest-first —
+    so as long as ANY shed record survives, the youngest (index ==
+    tally) survives with it, and the last-index check is sound. A fleet
+    whose shed records were ALL pushed out by newer tick records is only
+    a violation when eviction cannot explain the absence (the ring never
+    filled); otherwise the counter stands un-audited rather than
+    falsely condemned — size the recorder's capacity to the audit window
+    when the reconciliation matters (the harness and bench do).
+    """
+    out: List[str] = []
+    tallies = gateway.shed_counts()
+    counter = gateway.metrics.snapshot()["counters"].get("events_shed", 0)
+    if counter != sum(tallies.values()):
+        out.append(
+            f"shed accounting: events_shed={counter} but per-fleet "
+            f"tallies sum to {sum(tallies.values())}"
+        )
+    if flight is None:
+        if counter:
+            out.append(
+                f"shed accounting: {counter} sheds with no flight "
+                "recorder attached (sheds must be flight-recorded)"
+            )
+        return out
+    for fleet_id, tally in sorted(tallies.items()):
+        ring = flight.snapshot(fleet_id)
+        records = [r for r in ring if r.get("shed")]
+        if not records:
+            if len(ring) < flight.capacity:
+                # Nothing was ever evicted from this ring, so the
+                # missing records cannot be an overflow artifact.
+                out.append(
+                    f"shed accounting: fleet {fleet_id} counted {tally} "
+                    "shed(s) but has no shed flight records (and the "
+                    "ring never overflowed)"
+                )
+            continue
+        indices = [r.get("shed_index") for r in records]
+        if any(
+            not isinstance(i, int) or i < 1 for i in indices
+        ) or indices != sorted(indices) or len(set(indices)) != len(indices):
+            out.append(
+                f"shed accounting: fleet {fleet_id} has non-monotone "
+                f"shed indices {indices}"
+            )
+        elif indices[-1] != tally:
+            out.append(
+                f"shed accounting: fleet {fleet_id} newest shed record "
+                f"has index {indices[-1]} but the tally is {tally}"
+            )
+        for r in records:
+            ra = r.get("retry_after_s")
+            if not isinstance(ra, (int, float)) or ra <= 0:
+                out.append(
+                    f"shed accounting: fleet {fleet_id} shed record "
+                    f"#{r.get('shed_index')} carries no positive "
+                    f"Retry-After ({ra!r})"
+                )
+    for fleet_id in flight.keys():
+        shed_recs = [r for r in flight.snapshot(fleet_id) if r.get("shed")]
+        if shed_recs and fleet_id not in tallies:
+            out.append(
+                f"shed accounting: fleet {fleet_id} has shed flight "
+                "records but a zero tally"
+            )
+    return out
+
+
+async def _warmup(
+    gateway: Gateway, specs: Dict[str, dict], per_fleet: int, seed: int
+) -> None:
+    """Closed-loop warmup: cold solve + warm-layout compile per fleet,
+    concurrent across fleets (the loadgen's barrier-phase convention) —
+    the open-loop phase must measure serving, not jit."""
+    from ..gateway.traces import make_fleet_from_spec
+
+    async def _drive(fleet_id: str, events: list) -> None:
+        for ev in events:
+            await gateway.handle_event_async(fleet_id, ev)
+
+    jobs = []
+    for i, (fleet_id, spec) in enumerate(specs.items()):
+        devices = make_fleet_from_spec(fleet_id, spec)
+        events = generate_trace(
+            "drift", per_fleet, seed=seed * 104729 + i, base_fleet=devices
+        )
+        jobs.append(_drive(fleet_id, events))
+    await asyncio.gather(*jobs)
+
+
+def run_openloop(
+    model,
+    specs: Dict[str, dict],
+    items: Sequence[ScheduledEvent],
+    n_workers: int,
+    *,
+    time_scale: float = 1.0,
+    warmup_per_fleet: int = 2,
+    warmup_seed: int = 0,
+    k_candidates: Optional[Sequence[int]] = None,
+    mip_gap: float = 1e-3,
+    kv_bits: str = "4bit",
+    scheduler_kwargs: Optional[dict] = None,
+    max_queue_depth: Optional[int] = None,
+    coalesce: bool = False,
+    degrade_depth: Optional[int] = None,
+    flight=None,
+    tracer=None,
+) -> dict:
+    """One full open-loop arm: build, warm, fire, report, tear down.
+
+    Admission is configured only AFTER the warmup phase (a cold compile
+    behind a bounded queue would shed the warmup itself), then the whole
+    schedule executes open-loop. The report merges the executor's numbers
+    with the gateway's admission counters and — when a flight recorder is
+    attached — the shed reconciliation verdict.
+    """
+    kwargs = {
+        "mip_gap": mip_gap,
+        "kv_bits": kv_bits,
+        "backend": "jax",
+        "k_candidates": list(k_candidates) if k_candidates else None,
+    }
+    kwargs.update(scheduler_kwargs or {})
+    gateway = Gateway(
+        n_workers=n_workers, scheduler_kwargs=kwargs,
+        flight=flight, tracer=tracer,
+    )
+    try:
+        from ..gateway.traces import make_fleet_from_spec
+
+        for fleet_id, spec in specs.items():
+            gateway.register_fleet(
+                fleet_id, make_fleet_from_spec(fleet_id, spec), model
+            )
+        if warmup_per_fleet > 0:
+            asyncio.run(
+                _warmup(gateway, specs, warmup_per_fleet, warmup_seed)
+            )
+        gateway.configure_admission(
+            max_queue_depth=max_queue_depth,
+            coalesce=coalesce,
+            degrade_depth=degrade_depth,
+        )
+        report = asyncio.run(
+            execute_openloop(gateway, items, time_scale=time_scale)
+        )
+        snap = gateway.metrics_snapshot()
+        totals = snap["shard_totals"]
+        report.update(
+            {
+                "fleets": len(specs),
+                "workers": n_workers,
+                "time_scale": time_scale,
+                "events_shed": snap["counters"].get("events_shed", 0),
+                "events_coalesced": totals.get("events_coalesced", 0),
+                "spec_near_hits": totals.get("spec_near_hit", 0),
+                "shed_counts": gateway.shed_counts(),
+                "admission": {
+                    "max_queue_depth": max_queue_depth,
+                    "coalesce": coalesce,
+                    "degrade_depth": degrade_depth,
+                },
+            }
+        )
+        if flight is not None:
+            report["shed_violations"] = shed_violations(gateway, flight)
+        return report
+    finally:
+        gateway.close()
+
+
+def measure_closed_loop(
+    gateway: Gateway, specs: Dict[str, dict], events_per_fleet: int, seed: int
+) -> dict:
+    """Closed-loop capacity probe on an ALREADY-WARM gateway: the bench's
+    sustainable-rate search needs a capacity estimate from the same
+    fleets/workers the open-loop arms will stress, without paying a
+    second set of cold solves. Thin wrapper over the loadgen's concurrent
+    replayer with no warmup split."""
+    from ..gateway.loadgen import replay_concurrent
+    from ..gateway.traces import make_fleet_from_spec
+
+    items = []
+    per_fleet: Dict[str, list] = {}
+    for i, (fleet_id, spec) in enumerate(specs.items()):
+        devices = make_fleet_from_spec(fleet_id, spec)
+        per_fleet[fleet_id] = generate_trace(
+            "drift", events_per_fleet, seed=seed * 15485863 + i,
+            base_fleet=devices,
+        )
+    for j in range(events_per_fleet):
+        for fleet_id in specs:
+            items.append((fleet_id, per_fleet[fleet_id][j]))
+    return asyncio.run(
+        replay_concurrent(gateway, items, {f: 0 for f in specs})
+    )
+
+
+def lateness_probe(items: Sequence[ScheduledEvent]) -> float:
+    """Total schedule horizon in seconds (the last event's timestamp) —
+    a convenience for sizing time_scale against a wall-clock budget."""
+    return max((it.at_s for it in items), default=0.0)

@@ -415,6 +415,57 @@ def main() -> int:
         f"{len(tr.rounds)} rounds / {tr.lp_iters_executed} LP iters "
         f"(gap {gaps})"
     )
+
+    # ------------------------------------------------------------------
+    # 14. Overload: everything so far replayed CLOSED-loop — the next
+    #     event waits for the previous placement, so offered load can
+    #     never exceed capacity. The traffic engine is open-loop: events
+    #     fire at their scheduled time regardless of completion, and the
+    #     gateway's admission control decides what happens when they pile
+    #     up. Drive 4 small fleets 10x past saturation twice — once with
+    #     only a bounded queue (sheds, each counted + flight-recorded +
+    #     reconciled), once with coalescing (queued same-shard drift
+    #     folds into single solves) — and read the plateau from the
+    #     goodput, exactly the shape `make bench-compare` gates on the
+    #     100-fleet trace (README "Overload & admission control").
+    # ------------------------------------------------------------------
+    from distilp_tpu.obs import FlightRecorder
+    from distilp_tpu.traffic import (
+        ArrivalConfig,
+        generate_openloop_schedule,
+        run_openloop,
+    )
+
+    ol_cfg = ArrivalConfig(
+        seed=21, duration_s=40.0, base_rate=2.0, diurnal_amplitude=0.5,
+        diurnal_period_s=40.0, n_regions=2, burst_rate_per_region=0.06,
+        burst_factor=3.0, burst_duration_s=6.0, fleet_size=3, fleet_seed=42,
+    )
+    ol_specs, ol_items = generate_openloop_schedule(ol_cfg, 4)
+    flight = FlightRecorder(capacity=2 * len(ol_items))
+    shed_arm = run_openloop(
+        gw_model, ol_specs, ol_items, 2, time_scale=0.001,
+        k_candidates=[8, 10], max_queue_depth=2, flight=flight,
+    )
+    print(
+        f"[14] open-loop flood, bounded queue (depth 2): "
+        f"{shed_arm['offered']} offered @ ~{shed_arm['offered_eps']:.0f} "
+        f"ev/s -> {shed_arm['served']} served, {shed_arm['shed']} shed "
+        f"(reconciled: {not shed_arm['shed_violations']}), goodput "
+        f"{shed_arm['goodput_eps']:.0f} ev/s"
+    )
+    co_arm = run_openloop(
+        gw_model, ol_specs, ol_items, 2, time_scale=0.001,
+        k_candidates=[8, 10], max_queue_depth=64, coalesce=True,
+    )
+    print(
+        f"[14] same flood, coalescing: {co_arm['served']} served, "
+        f"{co_arm['events_coalesced']} folded into "
+        f"{co_arm['served'] - co_arm['events_coalesced']} solves, "
+        f"0 shed, goodput {co_arm['goodput_eps']:.0f} ev/s, p99 "
+        f"{co_arm['p99_ms']:.0f} ms — the burst compresses instead of "
+        "queueing: saturation is a plateau, not a cliff"
+    )
     return 0
 
 

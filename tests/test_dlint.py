@@ -979,6 +979,76 @@ def test_obs_layer_joins_blocking_async_contract():
     assert len(out) == 1 and "blocks the gateway event loop" in out[0].message
 
 
+def test_traffic_layer_is_lazy_for_dlp013():
+    # Generating or byte-checking an open-loop schedule must not pay
+    # backend init: traffic/ is in the lazy set like gateway/ and obs/.
+    out = findings_for("DLP013", "distilp_tpu/traffic/newgen.py", """\
+        import jax
+
+        def gen():
+            return jax.numpy.zeros(3)
+        """)
+    assert len(out) == 1
+    ok = findings_for("DLP013", "distilp_tpu/traffic/newgen.py", """\
+        def gen():
+            import jax
+
+            return jax.numpy.zeros(3)
+        """)
+    assert ok == []
+
+
+def test_traffic_layer_joins_silent_except_contract():
+    # The traffic harness audits shed/coalesce accounting — a swallowed
+    # exception there hides exactly what it exists to surface.
+    out = findings_for("DLP017", "distilp_tpu/traffic/runner.py", """\
+        def fire(self, gw, ev):
+            try:
+                gw.handle_event("f0", ev)
+            except Exception:
+                pass
+        """)
+    assert len(out) == 1 and "metrics sink" in out[0].message
+
+
+def test_traffic_layer_joins_blocking_async_contract():
+    # The open-loop dispatcher lives on the asyncio loop: one time.sleep
+    # and every fleet's schedule slips together.
+    out = findings_for("DLP018", "distilp_tpu/traffic/exec2.py", """\
+        import time
+
+        async def fire(self):
+            time.sleep(0.1)
+        """)
+    assert len(out) == 1 and "blocks the gateway event loop" in out[0].message
+
+
+def test_traffic_layer_joins_dlp019():
+    out = findings_for("DLP019", "distilp_tpu/traffic/exec2.py", """\
+        def note(self, m):
+            m.inc("totally_novel_overload_counter")
+        """)
+    assert len(out) == 1
+
+
+def test_admission_counters_registered_for_dlp019():
+    # The shed/coalesce/degrade counters are registry entries (satellite
+    # contract: a new admission counter cannot ship without HELP text).
+    ok = findings_for("DLP019", "distilp_tpu/gateway/adm.py", """\
+        def shed(self, near):
+            self.metrics.inc("events_shed")
+            self.metrics.inc("events_coalesced", 3)
+            self.metrics.inc("spec_near_hit" if near else "spec_near_miss")
+            self.metrics.inc("http_too_many_requests")
+        """)
+    assert ok == []
+    bad = findings_for("DLP019", "distilp_tpu/gateway/adm.py", """\
+        def shed(self):
+            self.metrics.inc("events_shedded")
+        """)
+    assert len(bad) == 1 and "events_shedded" in bad[0].message
+
+
 # --------------------------------------------------------------------------
 # DLP019 — literal counter names must be registered in METRIC_REGISTRY
 

@@ -57,6 +57,11 @@ LAZY_JAX_PREFIXES = (
     # backend at all, and a top-level jax import here would leak into the
     # sched/gateway layers that import obs at module level.
     "distilp_tpu/obs/",
+    # The traffic engine generates schedules and fires them at the
+    # gateway; generating (or byte-checking) a committed open-loop trace
+    # must never pay backend init — jax only loads through the
+    # schedulers the gateway builds.
+    "distilp_tpu/traffic/",
 )
 LAZY_JAX_MODULES = {
     "distilp_tpu/__init__.py",
@@ -108,6 +113,7 @@ BACKEND_TOUCHING_PREFIXES = (
     "distilp_tpu.sched",
     "distilp_tpu.twin",
     "distilp_tpu.gateway",
+    "distilp_tpu.traffic",
     "distilp_tpu.utils",
     "distilp_tpu.profiler.device",
     "distilp_tpu.profiler.topology",
@@ -803,12 +809,18 @@ class SilentExceptInScheduler(Rule):
         # flight recorder that silently ate a failure would be the one
         # component whose faults nothing else can observe.
         "distilp_tpu/obs/",
+        # The traffic harness AUDITS the shed/coalesce accounting — a
+        # swallowed exception there hides exactly the contract breaks it
+        # exists to surface.
+        "distilp_tpu/traffic/",
     )
     # Attribute calls that count as recording through the metrics sink.
-    # `_quarantine` is the scheduler's fault recorder (it increments the
-    # quarantine counters and the health state); delegating to it from a
-    # handler IS the accounting.
-    _SINK_METHODS = {"inc", "observe", "record_tick", "_quarantine"}
+    # `_quarantine`/`_quarantine_note` are the scheduler's fault recorders
+    # (they increment the quarantine counters and the health state);
+    # delegating to either from a handler IS the accounting.
+    _SINK_METHODS = {
+        "inc", "observe", "record_tick", "_quarantine", "_quarantine_note",
+    }
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         if ctx.is_test or not any(
@@ -860,8 +872,15 @@ class BlockingCallInAsyncGateway(Rule):
 
     # obs/ has no event loop of its own today, but it is imported BY the
     # gateway's async tier — the same contract applies the day it grows
-    # an async exporter.
-    _PATH_PREFIXES = ("distilp_tpu/gateway/", "distilp_tpu/obs/")
+    # an async exporter. traffic/'s open-loop executor LIVES on the loop:
+    # one blocking call in the dispatcher and every fleet's schedule
+    # slips together, which would corrupt the very lateness numbers the
+    # harness reports.
+    _PATH_PREFIXES = (
+        "distilp_tpu/gateway/",
+        "distilp_tpu/obs/",
+        "distilp_tpu/traffic/",
+    )
     # module -> function names that block the loop outright. Matched
     # through ALIASES too: `import time as t; t.sleep(...)` and
     # `from subprocess import run` block exactly as hard as the literal
@@ -973,6 +992,7 @@ class UnregisteredMetricName(Rule):
         "distilp_tpu/sched/",
         "distilp_tpu/gateway/",
         "distilp_tpu/obs/",
+        "distilp_tpu/traffic/",
     )
 
     _registry_cache: Optional[Dict[str, str]] = None
