@@ -18,12 +18,16 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo
 	python -m pytest tests/ -q
 
+# `make bench` also appends the run's headline keys as one line of
+# BENCH_HISTORY.jsonl (committed format, see tools/bench_history.py) so
+# the bench trajectory stays machine-readable; trend-check it with
+# `solver slo --history BENCH_HISTORY.jsonl`.
 .PHONY: bench
 bench:
-	python bench.py
+	python bench.py --history BENCH_HISTORY.jsonl
 
 # Regression gate for the perf dev loop: run the bench and diff every
 # headline metric against a committed capture (default: the latest
@@ -229,6 +233,36 @@ smoke-overload: lint-strict
 		--workers 2 --k-candidates 8,10 --time-scale 0.001 \
 		--max-queue-depth 64 --coalesce --check --expect-coalesced \
 		--expect-no-sheds --quiet
+
+# SLO smoke: burn-rate alerting, both halves of the determinism claim.
+# (1) OFFLINE: the committed synthetic overload timeline (regeneration
+# pinned byte-exact in tests/test_slo.py) replayed against the committed
+# spec must reproduce the committed expected alert sequence EXACTLY —
+# tier, window set, state and firing-timestamp bucket; evaluation over a
+# dumped timeline is a pure function of (timeline, spec, step), so any
+# diff is evaluator drift, not noise. --check also reconciles the
+# transition list against the engine's own counters and flight records.
+# (2) LIVE: the committed diurnal+burst capture replayed as the
+# smoke-overload flood (time-scale 0.001, depth-2 queue -> ~90% shed)
+# with the SLO engine sampling live: the availability page alert must
+# OPEN at the shed onset and CLOSE during the settle window, reconciled
+# record-by-record (engine events == counters == flight records), on top
+# of the usual shed-accounting contract. The sampler-overhead <= 5% gate
+# is the bench's job (`slo` section, `make bench-compare`).
+.PHONY: smoke-slo
+smoke-slo: lint-strict
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli slo \
+		--spec tests/traces/slo_overload_spec.json \
+		--timeline tests/traces/slo_timeline_overload.jsonl \
+		--step-s 0.1 --expect tests/traces/slo_expected_alerts.json \
+		--check --quiet
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli overload \
+		--trace tests/traces/openloop_diurnal_burst.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 2 --k-candidates 8,10 --time-scale 0.001 \
+		--max-queue-depth 2 --check --expect-sheds \
+		--slo tests/traces/slo_live_spec.json --settle-s 3 \
+		--expect-alert page --quiet
 
 .PHONY: smoke-sched
 smoke-sched: lint-strict

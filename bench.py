@@ -263,6 +263,7 @@ _COMPARE_LOWER_BETTER = (
     "spec_p99_hit_ms", "spec_p99_on_ms",
     "conv_ipm_iters_to_certify", "conv_pdhg_iters_to_certify",
     "conv_pdhg_restarts", "conv_overhead_pct",
+    "slo_overhead_pct",
 )
 # Instrumentation cost ceiling: tracing + Prometheus exposition may never
 # cost more than this fraction of the loadgen arm's events/sec. Checked
@@ -273,6 +274,10 @@ _OBS_OVERHEAD_MAX_PCT = 5.0
 # at most this much over the untraced one (absolute ceiling, not a delta
 # vs the reference — the trace budget does not inflate with a slow capture).
 _CONV_OVERHEAD_MAX_PCT = 5.0
+# And for the SLO layer's timeline sampler: full sampling (one metrics
+# round trip per worker per tick) may cost at most this much of the
+# loadgen arm's events/sec — absolute, like the other obs ceilings.
+_SLO_OVERHEAD_MAX_PCT = 5.0
 _COMPARE_HIGHER_BETTER = (
     "vs_baseline", "placements_per_sec", "pipelined_placements_per_sec",
     "scenario_batch_placements_per_sec", "scheduler_events_per_sec",
@@ -371,6 +376,34 @@ def _compare_against(payload: dict, against: str) -> int:
             f"conv_overhead_pct {conv_pct:.1f} > {_CONV_OVERHEAD_MAX_PCT:g} "
             "(solver-interior telemetry cost ceiling on the traced arm)"
         )
+    slo_pct = payload.get("slo_overhead_pct")
+    if isinstance(slo_pct, (int, float)) and slo_pct > _SLO_OVERHEAD_MAX_PCT:
+        failures.append(
+            f"slo_overhead_pct {slo_pct:.1f} > {_SLO_OVERHEAD_MAX_PCT:g} "
+            "(timeline-sampler cost ceiling on the sampled arm)"
+        )
+    # SLO absolute contracts (checked on the new capture, never relative):
+    # the committed overload capture must fire AND clear the expected
+    # burn-rate alert, the offline replay must be deterministic against
+    # the committed fixture, and the /signals payload must validate
+    # against its pydantic schema (the federation consumer contract).
+    if payload.get("slo_alerts_ok") is False:
+        failures.append(
+            "slo_alerts_ok is false (the flood's burn-rate alert did not "
+            "fire and clear as the committed capture expects — see the "
+            "slo section's events)"
+        )
+    if payload.get("slo_replay_deterministic") is False:
+        failures.append(
+            "slo_replay_deterministic is false (offline timeline replay "
+            "diverged from the committed expected alert sequence)"
+        )
+    if payload.get("slo_signals_schema_ok") is False:
+        failures.append(
+            "slo_signals_schema_ok is false (/signals payload no longer "
+            "validates against obs.slo.SignalsPayload — the autoscaling "
+            "contract broke)"
+        )
     # Overload's absolute contracts: graceful saturation (plateau, not
     # cliff) and every shed observable. Checked on the new capture, never
     # relative — a collapse is a collapse even if the reference also
@@ -409,7 +442,7 @@ def _compare_against(payload: dict, against: str) -> int:
     return 0
 
 
-def main(against: str | None = None) -> int:
+def main(against: str | None = None, history: str | None = None) -> int:
     global _PLATFORM
     platform, probe_info = _probe_backend()
     if platform is None:
@@ -699,6 +732,21 @@ def main(against: str | None = None) -> int:
     except Exception as e:  # pragma: no cover - defensive bench path
         payload["obs_error"] = f"{type(e).__name__}: {e}"
 
+    # SLO engine (distilp_tpu.obs.timeline + obs.slo): (1) the committed
+    # overload capture replayed as a flood with the SLO engine attached —
+    # the availability page alert must OPEN at the shed onset and CLOSE
+    # after recovery, reconciled against the flight recorder; (2) the
+    # offline alert replay over the committed synthetic timeline must
+    # reproduce the committed expected sequence exactly (byte-determinism
+    # of the evaluator); (3) the /signals payload must validate against
+    # its pydantic schema; (4) timeline-sampler overhead on the loadgen
+    # arm, interleaved off/on, gated <= 5% absolute alongside
+    # obs_overhead_pct. A failure costs only these keys.
+    try:
+        payload.update(_slo_bench(model))
+    except Exception as e:  # pragma: no cover - defensive bench path
+        payload["slo_error"] = f"{type(e).__name__}: {e}"
+
     # Digital twin (distilp_tpu.twin): Monte-Carlo throughput of the
     # vmapped robustness report (1024 perturbed what-if executions per
     # dispatch) and the objective-vs-twin rank agreement over the
@@ -748,6 +796,17 @@ def main(against: str | None = None) -> int:
         payload["fleet_scale_error"] = f"{type(e).__name__}: {e}"
 
     print(json.dumps(payload))
+    if history:
+        # The machine-readable trajectory: one committed-format line per
+        # run (tools/bench_history.HISTORY_KEYS), the dataset
+        # `solver slo --history` trend-checks. Appended best-effort — a
+        # read-only checkout must not fail the bench over its log line.
+        try:
+            from tools.bench_history import append_history
+
+            append_history(payload, history)
+        except OSError as e:
+            print(f"bench history append failed: {e}", file=sys.stderr)
     if against:
         return _compare_against(payload, against)
     return 0
@@ -1100,6 +1159,170 @@ def _obs_bench(model) -> dict:
         "obs_overhead_pct": round(max(0.0, overhead), 2),
         "obs_overhead_pct_raw": round(overhead, 2),
     }
+
+
+def _slo_bench(model) -> dict:
+    """slo_* section: alerting correctness + timeline-sampler cost.
+
+    Alert correctness rides the committed diurnal+burst open-loop capture
+    at time-scale 0.001 (the smoke-slo flood): a tiny bounded queue sheds
+    ~90% of the schedule, the availability SLO's page tier must open on
+    the burst and close during the settle window, and the open/close
+    trail must reconcile (engine transitions == counters == flight
+    records — the same record-by-record contract as sheds). Offline
+    determinism replays the committed synthetic timeline against the
+    committed spec and compares to the committed expected sequence —
+    a pure function, so any diff is evaluator drift, not noise. The
+    overhead arm interleaves the 10-fleet loadgen with and without a
+    50 ms timeline sampler (one metrics round trip per worker per tick,
+    the realistic cost); ``slo_overhead_pct`` is floored at zero like
+    the other obs overheads (raw alongside) and gated <= 5% absolute.
+    """
+    from distilp_tpu.gateway.loadgen import run_loadgen
+    from distilp_tpu.obs import (
+        SignalsPayload,
+        SLOConfig,
+        SLOEngine,
+        synthesize_overload_timeline,
+    )
+    from distilp_tpu.obs.flight import FlightRecorder
+    from distilp_tpu.traffic import read_openloop_trace, run_openloop
+
+    out: dict = {"slo": {}}
+
+    # -- (1) live alert fire/clear on the committed overload capture -------
+    spec_path = REPO / "tests" / "traces" / "slo_live_spec.json"
+    capture = REPO / "tests" / "traces" / "openloop_diurnal_burst.jsonl"
+    specs, items = read_openloop_trace(capture)
+    flight = FlightRecorder(capacity=max(256, 2 * len(items)))
+    flood = run_openloop(
+        model,
+        specs,
+        items,
+        n_workers=int(_env_num("DPERF_SLO_WORKERS", 2)),
+        time_scale=0.001,
+        k_candidates=[8, 10],
+        mip_gap=MIP_GAP,
+        max_queue_depth=2,
+        flight=flight,
+        slo_config=SLOConfig.from_json(spec_path),
+        settle_s=_env_num("DPERF_SLO_SETTLE_S", 3.0),
+    )
+    slo_rep = flood.get("slo", {})
+    events = slo_rep.get("events", [])
+    page_open = [
+        e for e in events
+        if e["severity"] == "page" and e["state"] == "open"
+    ]
+    page_close = [
+        e for e in events
+        if e["severity"] == "page" and e["state"] == "close"
+    ]
+    flight_alerts = [
+        r for r in flight.snapshot("slo") if r.get("kind") == "slo_alert"
+    ]
+    # Reconcile ALL severities against the counters (the counters count
+    # every tier; comparing page-only would spuriously fail the moment
+    # the live spec grows a warn tier) — same shape as overload --check.
+    opened_all = sum(1 for e in events if e["state"] == "open")
+    closed_all = sum(1 for e in events if e["state"] == "close")
+    reconciled = (
+        len(flight_alerts) == len(events)
+        and opened_all == slo_rep.get("alerts_opened")
+        and closed_all == slo_rep.get("alerts_closed")
+    )
+    out["slo"]["flood"] = {
+        "offered": flood["offered"],
+        "shed": flood["shed"],
+        "alerts_opened": slo_rep.get("alerts_opened", 0),
+        "alerts_closed": slo_rep.get("alerts_closed", 0),
+        "timeline_samples": slo_rep.get("timeline_samples", 0),
+        "events": events,
+        "reconciled": reconciled,
+    }
+    out["slo_alerts_fired"] = len(page_open)
+    out["slo_alerts_ok"] = bool(page_open) and bool(page_close) and reconciled
+
+    # -- (2) offline determinism vs the committed fixtures -----------------
+    tl = synthesize_overload_timeline()
+    committed = (
+        REPO / "tests" / "traces" / "slo_timeline_overload.jsonl"
+    ).read_text()
+    config = SLOConfig.from_json(
+        REPO / "tests" / "traces" / "slo_overload_spec.json"
+    )
+    replayed = SLOEngine(config, tl).replay(step_s=0.1)
+    expect = json.loads(
+        (REPO / "tests" / "traces" / "slo_expected_alerts.json").read_text()
+    )
+    bucket_s = float(expect["bucket_s"])
+    t0 = tl.bounds()[0]
+    got = [
+        {
+            "slo": e["slo"], "severity": e["severity"],
+            "state": e["state"], "bucket": int((e["t"] - t0) / bucket_s),
+        }
+        for e in replayed
+    ]
+    deterministic = tl.to_jsonl() == committed and got == expect["events"]
+    out["slo"]["offline"] = {
+        "transitions": len(replayed),
+        "timeline_regenerated_byte_exact": tl.to_jsonl() == committed,
+        "expected_sequence_match": got == expect["events"],
+    }
+    out["slo_replay_deterministic"] = deterministic
+
+    # -- (3) /signals schema (the federation consumer contract) ------------
+    signals = slo_rep.get("signals")
+    try:
+        SignalsPayload.model_validate(signals)
+        out["slo_signals_schema_ok"] = True
+    except Exception as e:
+        out["slo_signals_schema_ok"] = False
+        out["slo"]["signals_error"] = f"{type(e).__name__}: {e}"
+
+    # -- (4) sampler overhead, interleaved off/on --------------------------
+    n_fleets = int(_env_num("DPERF_SLO_FLEETS", 10))
+    n_workers = int(_env_num("DPERF_SLO_WORKERS", 2))
+    events_pf = int(_env_num("DPERF_SLO_EVENTS", 40))
+    repeats = max(1, int(_env_num("DPERF_SLO_REPEATS", 2)))
+
+    def arm(sampled: bool) -> dict:
+        return run_loadgen(
+            model,
+            n_fleets=n_fleets,
+            n_workers=n_workers,
+            events_per_fleet=events_pf,
+            fleet_size=int(_env_num("DPERF_GATEWAY_M", 3)),
+            seed=0,
+            k_candidates=[8, 10],
+            mip_gap=MIP_GAP,
+            timeline_period_s=0.05 if sampled else None,
+        )
+
+    runs = {"off": [], "on": []}
+    for _ in range(repeats):
+        runs["off"].append(arm(False))
+        runs["on"].append(arm(True))
+    med_off = statistics.median(r["events_per_sec"] for r in runs["off"])
+    med_on = statistics.median(r["events_per_sec"] for r in runs["on"])
+    overhead = (med_off - med_on) / med_off * 100.0 if med_off > 0 else 0.0
+    out["slo"]["overhead"] = {
+        "fleets": n_fleets,
+        "workers": n_workers,
+        "events_per_fleet": events_pf,
+        "repeats": repeats,
+        "events_per_sec_off": [r["events_per_sec"] for r in runs["off"]],
+        "events_per_sec_on": [r["events_per_sec"] for r in runs["on"]],
+        "timeline_samples": runs["on"][-1].get("timeline_samples", 0),
+        "timeline_sample_errors": runs["on"][-1].get(
+            "timeline_sample_errors", 0
+        ),
+    }
+    # Floored like obs_overhead_pct: negative = box noise, raw alongside.
+    out["slo_overhead_pct"] = round(max(0.0, overhead), 2)
+    out["slo_overhead_pct_raw"] = round(overhead, 2)
+    return out
 
 
 def _twin_bench(model, base_devs) -> dict:
@@ -1716,9 +1939,17 @@ def _main_guarded() -> int:
         "and exit nonzero on a >20%% regression of value or warm_tick_ms "
         "(`make bench-compare`)",
     )
+    parser.add_argument(
+        "--history",
+        default=None,
+        metavar="BENCH_HISTORY.jsonl",
+        help="append this run's headline keys as one committed-format "
+        "JSONL line (`make bench` passes BENCH_HISTORY.jsonl; trend-check "
+        "with `solver slo --history`)",
+    )
     args = parser.parse_args()
     try:
-        return main(against=args.against)
+        return main(against=args.against, history=args.history)
     except BaseException as e:  # noqa: BLE001 - the line matters more
         print(
             json.dumps(

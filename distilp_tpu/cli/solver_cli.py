@@ -430,6 +430,42 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "span and the flight recorder's tick records; see `solver "
         "diagnose` for the one-shot report",
     )
+    # SLO engine (obs.timeline + obs.slo; README "SLOs & alerting"). All
+    # default off — serving without them is byte-identical to the
+    # pre-SLO daemon (no sampler thread, no new counters).
+    p.add_argument(
+        "--slo",
+        default=None,
+        metavar="SPEC.json",
+        help="attach the SLO engine: a fixed-cadence sampler snapshots "
+        "the live metrics into an in-process timeline and evaluates the "
+        "spec's multi-window burn-rate alert rules on every tick (alert "
+        "open/close -> counters + flight records + sched.alert spans; "
+        "GET /slo and GET /signals serve live status under --listen)",
+    )
+    p.add_argument(
+        "--timeline-dir",
+        default=None,
+        metavar="DIR",
+        help="dump the sampled metrics timeline to DIR/timeline.jsonl at "
+        "exit (replay it offline with `solver slo --timeline`); implies "
+        "sampling even without --slo alert rules",
+    )
+    p.add_argument(
+        "--timeline-period-ms",
+        type=float,
+        default=100.0,
+        help="timeline sampler cadence (ms); each tick costs one metrics "
+        "snapshot round trip per worker (bench-gated <= 5%% overhead)",
+    )
+    p.add_argument(
+        "--capacity-eps",
+        type=float,
+        default=None,
+        help="max-sustainable events/sec from a capacity probe (`solver "
+        "overload` / bench overload section): the /signals payload "
+        "reports autoscaling headroom against it",
+    )
     return p
 
 
@@ -666,6 +702,53 @@ def _obs_summary(writer, flight) -> dict:
     return out
 
 
+def _build_slo(args, metrics, sample_fn, tracer, flight):
+    """(timeline, engine, sampler) from the serve SLO flags, all None
+    when neither --slo nor --timeline-dir is set (the byte-identical
+    default path). The sampler is returned STARTED; the caller stops it
+    (or lets Gateway.close do so when it is attached there)."""
+    if not (args.slo or args.timeline_dir):
+        return None, None, None
+    from ..obs import SLOConfig, SLOEngine, Timeline, TimelineSampler
+
+    timeline = Timeline()
+    engine = None
+    if args.slo:
+        config = SLOConfig.from_json(args.slo)
+        engine = SLOEngine(
+            config, timeline, metrics=metrics, tracer=tracer, flight=flight
+        )
+    sampler = TimelineSampler(
+        timeline,
+        sample_fn,
+        period_s=max(0.001, args.timeline_period_ms / 1e3),
+        metrics=metrics,
+        on_sample=(
+            None if engine is None
+            else (lambda _tl, now: engine.evaluate(now))
+        ),
+    )
+    sampler.start()
+    return timeline, engine, sampler
+
+
+def _slo_summary(args, timeline, engine, sampler) -> dict:
+    """The serve summary's "slo" block (+ the timeline dump side effect)."""
+    out: dict = {
+        "samples": sampler.samples,
+        "sample_errors": sampler.errors,
+        "series": len(timeline.names()),
+    }
+    if engine is not None:
+        out["alerts_open"] = len(engine.firing())
+        out["events"] = list(engine.events)
+    if args.timeline_dir:
+        path = Path(args.timeline_dir) / "timeline.jsonl"
+        timeline.dump(path)
+        out["timeline_path"] = str(path)
+    return out
+
+
 def serve_main(argv=None) -> int:
     """``solver serve``: replay a churn trace through the scheduler daemon."""
     args = build_serve_parser().parse_args(argv)
@@ -813,6 +896,9 @@ def serve_main(argv=None) -> int:
             f"obj={r.obj_value:.6f} {ms:8.1f} ms{risk}"
         )
 
+    timeline, slo_engine, sampler = _build_slo(
+        args, sched.metrics, sched.timeline_sample, tracer, flight
+    )
     chaos = None
     try:
         if plan is not None:
@@ -826,6 +912,8 @@ def serve_main(argv=None) -> int:
         print(f"error: replay failed: {e}", file=sys.stderr)
         return 1
     finally:
+        if sampler is not None:
+            sampler.stop()  # before close: no sampling a torn-down daemon
         sched.close()  # release the deadline worker (no-op when unused)
         if tracer is not None:
             tracer.close()  # flush the span JSONL
@@ -846,6 +934,8 @@ def serve_main(argv=None) -> int:
                 sched.metrics.inc("flight_dumps")
     if args.speculate:
         summary["speculation"] = sched.speculation_snapshot()
+    if sampler is not None:
+        summary["slo"] = _slo_summary(args, timeline, slo_engine, sampler)
     if writer is not None or flight is not None:
         summary["obs"] = _obs_summary(writer, flight)
     if args.risk_aware:
@@ -1019,6 +1109,14 @@ def _serve_gateway(args) -> int:
         coalesce=args.coalesce,
         degrade_depth=args.degrade_depth,
     )
+    timeline, slo_engine, sampler = _build_slo(
+        args, gw.metrics, gw.timeline_sample, tracer, flight
+    )
+    if sampler is not None:
+        # Attached: Gateway.close() stops the sampler before the workers
+        # and --listen keeps it (and /slo, /signals) live until ^C.
+        gw.attach_sampler(sampler)
+        gw.attach_slo(slo_engine, timeline, capacity_eps=args.capacity_eps)
     try:
         if args.resume:
             try:
@@ -1193,6 +1291,8 @@ def _serve_gateway(args) -> int:
             ):
                 if flight.trigger("default", "chaos_violation") is not None:
                     gw.scheduler("default").metrics.inc("flight_dumps")
+        if sampler is not None:
+            summary["slo"] = _slo_summary(args, timeline, slo_engine, sampler)
         if writer is not None or flight is not None:
             summary["obs"] = _obs_summary(writer, flight)
         print(json.dumps(summary))
@@ -1379,6 +1479,30 @@ def build_overload_parser() -> argparse.ArgumentParser:
         help="with --check: additionally fail if ANYTHING was shed (the "
         "coalesce smoke's contract: the flood folds instead of shedding)",
     )
+    p.add_argument(
+        "--slo", default=None, metavar="SPEC.json",
+        help="attach the SLO engine to the flood: a timeline sampler "
+        "runs for the arm's whole life, the executor feeds per-event "
+        "scheduled-time latency, and burn-rate alerts open/close live "
+        "(counters + flight records; report grows an 'slo' block)",
+    )
+    p.add_argument(
+        "--settle-s", type=float, default=0.0,
+        help="keep sampling this long AFTER the schedule drains — the "
+        "recovery window a fired burn-rate alert needs to clear",
+    )
+    p.add_argument(
+        "--timeline-out", default=None, metavar="FILE",
+        help="dump the sampled timeline JSONL here (replay offline with "
+        "`solver slo --timeline`)",
+    )
+    p.add_argument(
+        "--expect-alert", action="append", default=None, metavar="SEV",
+        help="with --check: fail unless an alert of this severity "
+        "OPENED during the flood and CLOSED by the end of --settle-s, "
+        "with the open/close counters reconciling record-by-record "
+        "against the flight recorder's slo ring (repeatable)",
+    )
     p.add_argument("--metrics-out", default=None,
                    help="write the report JSON here too")
     p.add_argument("--quiet", action="store_true", help="summary line only")
@@ -1418,6 +1542,20 @@ def overload_main(argv=None) -> int:
         k_candidates = [
             int(x) for x in args.k_candidates.split(",") if x.strip()
         ]
+    slo_config = None
+    if args.slo:
+        from ..obs import SLOConfig
+
+        try:
+            slo_config = SLOConfig.from_json(args.slo)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load --slo spec: {e}", file=sys.stderr)
+            return 2
+    timeline = None
+    if args.slo or args.timeline_out:
+        from ..obs import Timeline
+
+        timeline = Timeline()
     # A recorder is always attached here: the --check reconciliation is
     # the point of the command, and sheds must be observable to audit.
     flight = FlightRecorder(capacity=max(256, 2 * len(items)))
@@ -1438,7 +1576,13 @@ def overload_main(argv=None) -> int:
         coalesce=args.coalesce,
         degrade_depth=args.degrade_depth,
         flight=flight,
+        slo_config=slo_config,
+        timeline=timeline,
+        settle_s=args.settle_s,
     )
+    if args.timeline_out and timeline is not None:
+        timeline.dump(args.timeline_out)
+        report["timeline_path"] = args.timeline_out
     print(json.dumps(report))
     if args.metrics_out:
         Path(args.metrics_out).write_text(json.dumps(report, indent=2))
@@ -1478,6 +1622,51 @@ def overload_main(argv=None) -> int:
                 f"expected zero sheds but {report['shed']} event(s) were "
                 "shed (the flood should have folded, not overflowed)"
             )
+        if args.expect_alert:
+            slo_rep = report.get("slo") or {}
+            events = slo_rep.get("events", [])
+            # Record-by-record reconciliation, shed-contract style: the
+            # engine's transition list, the counters and the flight
+            # recorder's slo ring must all tell the same story.
+            flight_alerts = [
+                r for r in flight.snapshot("slo")
+                if r.get("kind") == "slo_alert"
+            ]
+            if len(flight_alerts) != len(events):
+                problems.append(
+                    f"alert accounting: {len(events)} engine transition(s) "
+                    f"but {len(flight_alerts)} flight record(s)"
+                )
+            opened = sum(1 for e in events if e["state"] == "open")
+            closed = sum(1 for e in events if e["state"] == "close")
+            if opened != slo_rep.get("alerts_opened") or closed != slo_rep.get(
+                "alerts_closed"
+            ):
+                problems.append(
+                    f"alert accounting: events say {opened} open/{closed} "
+                    f"close but counters say "
+                    f"{slo_rep.get('alerts_opened')}/"
+                    f"{slo_rep.get('alerts_closed')}"
+                )
+            for sev in args.expect_alert:
+                sev_open = [
+                    e for e in events
+                    if e["severity"] == sev and e["state"] == "open"
+                ]
+                sev_close = [
+                    e for e in events
+                    if e["severity"] == sev and e["state"] == "close"
+                ]
+                if not sev_open:
+                    problems.append(
+                        f"expected a {sev!r} alert to open during the "
+                        "flood but none did"
+                    )
+                elif len(sev_close) < len(sev_open):
+                    problems.append(
+                        f"{sev!r} alert opened but never closed (recovery "
+                        "window too short, or the burn never cleared)"
+                    )
         if problems:
             for pmsg in problems:
                 print(f"overload violation: {pmsg}", file=sys.stderr)
@@ -1487,6 +1676,240 @@ def overload_main(argv=None) -> int:
             f"record), {report['events_coalesced']} coalesced, "
             f"{report['served']} served valid", file=sys.stderr,
         )
+    return 0
+
+
+def build_slo_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solver slo",
+        description="evaluate SLOs: replay a dumped metrics timeline "
+        "(serve --timeline-dir / overload --timeline-out) against a "
+        "spec file offline — a pure function of (timeline, spec), "
+        "byte-deterministic — or fetch a live gateway's /slo status, "
+        "or trend-check the committed bench history; see README "
+        "'SLOs & alerting'",
+    )
+    p.add_argument(
+        "--spec", default=None, metavar="SPEC.json",
+        help="SLO spec file (obs.slo.SLOConfig JSON); required with "
+        "--timeline",
+    )
+    p.add_argument(
+        "--timeline", default=None, metavar="FILE",
+        help="dumped timeline JSONL to replay the alert evaluation over "
+        "(offline, deterministic)",
+    )
+    p.add_argument(
+        "--url", default=None, metavar="http://HOST:PORT",
+        help="fetch a live gateway's GET /slo instead (serve --listen "
+        "--slo)",
+    )
+    p.add_argument(
+        "--step-s", type=float, default=0.05,
+        help="offline replay evaluation step (seconds of timeline time)",
+    )
+    p.add_argument(
+        "--expect", default=None, metavar="FILE",
+        help="expected alert sequence JSON ({bucket_s, events: [{slo, "
+        "severity, state, bucket}]}): the replayed transitions must "
+        "match EXACTLY — tier, window set, state and firing-timestamp "
+        "bucket (exit 1 on any difference)",
+    )
+    p.add_argument(
+        "--history", default=None, metavar="BENCH_HISTORY.jsonl",
+        help="evaluate trend rules over the committed bench history "
+        "(tools/bench_history.py appends one line per `make bench`): "
+        "the newest round's headline keys may not regress past the "
+        "prior-median tolerance",
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="exit 1 on any violation: --expect mismatch, alert-counter "
+        "vs transition-list drift, or a --history trend regression",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the evaluation as one JSON object instead of tables",
+    )
+    p.add_argument("--quiet", action="store_true", help="no tables")
+    return p
+
+
+def _slo_render_tables(status: dict, events: list) -> None:
+    print(f"{'slo':<28s} {'sev':<6s} {'window':>9s} {'burn':>10s} "
+          f"{'threshold':>9s} {'firing':>6s}")
+    for slo in status.get("slos", []):
+        for rule in slo.get("alerts", []):
+            for w in rule.get("windows", []):
+                burn = w.get("burn")
+                print(
+                    f"{slo['name']:<28s} {rule['severity']:<6s} "
+                    f"{w['window_s']:>8.6g}s "
+                    f"{'-' if burn is None else format(burn, '>10.3f')} "
+                    f"{w['threshold']:>9.3g} "
+                    f"{str(rule['firing']):>6s}"
+                )
+    if events:
+        print(f"\n{'t':>10s} {'slo':<28s} {'sev':<6s} {'state':<6s} burn")
+        for e in events:
+            print(
+                f"{e['t']:>10.3f} {e['slo']:<28s} {e['severity']:<6s} "
+                f"{e['state']:<6s} {e['burn']}"
+            )
+    else:
+        print("\nno alert transitions")
+
+
+def slo_main(argv=None) -> int:
+    """``solver slo``: offline timeline replay / live status / trends."""
+    args = build_slo_parser().parse_args(argv)
+
+    # Pure JSON-in, JSON-out: no profiles, no backend, no axon guard.
+    violations: list = []
+    payload: dict = {}
+
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/slo"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                status = json.loads(resp.read())
+        except OSError as e:
+            print(f"error: cannot fetch {url}: {e}", file=sys.stderr)
+            return 2
+        payload["live"] = status
+        if not args.quiet and not args.json:
+            _slo_render_tables(status, status.get("events", []))
+        if status.get("alerts_open"):
+            violations.append(
+                f"{status['alerts_open']} alert(s) currently open on "
+                f"{url}"
+            )
+
+    if args.timeline:
+        if not args.spec:
+            print("error: --timeline needs --spec", file=sys.stderr)
+            return 2
+        from ..obs import FlightRecorder, SLOConfig, SLOEngine, Timeline
+        from ..sched.metrics import SchedulerMetrics
+
+        try:
+            config = SLOConfig.from_json(args.spec)
+            timeline = Timeline.load(args.timeline)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load inputs: {e}", file=sys.stderr)
+            return 2
+        # The offline engine gets its own sink + flight ring so the
+        # counter/record/transition reconciliation (the live contract)
+        # is checkable on a replay too.
+        metrics = SchedulerMetrics()
+        flight = FlightRecorder(capacity=4096)
+        engine = SLOEngine(config, timeline, metrics=metrics, flight=flight)
+        events = engine.replay(step_s=args.step_s)
+        status = engine.status()
+        payload["replay"] = {
+            "events": events,
+            "alerts_open": status["alerts_open"],
+            "step_s": args.step_s,
+        }
+        counters = metrics.snapshot()["counters"]
+        opened = sum(1 for e in events if e["state"] == "open")
+        closed = sum(1 for e in events if e["state"] == "close")
+        if counters.get("slo_alert_opened", 0) != opened or counters.get(
+            "slo_alert_closed", 0
+        ) != closed:
+            violations.append(
+                "alert accounting: transitions "
+                f"({opened} open/{closed} close) disagree with counters "
+                f"({counters.get('slo_alert_opened', 0)}/"
+                f"{counters.get('slo_alert_closed', 0)})"
+            )
+        flight_alerts = [
+            r for r in flight.snapshot("slo") if r.get("kind") == "slo_alert"
+        ]
+        if len(flight_alerts) != len(events):
+            violations.append(
+                f"alert accounting: {len(events)} transition(s) but "
+                f"{len(flight_alerts)} flight record(s)"
+            )
+        if args.expect:
+            try:
+                expect = json.loads(Path(args.expect).read_text())
+            except (OSError, ValueError) as e:
+                print(f"error: cannot load --expect: {e}", file=sys.stderr)
+                return 2
+            bucket_s = float(expect.get("bucket_s", 1.0))
+            bounds = timeline.bounds()
+            t0 = bounds[0] if bounds else 0.0
+            got = [
+                {
+                    "slo": e["slo"],
+                    "severity": e["severity"],
+                    "state": e["state"],
+                    "bucket": int((e["t"] - t0) / bucket_s),
+                }
+                for e in events
+            ]
+            if got != expect.get("events"):
+                violations.append(
+                    "alert sequence mismatch:\n  expected "
+                    f"{json.dumps(expect.get('events'))}\n  got      "
+                    f"{json.dumps(got)}"
+                )
+            payload["replay"]["expected_match"] = got == expect.get("events")
+        if not args.quiet and not args.json:
+            _slo_render_tables(status, events)
+
+    if args.history:
+        from ..obs.slo import evaluate_history
+
+        try:
+            rows = [
+                json.loads(ln)
+                for ln in Path(args.history).read_text().splitlines()
+                if ln.strip()
+            ]
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load --history: {e}", file=sys.stderr)
+            return 2
+        table, trend_violations = evaluate_history(rows)
+        payload["history"] = {"rows": len(rows), "table": table}
+        violations.extend(trend_violations)
+        if not args.quiet and not args.json:
+            print(
+                f"\nbench history ({len(rows)} round(s)): "
+                f"{'key':<36s} {'median':>12s} {'latest':>12s} {'delta':>8s}"
+            )
+            for row in table:
+                med = row["median"]
+                lat = row["latest"]
+                chg = row["change"]
+                print(
+                    f"{'':41s}{row['key']:<36s} "
+                    f"{'-' if med is None else format(med, '>12.4g')} "
+                    f"{'-' if lat is None else format(lat, '>12.4g')} "
+                    f"{'-' if chg is None else format(chg, '>+8.1%')}"
+                )
+
+    if not (args.url or args.timeline or args.history):
+        print(
+            "error: nothing to evaluate (need --timeline, --url or "
+            "--history)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.json:
+        payload["violations"] = violations
+        print(json.dumps(payload))
+    if violations:
+        for v in violations:
+            print(f"slo violation: {v}", file=sys.stderr)
+        if args.check:
+            return 1
+    elif args.check and not args.quiet:
+        print("slo check OK", file=sys.stderr)
     return 0
 
 
@@ -1516,6 +1939,14 @@ def build_spans_parser() -> argparse.ArgumentParser:
         default=3,
         help="also print the N slowest spans (0 disables)",
     )
+    p.add_argument(
+        "--stats",
+        action="store_true",
+        help="aggregate instead of convert: one row per span NAME "
+        "(count, p50/p99/max duration, top slowest with trace ids) — "
+        "the CI-log-readable view Perfetto cannot give; skips the "
+        "Chrome JSON unless --out is also given",
+    )
     p.add_argument("--quiet", action="store_true", help="no summary output")
     return p
 
@@ -1525,7 +1956,7 @@ def spans_main(argv=None) -> int:
     args = build_spans_parser().parse_args(argv)
 
     # Pure JSON-to-JSON: no profiles, no backend, no axon guard needed.
-    from ..obs import read_spans, spans_to_chrome, top_spans
+    from ..obs import read_spans, span_stats, spans_to_chrome, top_spans
 
     src = Path(args.input)
     if src.is_dir():
@@ -1541,6 +1972,23 @@ def spans_main(argv=None) -> int:
     if not spans:
         print(f"error: {src} holds no spans", file=sys.stderr)
         return 1
+    if args.stats:
+        rows = span_stats(spans, top=max(0, args.top))
+        print(
+            f"{'span':<22s} {'count':>6s} {'total ms':>10s} "
+            f"{'p50 ms':>9s} {'p99 ms':>9s} {'max ms':>9s}  slowest (trace ids)"
+        )
+        for r in rows:
+            slow = ", ".join(
+                f"{s['dur_ms']:.2f}ms@{s['trace_id']}" for s in r["slowest"]
+            )
+            print(
+                f"{r['name']:<22s} {r['count']:>6d} {r['total_ms']:>10.3f} "
+                f"{r['p50_ms']:>9.3f} {r['p99_ms']:>9.3f} "
+                f"{r['max_ms']:>9.3f}  {slow}"
+            )
+        if not args.out:
+            return 0
     chrome = spans_to_chrome(spans)
     out = Path(args.out) if args.out else src.with_suffix(".chrome.json")
     out.write_text(json.dumps(chrome))
@@ -1755,6 +2203,8 @@ def main(argv=None) -> int:
         return diagnose_main(argv[1:])
     if argv and argv[0] == "overload":
         return overload_main(argv[1:])
+    if argv and argv[0] == "slo":
+        return slo_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     from ..axon_guard import force_cpu_if_env_requested

@@ -207,6 +207,21 @@ class Gateway:
         self._shed_counts: Dict[str, int] = {}
         # EWMA of event->placement ms, the Retry-After estimate's input.
         self._serve_ewma_ms: Optional[float] = None
+        # Attached background observers (timeline samplers, prom
+        # scrapers): anything with a .stop(join=True) that reads this
+        # gateway on its own thread. close() stops them BEFORE the
+        # workers so a probe mid-round-trip can never land on a stopping
+        # worker (the PR 8 bench ordering gotcha, fixed at the source).
+        self._samplers: List = []
+        # SLO layer (obs.timeline + obs.slo), opt-in via attach_slo():
+        # None everywhere by default — /slo and /signals 404, no sampler
+        # thread, no new counters (byte-identical serving, pinned).
+        self.timeline = None
+        self.slo_engine = None
+        # Max-sustainable events/sec from the PR 12 closed-loop capacity
+        # probe (serve --capacity-eps / the bench's measured value): the
+        # denominator of /signals' headroom computation.
+        self.capacity_eps: Optional[float] = None
 
     # -- shard lifecycle ---------------------------------------------------
 
@@ -824,6 +839,80 @@ class Gateway:
             },
         )
 
+    def attach_sampler(self, sampler):
+        """Register a background observer thread (timeline sampler, prom
+        scraper — anything with ``.stop(join=True)``) for teardown:
+        ``close()`` stops every attached sampler before the workers, so
+        the observer can never probe a stopping worker. Returns the
+        sampler for chaining."""
+        self._samplers.append(sampler)
+        return sampler
+
+    def attach_slo(
+        self, engine, timeline, capacity_eps: Optional[float] = None
+    ) -> None:
+        """Install the SLO engine + timeline this gateway serves on
+        ``GET /slo`` / ``GET /signals`` (see ``obs.slo``). The caller
+        owns sampler construction (and usually attaches it via
+        ``attach_sampler``); this only wires the read surface."""
+        self.slo_engine = engine
+        self.timeline = timeline
+        if capacity_eps is not None:
+            self.capacity_eps = capacity_eps
+
+    def timeline_sample(self) -> Dict[str, float]:
+        """One flat ``{series: value}`` sample for the metrics timeline:
+        gateway counters (``c.<name>``), per-shard aggregate counters
+        (``shards.<name>``), gateway latency quantiles (``lat.<hist>.*``)
+        and the live per-worker queue depths (``queue_depth.w<i>`` — the
+        admission-control input the /signals trend derives from). One
+        ``metrics_snapshot`` round trip per worker per tick; that cost
+        is exactly what the bench's slo section gates at <= 5%."""
+        from ..obs.timeline import flatten_metrics_snapshot
+
+        snap = self.metrics_snapshot()
+        out = flatten_metrics_snapshot(snap)
+        for name, value in snap.get("shard_totals", {}).items():
+            out[f"shards.{name}"] = float(value)
+        # The availability SLO's inputs always exist, zero-valued before
+        # the first event: a counter minted mid-incident would otherwise
+        # have no pre-incident baseline sample, and the burst's delta
+        # would be invisible to every window that needs it most.
+        out.setdefault("c.gateway_events", 0.0)
+        out.setdefault("c.events_shed", 0.0)
+        # Offered = accepted + shed: the availability SLO's denominator
+        # (a shed never reaches gateway_events, and an error ratio over
+        # accepted-only would understate a shedding gateway's burn).
+        out["c.events_offered"] = out["c.gateway_events"] + out["c.events_shed"]
+        depths = [w.depth() for w in self.workers]
+        for i, d in enumerate(depths):
+            out[f"queue_depth.w{i}"] = float(d)
+        out["queue_depth.max"] = float(max(depths) if depths else 0)
+        return out
+
+    def slo_status(self) -> dict:
+        """The ``GET /slo`` payload (KeyError -> HTTP 404 when no SLO
+        engine is attached — same contract as the flight endpoint)."""
+        if self.slo_engine is None:
+            raise KeyError("SLO engine not enabled (serve --slo <spec>)")
+        return self.slo_engine.status()
+
+    def signals(self) -> dict:
+        """The ``GET /signals`` autoscaling payload (versioned, schema'd
+        by ``obs.slo.SignalsPayload``)."""
+        if self.timeline is None:
+            raise KeyError(
+                "signals need a metrics timeline (serve --slo <spec> "
+                "or --timeline-dir; burn rates need --slo)"
+            )
+        from ..obs.slo import build_signals
+
+        return build_signals(
+            self.timeline,
+            engine=self.slo_engine,
+            capacity_eps=self.capacity_eps,
+        ).model_dump()
+
     def flight_snapshot(self, fleet_id: str) -> List[dict]:
         """The fleet's live flight-recorder ring (``GET /debug/flight/<fleet>``)."""
         if self.flight is None:
@@ -926,10 +1015,27 @@ class Gateway:
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:
-        """Stop every worker (graceful: queued work drains first)."""
+        """Stop attached samplers, then every worker (graceful: queued
+        work drains first). Idempotent — CLI finally blocks, harness
+        teardowns and ``with`` exits may all call it.
+
+        Sampler order matters: an attached prom scraper or timeline
+        sampler probes the workers on its own thread, and a probe landing
+        after a worker stopped would count a scrape error on a perfectly
+        clean shutdown (the PR 8 bench re-learned this per harness; now
+        the gateway owns the ordering). ``stop()`` on a sampler is
+        required idempotent, so a harness that already stopped its own
+        sampler is fine."""
         if self._closed:
             return
         self._closed = True
+        for sampler in self._samplers:
+            try:
+                sampler.stop()
+            except Exception:
+                # A sampler that fails to stop must not leak workers; the
+                # failure is counted, teardown continues.
+                self.metrics.inc("timeline_sample_error")
         for w in self.workers:
             w.stop()
 

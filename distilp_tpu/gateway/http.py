@@ -18,6 +18,16 @@ Five routes:
                                  labeled per-shard exposition
     GET  /debug/flight/<fleet>   -> 200 the fleet's live flight-recorder
                                  ring (404 unless serving with a recorder)
+    GET  /slo                    -> 200 live SLO status: per-objective
+                                 budget, per-window burn rates, open
+                                 alerts (404 unless serving with --slo)
+    GET  /signals                -> 200 the versioned autoscaling payload
+                                 (obs.slo.SignalsPayload: per-worker
+                                 queue depth + trend, burn rates,
+                                 headroom vs max-sustainable-eps; 404
+                                 unless a metrics timeline is attached —
+                                 serve --slo or --timeline-dir; the
+                                 burn-rate block needs --slo)
 
 One connection = one request (``Connection: close``): the serving tier's
 clients are schedulers and probes, not browsers, and the parser stays ~50
@@ -250,6 +260,12 @@ class GatewayHTTPServer:
                 None, self.gateway.metrics_snapshot
             )
             return 200, snap, _JSON
+        if method == "GET" and path == "/slo":
+            status = await loop.run_in_executor(None, self.gateway.slo_status)
+            return 200, status, _JSON
+        if method == "GET" and path == "/signals":
+            signals = await loop.run_in_executor(None, self.gateway.signals)
+            return 200, signals, _JSON
         if method == "GET" and path.startswith("/debug/flight/"):
             fleet_id = path[len("/debug/flight/"):]
             records = await loop.run_in_executor(

@@ -34,6 +34,7 @@ Runnable directly:
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -41,6 +42,59 @@ from ..sched.metrics import _quantile
 from ..sched.sim import generate_trace
 from .gateway import Gateway
 from .traces import make_fleet_from_spec
+
+
+class PromScraper:
+    """Background Prometheus-exposition scrape loop against one gateway.
+
+    The bench's "observability on" arms run this as the realistic
+    sidecar load: every period the full labeled exposition renders, its
+    per-worker round trips queueing behind live solves exactly like an
+    external scraper hitting ``GET /metrics``.
+
+    Lifecycle contract: ``stop()`` is idempotent and joins the thread,
+    and the scraper registers itself with the gateway
+    (``gateway.attach_sampler``), so ``Gateway.close()`` stops it BEFORE
+    stopping the workers — a scrape can therefore never land on a
+    stopping worker and count a ``prom_scrape_error`` on a clean
+    shutdown (the PR 8 bench gotcha every harness used to re-learn,
+    pinned by the close-during-scrape test in tests/test_obs.py).
+    """
+
+    def __init__(self, gateway: Gateway, period_s: float):
+        if period_s <= 0:
+            raise ValueError("scrape period must be > 0")
+        self.gateway = gateway
+        self.period_s = period_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.scrapes = 0
+        gateway.attach_sampler(self)
+
+    def start(self) -> "PromScraper":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="prom-scrape"
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.gateway.prometheus_text()
+                self.scrapes += 1
+            except Exception:
+                # The scrape must never kill the arm; a failure is a
+                # real observability signal, so it is counted.
+                self.gateway.metrics.inc("prom_scrape_error")
+
+    def stop(self, join: bool = True, timeout: float = 2.0) -> None:
+        self._stop.set()
+        thread = self._thread
+        if join and thread is not None and thread.is_alive():
+            thread.join(timeout=timeout)
 
 
 def make_fleet_specs(
@@ -158,6 +212,7 @@ def run_loadgen(
     scheduler_kwargs: Optional[dict] = None,
     tracer=None,
     prom_scrape_s: Optional[float] = None,
+    timeline_period_s: Optional[float] = None,
 ) -> dict:
     """One full loadgen arm: build fleets, replay, report, tear down.
 
@@ -172,8 +227,6 @@ def run_loadgen(
     scrape thread is a real scrape: its per-worker round trips queue
     behind live solves, exactly like a sidecar hitting ``/metrics``).
     """
-    import threading
-
     total_events = events_per_fleet + warmup_per_fleet
     specs = make_fleet_specs(n_fleets, fleet_size=fleet_size, seed=seed)
     items = make_loadgen_trace(specs, total_events, seed=seed, scenario=scenario)
@@ -187,21 +240,25 @@ def run_loadgen(
     gateway = Gateway(
         n_workers=n_workers, scheduler_kwargs=kwargs, tracer=tracer
     )
-    scrape_stop = threading.Event()
     scraper = None
     if prom_scrape_s is not None:
+        # Self-attaching: Gateway.close() stops it before the workers,
+        # so the harness needs no stop-ordering knowledge of its own.
+        scraper = PromScraper(gateway, prom_scrape_s)
+    sampler = None
+    if timeline_period_s is not None:
+        # The bench's slo-overhead arm: a live timeline sampler at the
+        # given cadence, each tick one metrics round trip per worker —
+        # the cost the <= 5% gate measures. Attached, so close() stops it.
+        from ..obs.timeline import Timeline, TimelineSampler
 
-        def _scrape() -> None:
-            while not scrape_stop.wait(prom_scrape_s):
-                try:
-                    gateway.prometheus_text()
-                except Exception:
-                    # The scrape must never kill the arm; a failure is a
-                    # real observability signal, so it is counted.
-                    gateway.metrics.inc("prom_scrape_error")
-
-        scraper = threading.Thread(
-            target=_scrape, daemon=True, name="prom-scrape"
+        sampler = gateway.attach_sampler(
+            TimelineSampler(
+                Timeline(),
+                gateway.timeline_sample,
+                period_s=timeline_period_s,
+                metrics=gateway.metrics,
+            )
         )
     try:
         for fleet_id, spec in specs.items():
@@ -210,6 +267,8 @@ def run_loadgen(
             )
         if scraper is not None:
             scraper.start()
+        if sampler is not None:
+            sampler.start()
         measure_from = {f: warmup_per_fleet for f in specs}
         report = asyncio.run(replay_concurrent(gateway, items, measure_from))
         snap = gateway.metrics_snapshot()
@@ -230,13 +289,17 @@ def run_loadgen(
             report["prom_scrape_errors"] = snap["counters"].get(
                 "prom_scrape_error", 0
             )
+        if sampler is not None:
+            report["timeline_samples"] = snap["counters"].get(
+                "timeline_samples", 0
+            )
+            report["timeline_sample_errors"] = snap["counters"].get(
+                "timeline_sample_error", 0
+            )
         return report
     finally:
-        # Scraper first: a scrape landing on a stopping worker would only
-        # count an error, but the arm should end quiet.
-        scrape_stop.set()
-        if scraper is not None and scraper.is_alive():
-            scraper.join(timeout=2.0)
+        # close() stops the attached scraper first, then the workers —
+        # the ordering lives in Gateway.close now, not per harness.
         gateway.close()
 
 

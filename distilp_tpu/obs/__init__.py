@@ -1,9 +1,10 @@
 """Observability for the serving stack: tracing, exporters, flight data,
-and solver-interior convergence reports.
+solver-interior convergence reports, metrics timelines and SLO alerting.
 
-Four pieces, all opt-in and backend-free (the obs layer imports neither
+Six pieces, all opt-in and backend-free (the obs layer imports neither
 jax nor numpy nor the solver — it is plumbing the serving layers thread
-data through; ``convergence`` adds pydantic, already a core dependency):
+data through; ``convergence``/``slo`` add pydantic, already a core
+dependency):
 
 - ``trace``  — span-based tracing of the event path (HTTP ingest → shard
   routing → worker queue wait → scheduler tick → solve → publish), a
@@ -20,7 +21,16 @@ data through; ``convergence`` adds pydantic, already a core dependency):
   (per-chunk LP residual traces, the branch-and-bound round log): the
   ``solver diagnose`` CLI and the bench ``convergence`` section render
   these, and the digest rides ``timings`` onto the ``sched.solve`` span
-  and flight-recorder tick records.
+  and flight-recorder tick records;
+- ``timeline`` — the in-process time-series layer: a fixed-cadence
+  sampler snapshots the serving tier's own sinks into bounded per-series
+  rings of (t, value), with rates/ratios/window fractions derived from
+  deltas and a flight-recorder-style JSONL dump/load;
+- ``slo`` — declarative SLO specs compiled into error budgets with
+  multi-window multi-burn-rate alert rules (hysteretic open/close, the
+  ``sched.alert`` span + flight trail), the ``GET /slo``/``GET /signals``
+  payloads (``SignalsPayload`` is the versioned autoscaling contract)
+  and the ``solver slo`` CLI's offline timeline replay.
 
 See README "Observability" / "Convergence diagnostics" for the span model,
 the label table, and the trace-buffer semantics.
@@ -39,10 +49,26 @@ from .export import (
     parse_prometheus_text,
     read_spans,
     render_prometheus,
+    span_stats,
     spans_to_chrome,
     top_spans,
 )
 from .flight import FlightRecorder
+from .slo import (
+    AlertRule,
+    BurnWindow,
+    SignalsPayload,
+    SLOConfig,
+    SLOEngine,
+    SLOSpec,
+    build_signals,
+)
+from .timeline import (
+    Timeline,
+    TimelineSampler,
+    flatten_metrics_snapshot,
+    synthesize_overload_timeline,
+)
 from .trace import (
     NOOP_SPAN,
     NOOP_TRACER,
@@ -64,9 +90,21 @@ __all__ = [
     "read_spans",
     "spans_to_chrome",
     "top_spans",
+    "span_stats",
     "render_prometheus",
     "parse_prometheus_text",
     "FlightRecorder",
+    "Timeline",
+    "TimelineSampler",
+    "flatten_metrics_snapshot",
+    "synthesize_overload_timeline",
+    "SLOConfig",
+    "SLOSpec",
+    "SLOEngine",
+    "AlertRule",
+    "BurnWindow",
+    "SignalsPayload",
+    "build_signals",
     "LPChunkSample",
     "ConvergenceTrace",
     "RoundRecord",

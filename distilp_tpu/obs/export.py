@@ -33,6 +33,7 @@ __all__ = [
     "read_spans",
     "spans_to_chrome",
     "top_spans",
+    "span_stats",
     "render_prometheus",
     "parse_prometheus_text",
 ]
@@ -139,6 +140,46 @@ def spans_to_chrome(spans: List[dict]) -> dict:
 def top_spans(spans: List[dict], n: int = 3) -> List[dict]:
     """The n slowest spans (the walkthrough's "where did the time go")."""
     return sorted(spans, key=lambda s: s.get("dur_ms", 0.0), reverse=True)[:n]
+
+
+def span_stats(spans: List[dict], top: int = 3) -> List[dict]:
+    """Per-span-name aggregates for CI logs: Perfetto is the deep-dive
+    tool, but a test log needs "which span got slow" as TEXT. One row per
+    span name — count, total/p50/p99/max duration, and the ``top``
+    slowest instances with their trace ids (the handle a post-mortem
+    greps the span JSONL for). Rows sort by total duration, descending —
+    the"where did the wall clock go" order."""
+    from ..sched.metrics import _quantile
+
+    by_name: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_name.setdefault(s.get("name", "?"), []).append(s)
+    rows: List[dict] = []
+    for name, group in by_name.items():
+        durs = sorted(float(s.get("dur_ms", 0.0)) for s in group)
+        slowest = sorted(
+            group, key=lambda s: s.get("dur_ms", 0.0), reverse=True
+        )[:top]
+        rows.append(
+            {
+                "name": name,
+                "count": len(group),
+                "total_ms": round(sum(durs), 3),
+                "p50_ms": round(_quantile(durs, 0.50), 3),
+                "p99_ms": round(_quantile(durs, 0.99), 3),
+                "max_ms": round(durs[-1], 3) if durs else 0.0,
+                "slowest": [
+                    {
+                        "dur_ms": s.get("dur_ms", 0.0),
+                        "trace_id": s.get("trace_id"),
+                        "thread": s.get("thread"),
+                    }
+                    for s in slowest
+                ],
+            }
+        )
+    rows.sort(key=lambda r: r["total_ms"], reverse=True)
+    return rows
 
 
 # -- Prometheus v0.0.4 text exposition --------------------------------------

@@ -159,6 +159,11 @@ METRIC_REGISTRY = {
     # -- observability layer ----------------------------------------------
     "flight_dumps": "Flight-recorder post-mortem dumps written",
     "health_state": "Shard health as a gauge (0 healthy, 1 degraded, 2 broken)",
+    # -- SLO engine / metrics timelines (obs.timeline + obs.slo) ----------
+    "timeline_samples": "Timeline sampler ticks that recorded a sample",
+    "timeline_sample_error": "Timeline sampler ticks that failed (counted, never fatal)",
+    "slo_alert_opened": "SLO burn-rate alerts opened (multi-window AND fired)",
+    "slo_alert_closed": "SLO burn-rate alerts closed (hysteresis cleared)",
     # -- latency histograms (exposed as Prometheus summaries, ms) ---------
     "event_to_placement": "Event to published placement, ms (per shard)",
     "structural_tick": "Structural-event tick latency, ms",

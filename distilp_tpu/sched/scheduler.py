@@ -1462,6 +1462,34 @@ class Scheduler:
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot()
 
+    def timeline_sample(self) -> dict:
+        """One flat ``{series: value}`` sample for the metrics timeline
+        (``obs.timeline.TimelineSampler``'s sample_fn on the
+        single-scheduler serving path).
+
+        Carries the SLO inputs the spec layer names: every counter
+        (``c.<name>``, cumulative), latency quantiles (``lat.<hist>.*``
+        — iters-to-certify rides ``lat.ipm_iters_executed``), the serve
+        clock (``last_serve_ms``), the health rank, and — when solver
+        diagnostics are on — the latest tick's ``conv_*`` digest
+        (``conv.<key>``). Pure read; no timeline knob engaged means this
+        is simply never called.
+        """
+        from ..obs.timeline import flatten_metrics_snapshot
+
+        out = flatten_metrics_snapshot(self.metrics.snapshot())
+        out["last_serve_ms"] = float(self.last_serve_ms)
+        out["health"] = float(
+            {HEALTH_HEALTHY: 0, HEALTH_DEGRADED: 1, HEALTH_BROKEN: 2}[
+                self.health
+            ]
+        )
+        if self._tick_conv:
+            for k, v in self._tick_conv.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"conv.{k}"] = float(v)
+        return out
+
     # -- warm snapshot / restore (the gateway's drain/restore cycle) -------
 
     def dump_state(self) -> dict:

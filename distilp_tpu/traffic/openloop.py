@@ -23,6 +23,7 @@ unaccounted quarantine in the chaos soak.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict, List, Optional, Sequence
 
 from ..gateway.gateway import Gateway, QueueFull
@@ -44,6 +45,7 @@ async def execute_openloop(
     items: Sequence[ScheduledEvent],
     time_scale: float = 1.0,
     on_event=None,
+    timeline=None,
 ) -> dict:
     """Fire ``items`` at their (scaled) scheduled times; gather results.
 
@@ -53,6 +55,13 @@ async def execute_openloop(
     sequence past saturation deterministically. Returns the report dict
     (see keys below); per-event outcomes stream through ``on_event(item,
     outcome)`` with outcome one of 'served'/'shed'/'failed'.
+
+    ``timeline`` (an ``obs.timeline.Timeline``) is the per-window latency
+    feed: each served event's scheduled-time latency lands as a point on
+    ``openloop.latency_ms`` (and each shed as a tick on the cumulative
+    ``openloop.sheds``) at the moment it happened — so a latency-tier SLO
+    evaluated DURING the flood sees the client clock's window, not just
+    the end-of-run percentiles this function returns.
     """
     if time_scale <= 0:
         raise ValueError("time_scale must be > 0")
@@ -77,6 +86,10 @@ async def execute_openloop(
                     "retry_after_s": e.retry_after_s,
                 }
             )
+            if timeline is not None:
+                timeline.record(
+                    "openloop.sheds", loop.time(), counts["shed"]
+                )
             if on_event is not None:
                 on_event(item, "shed")
             return
@@ -93,6 +106,10 @@ async def execute_openloop(
             counts["invalid"] += 1
         counts["served"] += 1
         lat_ms.append(done_ms)
+        if timeline is not None:
+            # loop.time() IS time.monotonic() on the default event loop,
+            # so these points share the timeline sampler's clock.
+            timeline.record("openloop.latency_ms", loop.time(), done_ms)
         if on_event is not None:
             on_event(item, "served")
 
@@ -257,6 +274,10 @@ def run_openloop(
     degrade_depth: Optional[int] = None,
     flight=None,
     tracer=None,
+    slo_config=None,
+    timeline=None,
+    timeline_period_s: float = 0.05,
+    settle_s: float = 0.0,
 ) -> dict:
     """One full open-loop arm: build, warm, fire, report, tear down.
 
@@ -265,6 +286,15 @@ def run_openloop(
     schedule executes open-loop. The report merges the executor's numbers
     with the gateway's admission counters and — when a flight recorder is
     attached — the shed reconciliation verdict.
+
+    SLO arm (``slo_config``, an ``obs.slo.SLOConfig``): a timeline
+    sampler runs for the arm's whole life (evaluating the SLO engine on
+    every tick), the executor feeds per-event scheduled-time latency into
+    the timeline, and the report grows an ``slo`` block (status + the
+    alert open/close sequence) plus ``timeline_samples``. ``settle_s``
+    keeps sampling AFTER the schedule drains — the recovery window a
+    burn-rate alert needs to clear, which is exactly what the smoke
+    asserts. ``timeline`` alone (no config) just records, no alerting.
     """
     kwargs = {
         "mip_gap": mip_gap,
@@ -277,6 +307,12 @@ def run_openloop(
         n_workers=n_workers, scheduler_kwargs=kwargs,
         flight=flight, tracer=tracer,
     )
+    engine = None
+    sampler = None
+    if slo_config is not None and timeline is None:
+        from ..obs.timeline import Timeline
+
+        timeline = Timeline()
     try:
         from ..gateway.traces import make_fleet_from_spec
 
@@ -284,6 +320,30 @@ def run_openloop(
             gateway.register_fleet(
                 fleet_id, make_fleet_from_spec(fleet_id, spec), model
             )
+        if slo_config is not None:
+            from ..obs.slo import SLOEngine
+
+            engine = SLOEngine(
+                slo_config, timeline, metrics=gateway.metrics,
+                tracer=tracer, flight=flight,
+            )
+            gateway.attach_slo(engine, timeline)
+        if timeline is not None:
+            from ..obs.timeline import TimelineSampler
+
+            sampler = gateway.attach_sampler(
+                TimelineSampler(
+                    timeline,
+                    gateway.timeline_sample,
+                    period_s=timeline_period_s,
+                    metrics=gateway.metrics,
+                    on_sample=(
+                        None if engine is None
+                        else (lambda _tl, now: engine.evaluate(now))
+                    ),
+                )
+            )
+            sampler.start()
         if warmup_per_fleet > 0:
             asyncio.run(
                 _warmup(gateway, specs, warmup_per_fleet, warmup_seed)
@@ -294,8 +354,17 @@ def run_openloop(
             degrade_depth=degrade_depth,
         )
         report = asyncio.run(
-            execute_openloop(gateway, items, time_scale=time_scale)
+            execute_openloop(
+                gateway, items, time_scale=time_scale, timeline=timeline
+            )
         )
+        if settle_s > 0 and sampler is not None:
+            # Recovery window: the schedule drained, the sampler keeps
+            # watching — this is where a fired burn-rate alert clears
+            # (windowed deltas go to zero once the burst slides out).
+            deadline = time.monotonic() + settle_s
+            while time.monotonic() < deadline:
+                time.sleep(min(timeline_period_s, 0.05))
         snap = gateway.metrics_snapshot()
         totals = snap["shard_totals"]
         report.update(
@@ -316,8 +385,23 @@ def run_openloop(
         )
         if flight is not None:
             report["shed_violations"] = shed_violations(gateway, flight)
+        if engine is not None:
+            report["slo"] = {
+                "alerts_opened": snap["counters"].get("slo_alert_opened", 0),
+                "alerts_closed": snap["counters"].get("slo_alert_closed", 0),
+                "timeline_samples": snap["counters"].get(
+                    "timeline_samples", 0
+                ),
+                "events": list(engine.events),
+                "firing": engine.firing(),
+                # The /signals payload as the live gateway would serve it
+                # — the bench validates it against SignalsPayload so the
+                # federation contract is schema-checked on every capture.
+                "signals": gateway.signals(),
+            }
         return report
     finally:
+        # close() stops the attached sampler before the workers.
         gateway.close()
 
 

@@ -466,6 +466,46 @@ def main() -> int:
         f"{co_arm['p99_ms']:.0f} ms — the burst compresses instead of "
         "queueing: saturation is a plateau, not a cliff"
     )
+
+    # ------------------------------------------------------------------
+    # 15. SLOs: step 14 showed the gateway SURVIVING overload; nothing
+    #     yet said whether the run MET its objectives. Replay the same
+    #     flood with an SLO attached: a timeline sampler snapshots the
+    #     live metrics, the availability objective (1 - shed ratio,
+    #     budget 1%) compiles into multi-window burn-rate rules, and the
+    #     page-tier alert opens AT the shed onset (both windows burning
+    #     >= 10x budget at once) and closes after recovery — hysteresis
+    #     means flapping load could not flap it. The open/close trail
+    #     lands in the counters AND the flight recorder, record for
+    #     record (README "SLOs & alerting"; the same engine serves
+    #     GET /slo and the /signals autoscaling payload under --listen).
+    # ------------------------------------------------------------------
+    from distilp_tpu.obs import SLOConfig
+
+    slo_flight = FlightRecorder(capacity=2 * len(ol_items))
+    slo_arm = run_openloop(
+        gw_model, ol_specs, ol_items, 2, time_scale=0.001,
+        k_candidates=[8, 10], max_queue_depth=2, flight=slo_flight,
+        slo_config=SLOConfig.from_json("tests/traces/slo_live_spec.json"),
+        settle_s=3.0,
+    )
+    slo = slo_arm["slo"]
+    for e in slo["events"]:
+        burns = ", ".join(f"{w}={b}x" for w, b in e["burn"].items())
+        print(
+            f"[15] alert {e['state']:<5s} {e['slo']}/{e['severity']} "
+            f"(burn {burns})"
+        )
+    alert_recs = [
+        r for r in slo_flight.snapshot("slo") if r.get("kind") == "slo_alert"
+    ]
+    print(
+        f"[15] flood under an SLO: {slo_arm['shed']} shed -> "
+        f"{slo['alerts_opened']} page opened at shed onset, "
+        f"{slo['alerts_closed']} closed after recovery, "
+        f"{len(alert_recs)} flight record(s) reconcile the trail "
+        f"({slo['timeline_samples']} timeline samples)"
+    )
     return 0
 
 

@@ -1400,3 +1400,96 @@ def test_convergence_module_is_currently_clean():
     src = Path("distilp_tpu/obs/convergence.py").read_text()
     for code in ("DLP013", "DLP017", "DLP019"):
         assert findings_for(code, "distilp_tpu/obs/convergence.py", src) == []
+
+
+# --------------------------------------------------------------------------
+# obs/timeline.py + obs/slo.py (the SLO engine) join the obs-layer
+# contracts: lazy-jax (DLP013), accounted excepts (DLP017), registered
+# metric names (DLP019) — fixture-pinned per module, like convergence.py,
+# so the prefix coverage cannot silently regress out from under them.
+
+
+def test_timeline_module_joins_lazy_jax_contract():
+    out = findings_for("DLP013", "distilp_tpu/obs/timeline.py", """\
+        import jax
+
+        def sample(snapshot):
+            return jax.numpy.asarray(snapshot)
+        """)
+    assert len(out) == 1 and "lazy" in out[0].message
+
+
+def test_timeline_module_joins_silent_except_contract():
+    # The exact failure mode the sampler must never have: a swallowed
+    # sample error is an invisible observability outage.
+    out = findings_for("DLP017", "distilp_tpu/obs/timeline.py", """\
+        def sample_once(self):
+            try:
+                self.timeline.record_many(0.0, self._sample_fn())
+            except Exception:
+                return False
+        """)
+    assert len(out) == 1 and "metrics sink" in out[0].message
+
+
+def test_timeline_module_joins_metric_registry_contract():
+    out = findings_for("DLP019", "distilp_tpu/obs/timeline.py", """\
+        def sample_once(self):
+            self.metrics.inc("timeline_totally_unregistered")
+        """)
+    assert len(out) == 1 and "METRIC_REGISTRY" in out[0].message
+    # The real counters ARE registered: the same fixture with the real
+    # names passes.
+    ok = findings_for("DLP019", "distilp_tpu/obs/timeline.py", """\
+        def sample_once(self, ok):
+            self.metrics.inc(
+                "timeline_samples" if ok else "timeline_sample_error"
+            )
+        """)
+    assert ok == []
+
+
+def test_slo_module_joins_lazy_jax_contract():
+    out = findings_for("DLP013", "distilp_tpu/obs/slo.py", """\
+        from distilp_tpu.ops.pdhg import PDHG_AUTO_M
+        """)
+    assert len(out) == 1
+
+
+def test_slo_module_joins_silent_except_contract():
+    out = findings_for("DLP017", "distilp_tpu/obs/slo.py", """\
+        def evaluate(self, now):
+            try:
+                return self._burns(now)
+            except Exception:
+                return []
+        """)
+    assert len(out) == 1
+
+
+def test_slo_module_joins_metric_registry_contract():
+    out = findings_for("DLP019", "distilp_tpu/obs/slo.py", """\
+        def _transition(self, kind):
+            self.metrics.inc("slo_alert_flapped")
+        """)
+    assert len(out) == 1 and "METRIC_REGISTRY" in out[0].message
+    # Both branches of the real IfExp site resolve through the registry.
+    ok = findings_for("DLP019", "distilp_tpu/obs/slo.py", """\
+        def _transition(self, kind):
+            self.metrics.inc(
+                "slo_alert_opened" if kind == "open" else "slo_alert_closed"
+            )
+        """)
+    assert ok == []
+
+
+def test_slo_and_timeline_modules_are_currently_clean():
+    """The REAL obs/slo.py + obs/timeline.py pass their layer's
+    contracts (no jax import, no silent excepts, no unregistered
+    literal counters)."""
+    from pathlib import Path
+
+    for mod in ("distilp_tpu/obs/slo.py", "distilp_tpu/obs/timeline.py"):
+        src = Path(mod).read_text()
+        for code in ("DLP013", "DLP017", "DLP019"):
+            assert findings_for(code, mod, src) == [], (mod, code)

@@ -779,6 +779,105 @@ def test_gateway_http_prom_flight_and_tracing(model, tmp_path):
         assert ingest["parent_id"] == roots[0]["span_id"]
 
 
+def test_worker_gauge_exposition_roundtrip():
+    """Gauge round-trip pin (PR 12 added worker_gauges to the renderer
+    but only counters/summaries had round-trip coverage): labeled per
+    worker, multiple workers, zero-valued samples all survive the
+    render -> parse trip with TYPE gauge and registry-backed HELP."""
+    text = render_prometheus(
+        [],
+        gateway_counters={"gateway_events": 3},
+        worker_gauges={
+            "worker_queue_depth": {"0": 7, "1": 0, "2": 3.5},
+        },
+    )
+    parsed = parse_prometheus_text(text)
+    assert parsed["type"]["distilp_worker_queue_depth"] == "gauge"
+    # HELP comes from the registry, never the "(unregistered)" fallback.
+    assert "unregistered" not in parsed["help"]["distilp_worker_queue_depth"]
+    assert _registered("distilp_worker_queue_depth")
+    depths = {
+        labels["worker"]: value
+        for name, labels, value in parsed["samples"]
+        if name == "distilp_worker_queue_depth"
+    }
+    # All three workers, the zero-valued one included (an idle worker
+    # DISAPPEARING from the exposition would read as a dead scrape).
+    assert depths == {"0": 7.0, "1": 0.0, "2": 3.5}
+    # Multiple gauge names render independently.
+    two = parse_prometheus_text(
+        render_prometheus(
+            [],
+            worker_gauges={
+                "worker_queue_depth": {"0": 0},
+                "worker_events": {"0": 2},
+            },
+        )
+    )
+    assert two["type"]["distilp_worker_queue_depth"] == "gauge"
+    assert ("distilp_worker_queue_depth", {"worker": "0"}, 0.0) in two[
+        "samples"
+    ]
+
+
+def test_spans_stats_aggregation_and_cli(tmp_path):
+    """`solver spans --stats`: per-span-name table (count, p50/p99, top
+    slowest with trace ids) — the CI-log view of a span dir."""
+    from distilp_tpu.cli.solver_cli import main as cli_main
+    from distilp_tpu.obs import span_stats
+
+    spans = _synthetic_trace_spans()
+    rows = span_stats(spans, top=2)
+    by_name = {r["name"]: r for r in rows}
+    assert set(by_name) == {
+        "gateway.ingest", "gateway.route", "gateway.queue_wait", "sched.tick",
+    }
+    tick = by_name["sched.tick"]
+    assert tick["count"] == 1 and tick["p50_ms"] == tick["max_ms"]
+    # Rows sort by total duration, descending: where the wall clock went.
+    totals = [r["total_ms"] for r in rows]
+    assert totals == sorted(totals, reverse=True)
+    # Slowest instances carry their trace ids (the grep handle).
+    assert all(s["trace_id"] for r in rows for s in r["slowest"])
+    assert all(len(r["slowest"]) <= 2 for r in rows)
+    path = tmp_path / "spans.jsonl"
+    with open(path, "w") as fh:
+        for s in spans:
+            fh.write(json.dumps(s) + "\n")
+    rc = cli_main(["spans", str(tmp_path), "--stats"])
+    assert rc == 0
+    # --stats alone converts nothing; with --out it still writes Chrome.
+    assert not (tmp_path / "spans.chrome.json").exists()
+    out = tmp_path / "c.json"
+    assert cli_main(["spans", str(path), "--stats", "--out", str(out)]) == 0
+    assert json.loads(out.read_text())["traceEvents"]
+
+
+def test_scheduler_timeline_sample_real(fleet, model):
+    """Scheduler.timeline_sample on a live scheduler: counters, latency
+    quantiles, the serve clock and the health rank all present under
+    the documented series names (the single-scheduler SLO input)."""
+    sched = make_scheduler(fleet, model)
+    try:
+        for ev in generate_trace("drift", 2, seed=3, base_fleet=sched.fleet.device_list()):
+            sched.handle(ev)
+        sample = sched.timeline_sample()
+        assert sample["c.events_total"] == 2.0
+        assert sample["c.tick_cold"] + sample.get("c.tick_warm", 0) >= 1.0
+        assert sample["last_serve_ms"] > 0.0
+        assert sample["health"] == 0.0
+        assert sample["lat.event_to_placement.count"] == 2.0
+        assert sample["lat.event_to_placement.p99_ms"] > 0.0
+        # No SLO knob engaged: sampling is pull-only, so the scheduler's
+        # own counters contain no timeline/slo entries.
+        counters = sched.metrics_snapshot()["counters"]
+        assert not any(
+            k.startswith(("timeline_", "slo_")) for k in counters
+        )
+    finally:
+        sched.close()
+
+
 # -- Prometheus parser edge cases (round-trip against the renderer) ---------
 
 

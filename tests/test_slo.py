@@ -1,0 +1,692 @@
+"""SLO engine: metrics timelines, burn-rate alerting, signals, history.
+
+Everything here is backend-free on purpose: the timeline/SLO layer is
+pure plumbing (stdlib + pydantic), and the gateway integration tests run
+against fake shard schedulers injected through ``scheduler_factory`` —
+so the whole file executes in milliseconds and the alerting semantics
+are pinned deterministically, not statistically. The one real-scheduler
+sample test lives in tests/test_obs.py next to the other solver-backed
+obs integration tests (shared jit programs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from distilp_tpu.gateway import Gateway, GatewayHTTPServer
+from distilp_tpu.obs import (
+    AlertRule,
+    BurnWindow,
+    FlightRecorder,
+    SignalsPayload,
+    SLOConfig,
+    SLOEngine,
+    SLOSpec,
+    Timeline,
+    TimelineSampler,
+    Tracer,
+    build_signals,
+    synthesize_overload_timeline,
+)
+from distilp_tpu.sched.metrics import METRIC_REGISTRY, SchedulerMetrics
+
+TRACES = "tests/traces"
+
+
+# -- timeline semantics ------------------------------------------------------
+
+
+def _ramp(tl: Timeline, name: str, pts):
+    for t, v in pts:
+        tl.record(name, t, v)
+
+
+def test_timeline_record_window_bounds_capacity():
+    tl = Timeline(capacity=4)
+    _ramp(tl, "c.x", [(0, 0), (1, 1), (2, 2), (3, 3), (4, 4)])
+    # Bounded ring: oldest fell off.
+    assert tl.series("c.x") == [(1, 1), (2, 2), (3, 3), (4, 4)]
+    assert tl.latest("c.x") == (4, 4)
+    assert tl.bounds() == (1, 4)
+    assert tl.window("c.x", 2.0, now=4) == [(2, 2), (3, 3), (4, 4)]
+    assert tl.names() == ["c.x"]
+    with pytest.raises(ValueError):
+        Timeline(capacity=1)
+
+
+def test_delta_uses_at_or_before_baseline():
+    """Prometheus increase() semantics: a counter jump landing between a
+    stale pre-window sample and the first in-window one is attributed to
+    the window — a sampler delayed by the very overload it measures must
+    not blind the alert to the burst it missed the edge of."""
+    tl = Timeline()
+    # Sample at t=0 (value 0), then a 6 s gap (sampler blocked), then the
+    # post-jump plateau.
+    _ramp(tl, "c.shed", [(0.0, 0.0), (6.0, 173.0), (6.1, 173.0), (6.2, 173.0)])
+    # All in-window samples are post-jump; the baseline makes the delta.
+    assert tl.delta("c.shed", 2.0, now=6.2) == 173.0
+    # The rate spreads the jump over the MEASURED gap, never inflates.
+    assert tl.rate("c.shed", 2.0, now=6.2) == pytest.approx(173.0 / 6.2)
+    # No baseline and a single in-window point = insufficient data.
+    tl2 = Timeline()
+    tl2.record("c.y", 5.0, 10.0)
+    assert tl2.delta("c.y", 2.0, now=5.0) is None
+    assert tl2.rate("c.y", 2.0, now=5.0) is None
+    # Two in-window points with no prior baseline: plain first-to-last.
+    tl2.record("c.y", 6.0, 14.0)
+    assert tl2.delta("c.y", 2.0, now=6.0) == 4.0
+
+
+def test_ratio_idle_and_full_shed_semantics():
+    tl = Timeline()
+    _ramp(tl, "c.bad", [(0, 0), (1, 8), (2, 8), (3, 8)])
+    _ramp(tl, "c.total", [(0, 0), (1, 10), (2, 10), (3, 10)])
+    # Burst window: 8 bad of 10 offered.
+    assert tl.ratio("c.bad", "c.total", 1.5, now=1.0) == pytest.approx(0.8)
+    # Idle window (deltas both zero): request-weighted budget burns 0 —
+    # this is what lets a flood's alert clear once the burst slides out.
+    assert tl.ratio("c.bad", "c.total", 1.5, now=3.0) == 0.0
+    # Degenerate: bad moved, total did not -> clamp to 1, not div-zero.
+    tl2 = Timeline()
+    _ramp(tl2, "c.bad", [(0, 0), (1, 5)])
+    _ramp(tl2, "c.total", [(0, 0), (1, 0)])
+    assert tl2.ratio("c.bad", "c.total", 2.0, now=1.0) == 1.0
+    # Unknown series: insufficient data, never zero.
+    assert tl2.ratio("c.bad", "c.nope", 2.0, now=1.0) is None
+
+
+def test_frac_above_and_trend():
+    tl = Timeline()
+    _ramp(tl, "g.p99", [(0, 100), (1, 600), (2, 700), (3, 100)])
+    assert tl.frac_above("g.p99", 500.0, 4.0, now=3.0) == pytest.approx(0.5)
+    assert tl.frac_above("g.p99", 500.0, 0.5, now=3.0) == 0.0
+    assert tl.frac_above("g.none", 500.0, 4.0, now=3.0) is None
+    _ramp(tl, "g.depth", [(0, 0), (1, 2), (2, 4), (3, 6)])
+    assert tl.trend_per_s("g.depth", 4.0, now=3.0) == pytest.approx(2.0)
+    assert tl.trend_per_s("g.depth", 0.1, now=3.0) is None
+
+
+def test_dump_load_byte_and_replay_identical(tmp_path):
+    tl = synthesize_overload_timeline(duration_s=10.0, period_s=0.5)
+    path = tl.dump(tmp_path / "t.jsonl")
+    tl2 = Timeline.load(path)
+    # Byte-stable re-dump AND identical evaluation (full float precision
+    # survives the JSON round trip, so window membership cannot shift).
+    assert tl2.to_jsonl() == tl.to_jsonl()
+    cfg = SLOConfig.from_json(f"{TRACES}/slo_overload_spec.json")
+    assert SLOEngine(cfg, tl2).replay(0.5) == SLOEngine(cfg, tl).replay(0.5)
+    with pytest.raises(ValueError):
+        Timeline.from_jsonl("")
+    with pytest.raises(ValueError):
+        Timeline.from_jsonl('{"not": "a header"}\n')
+
+
+def test_committed_fixture_regenerates_byte_exact():
+    """The committed synthetic overload timeline is a pure function of
+    its recipe (no clocks, no RNG) — regeneration must be byte-exact,
+    same contract as the committed traffic captures."""
+    committed = open(f"{TRACES}/slo_timeline_overload.jsonl").read()
+    assert synthesize_overload_timeline().to_jsonl() == committed
+
+
+def test_committed_expected_alert_sequence_matches_replay():
+    """The smoke-slo offline pin, asserted in-process: replaying the
+    committed timeline against the committed spec reproduces the
+    committed expected sequence exactly (tier, state, firing bucket)."""
+    tl = Timeline.load(f"{TRACES}/slo_timeline_overload.jsonl")
+    cfg = SLOConfig.from_json(f"{TRACES}/slo_overload_spec.json")
+    events = SLOEngine(cfg, tl).replay(step_s=0.1)
+    expect = json.loads(open(f"{TRACES}/slo_expected_alerts.json").read())
+    t0 = tl.bounds()[0]
+    got = [
+        {
+            "slo": e["slo"], "severity": e["severity"], "state": e["state"],
+            "bucket": int((e["t"] - t0) / expect["bucket_s"]),
+        }
+        for e in events
+    ]
+    assert got == expect["events"]
+    # The sequence is the full incident story: every open has its close.
+    opens = [(e["slo"], e["severity"]) for e in events if e["state"] == "open"]
+    closes = [
+        (e["slo"], e["severity"]) for e in events if e["state"] == "close"
+    ]
+    assert sorted(opens) == sorted(closes)
+    # And a second replay is identical (pure function).
+    assert SLOEngine(cfg, tl).replay(step_s=0.1) == events
+
+
+# -- spec validation ---------------------------------------------------------
+
+
+def test_spec_kind_field_validation():
+    with pytest.raises(ValueError):
+        SLOSpec(name="x", kind="ratio", objective=0.99)  # missing series
+    with pytest.raises(ValueError):
+        SLOSpec(name="x", kind="threshold", objective=0.99, series="s")
+    with pytest.raises(ValueError):
+        SLOSpec(
+            name="x", kind="ratio", objective=1.5,
+            bad_series="b", total_series="t",
+        )
+    spec = SLOSpec(
+        name="x", kind="ratio", objective=0.999,
+        bad_series="b", total_series="t",
+    )
+    assert spec.budget == pytest.approx(0.001)
+    # Default ladder is the SRE recipe: page 14.4x (1h AND 5m), warn 6x.
+    sev = {r.severity: r for r in spec.alerts}
+    assert {w.window_s for w in sev["page"].windows} == {3600, 300}
+    assert all(w.burn_rate == 14.4 for w in sev["page"].windows)
+    assert all(w.burn_rate == 6.0 for w in sev["warn"].windows)
+
+
+# -- the alert state machine -------------------------------------------------
+
+
+def _one_slo(windows, clear_factor=0.9, clear_hold_s=1.0, objective=0.99):
+    return SLOConfig(
+        slos=[
+            SLOSpec(
+                name="avail", kind="ratio", objective=objective,
+                bad_series="c.bad", total_series="c.total",
+                alerts=[
+                    AlertRule(
+                        severity="page",
+                        windows=[
+                            BurnWindow(window_s=w, burn_rate=b)
+                            for w, b in windows
+                        ],
+                        clear_factor=clear_factor,
+                        clear_hold_s=clear_hold_s,
+                    )
+                ],
+            )
+        ]
+    )
+
+
+def test_multi_window_and_gate():
+    """A short spike trips the short window but not the long one: the
+    rule must NOT fire until both burn at once (the reason multi-window
+    alerting exists — a long-resolved blip cannot page)."""
+    tl = Timeline()
+    # 10/s offered throughout; bad only in [5.0, 5.4) — a 0.4 s blip.
+    for i in range(101):
+        t = i * 0.1
+        bad = 4.0 if t >= 5.4 else (max(0.0, (t - 5.0)) * 10 if t >= 5.0 else 0.0)
+        tl.record_many(t, {"c.total": 10.0 * t, "c.bad": bad})
+    cfg = _one_slo([(8.0, 30.0), (0.5, 30.0)])
+    engine = SLOEngine(cfg, tl)
+    events = engine.replay(step_s=0.1)
+    # Short window burns during the blip (ratio ~0.4 -> burn ~40 >= 30).
+    assert tl.ratio("c.bad", "c.total", 0.5, now=5.3) > 0.3
+    # Long window never gets past 30x0.01: 4 bad / 80 offered = 0.05 -> 5.
+    assert events == []
+
+
+def test_hysteresis_no_flapping():
+    """Burn oscillating just under/over the threshold flaps the raw
+    signal every step; the alert must open once and close once."""
+    tl = Timeline()
+    # Error ratio alternates 0.2 / 0.12 per step between t=10 and t=20,
+    # zero outside: burn (budget 0.01, threshold 15) flaps 20 <-> 12 —
+    # above, then BELOW threshold but above clear_factor*threshold=13.5?
+    # 12 < 13.5, so each dip starts the clear hold; the 2 s hold outlasts
+    # every dip (0.5 s), so the alert stays open until the burst truly
+    # ends.
+    total = bad = 0.0
+    for i in range(301):
+        t = i * 0.1
+        total += 1.0
+        if 10.0 <= t < 20.0:
+            step = int(t * 2) % 2  # flips every 0.5 s
+            bad += 0.2 if step == 0 else 0.12
+        tl.record_many(t, {"c.total": total, "c.bad": bad})
+    cfg = _one_slo([(2.0, 15.0), (0.5, 15.0)], clear_hold_s=2.0)
+    events = SLOEngine(cfg, tl).replay(step_s=0.1)
+    kinds = [e["state"] for e in events]
+    assert kinds == ["open", "close"], events
+    assert 10.0 <= events[0]["t"] <= 13.0  # opens early in the burst
+    assert events[1]["t"] >= 20.0  # held open across every dip
+
+
+def test_insufficient_data_holds_state():
+    """A sampler gap (no samples at all) must neither fire nor clear a
+    burning alert: None is 'unknown', not 'zero'."""
+    tl = Timeline()
+    total = bad = 0.0
+    for i in range(51):  # burn hard for 5 s
+        t = i * 0.1
+        total += 1.0
+        bad += 0.5
+        tl.record_many(t, {"c.total": total, "c.bad": bad})
+    cfg = _one_slo([(2.0, 10.0), (0.5, 10.0)], clear_hold_s=0.0)
+    engine = SLOEngine(cfg, tl)
+    assert [e["state"] for e in engine.evaluate(now=5.0)] == ["open"]
+    # Evaluate far past the data: every window is empty -> ratio None ->
+    # the alert HOLDS (a dead sampler cannot silently close an incident).
+    assert engine.evaluate(now=100.0) == []
+    assert engine.firing()
+
+
+def test_transitions_hit_counters_flight_and_spans():
+    tl = synthesize_overload_timeline(duration_s=40.0, period_s=0.2)
+    cfg = SLOConfig.from_json(f"{TRACES}/slo_live_spec.json")
+    metrics = SchedulerMetrics()
+    flight = FlightRecorder(capacity=64)
+    tracer = Tracer(capacity=256)
+    engine = SLOEngine(
+        cfg, tl, metrics=metrics, tracer=tracer, flight=flight
+    )
+    events = engine.replay(step_s=0.2)
+    opened = sum(1 for e in events if e["state"] == "open")
+    closed = sum(1 for e in events if e["state"] == "close")
+    assert opened >= 1 and closed >= 1
+    counters = metrics.snapshot()["counters"]
+    assert counters["slo_alert_opened"] == opened
+    assert counters["slo_alert_closed"] == closed
+    # First-class flight records on the slo ring, one per transition.
+    recs = [r for r in flight.snapshot("slo") if r.get("kind") == "slo_alert"]
+    assert len(recs) == len(events)
+    assert recs[0]["state"] == "open" and recs[0]["slo"] == "availability"
+    # sched.alert span events, zero-duration, attrs carry the identity.
+    alert_spans = [s for s in tracer.spans() if s["name"] == "sched.alert"]
+    assert len(alert_spans) == len(events)
+    assert alert_spans[0]["attrs"]["severity"] == "page"
+    assert alert_spans[0]["dur_ms"] == 0.0
+    # Registry coverage for the two counters (DLP019's other half).
+    assert "slo_alert_opened" in METRIC_REGISTRY
+    assert "slo_alert_closed" in METRIC_REGISTRY
+
+
+# -- signals -----------------------------------------------------------------
+
+
+def test_build_signals_schema_trend_and_headroom():
+    tl = Timeline()
+    for i in range(61):
+        t = i * 1.0
+        tl.record_many(
+            t,
+            {
+                "queue_depth.w0": 0.1 * i,  # rising: trend > 0
+                "queue_depth.w1": 0.0,
+                "c.gateway_events": 10.0 * i,
+                "c.events_shed": 0.0,
+            },
+        )
+    cfg = _one_slo([(10.0, 10.0)])
+    engine = SLOEngine(cfg, tl)
+    sig = build_signals(tl, engine=engine, capacity_eps=25.0, now=60.0)
+    # Round-trips through its own schema (the federation contract).
+    assert SignalsPayload.model_validate(sig.model_dump()).version == 1
+    assert [w.worker for w in sig.workers] == [0, 1]
+    assert sig.workers[0].queue_depth_trend_per_s == pytest.approx(0.1)
+    assert sig.workers[1].queue_depth_trend_per_s == pytest.approx(0.0)
+    assert sig.queue_depth_total == pytest.approx(6.0)
+    assert sig.recent_eps == pytest.approx(10.0)
+    assert sig.headroom_eps == pytest.approx(15.0)
+    assert sig.slos[0].slo == "avail" and sig.slos[0].firing == []
+    # Burn keys exist per configured window.
+    assert set(sig.slos[0].burn) == {"10s"}
+
+
+# -- the sampler -------------------------------------------------------------
+
+
+def test_sampler_counts_samples_and_errors_and_survives_failures():
+    tl = Timeline()
+    metrics = SchedulerMetrics()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("probe hit a stopping worker")
+        return {"c.x": float(calls["n"])}
+
+    s = TimelineSampler(tl, flaky, period_s=0.001, metrics=metrics)
+    assert s.sample_once(now=1.0) is True
+    assert s.sample_once(now=2.0) is False  # counted, not fatal
+    assert s.sample_once(now=3.0) is True
+    counters = metrics.snapshot()["counters"]
+    assert counters["timeline_samples"] == 2
+    assert counters["timeline_sample_error"] == 1
+    assert [v for _, v in tl.series("c.x")] == [1.0, 3.0]
+    # on_sample failures are counted too (the engine must not kill the
+    # sampler thread).
+    s2 = TimelineSampler(
+        tl, lambda: {"c.y": 1.0}, period_s=0.001, metrics=metrics,
+        on_sample=lambda _tl, _now: (_ for _ in ()).throw(ValueError("x")),
+    )
+    assert s2.sample_once(now=1.0) is False
+    assert metrics.snapshot()["counters"]["timeline_sample_error"] == 2
+
+
+def test_sampler_thread_start_stop_idempotent():
+    tl = Timeline()
+    s = TimelineSampler(tl, lambda: {"c.x": 1.0}, period_s=0.005)
+    s.start()
+    s.start()  # second start is a no-op
+    deadline = time.monotonic() + 2.0
+    while s.samples < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert s.samples >= 3
+    s.stop()
+    assert not s.running
+    n = s.samples
+    s.stop()  # idempotent
+    time.sleep(0.05)
+    assert s.samples == n  # truly stopped
+
+
+# -- gateway integration (fake shard schedulers: no solver, no jax) ----------
+
+
+class _FakeSched:
+    """The minimal Scheduler face the gateway needs (tests inject it
+    through scheduler_factory, like test_gateway's failing schedulers)."""
+
+    def __init__(self):
+        self.metrics = SchedulerMetrics()
+        self.health = "healthy"
+
+    def handle(self, event):
+        self.metrics.inc("events_total")
+        return None
+
+    def latest(self):
+        return None
+
+    def health_snapshot(self):
+        return {"state": self.health}
+
+    def metrics_snapshot(self):
+        return self.metrics.snapshot()
+
+    def close(self):
+        pass
+
+
+def _fake_gateway(n_workers=2, **kw):
+    return Gateway(
+        n_workers=n_workers,
+        scheduler_factory=lambda devices, model: _FakeSched(),
+        **kw,
+    )
+
+
+def test_gateway_timeline_sample_series_conventions():
+    gw = _fake_gateway()
+    try:
+        gw.register_fleet("f0", [], None)
+        gw.handle_event("f0", object())
+        sample = gw.timeline_sample()
+        # Counters, shard totals, queue depths, and the derived offered
+        # series all follow the documented naming.
+        assert sample["c.gateway_events"] == 1.0
+        assert sample["c.events_shed"] == 0.0  # zero-valued, ALWAYS present
+        assert sample["c.events_offered"] == 1.0
+        assert sample["shards.events_total"] == 1.0
+        assert sample["queue_depth.w0"] == 0.0
+        assert sample["queue_depth.w1"] == 0.0
+        assert sample["queue_depth.max"] == 0.0
+        assert "lat.gateway_event_to_placement.p99_ms" in sample
+    finally:
+        gw.close()
+
+
+def test_gateway_close_stops_attached_samplers_idempotently():
+    gw = _fake_gateway()
+    tl = Timeline()
+    sampler = gw.attach_sampler(
+        TimelineSampler(
+            tl, gw.timeline_sample, period_s=0.005, metrics=gw.metrics
+        )
+    )
+    sampler.start()
+    deadline = time.monotonic() + 2.0
+    while sampler.samples < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sampler.samples >= 2
+    gw.close()
+    assert not sampler.running
+    counters = gw.metrics.snapshot()["counters"]
+    # Every tick before the stop landed cleanly; none raced the teardown.
+    assert counters.get("timeline_sample_error", 0) == 0
+    gw.close()  # idempotent, samplers already stopped
+
+
+def test_gateway_close_during_prom_scrape_counts_no_errors():
+    """The PR 8 bench gotcha, pinned at the source: a prom-scrape thread
+    attached to the gateway is stopped by close() BEFORE the workers, so
+    a clean shutdown can never count prom_scrape_error."""
+    from distilp_tpu.gateway.loadgen import PromScraper
+
+    for _ in range(3):  # a few rounds to give the race a chance
+        gw = _fake_gateway()
+        gw.register_fleet("f0", [], None)
+        scraper = PromScraper(gw, period_s=0.001).start()
+        deadline = time.monotonic() + 2.0
+        while scraper.scrapes < 3 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert scraper.scrapes >= 3  # it really was scraping
+        gw.close()  # no explicit scraper.stop(): close owns the ordering
+        counters = gw.metrics.snapshot()["counters"]
+        assert counters.get("prom_scrape_error", 0) == 0
+        scraper.stop()  # harness double-stop stays safe
+
+
+def test_no_slo_knobs_means_no_slo_counters():
+    """Byte-identical pin: serving without any timeline/SLO knob mints
+    ZERO slo/timeline counters and no sampler exists — the untouched
+    path is the pre-SLO path (same contract as the spec-off pin)."""
+    gw = _fake_gateway()
+    try:
+        gw.register_fleet("f0", [], None)
+        for _ in range(5):
+            gw.handle_event("f0", object())
+        counters = gw.metrics.snapshot()["counters"]
+        assert not any(
+            k.startswith(("timeline_", "slo_")) for k in counters
+        ), counters
+        assert gw.timeline is None and gw.slo_engine is None
+        assert gw._samplers == []
+    finally:
+        gw.close()
+
+
+def test_http_slo_and_signals_routes():
+    gw = _fake_gateway()
+    tl = Timeline()
+    cfg = _one_slo([(10.0, 10.0)])
+    engine = SLOEngine(cfg, tl, metrics=gw.metrics)
+    sampler = gw.attach_sampler(
+        TimelineSampler(
+            tl, gw.timeline_sample, period_s=0.01, metrics=gw.metrics,
+            on_sample=lambda _tl, now: engine.evaluate(now),
+        )
+    )
+    gw.attach_slo(engine, tl, capacity_eps=100.0)
+    sampler.start()
+
+    import urllib.error
+    import urllib.request
+
+    def get(port, path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30
+            ) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        gw.register_fleet("f0", [], None)
+        deadline = time.monotonic() + 2.0
+        while sampler.samples < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+        async def main():
+            srv = GatewayHTTPServer(gw)
+            await srv.start()
+            loop = asyncio.get_running_loop()
+            st, slo = await loop.run_in_executor(
+                None, get, srv.port, "/slo"
+            )
+            assert st == 200
+            assert slo["slos"][0]["name"] == "avail"
+            assert slo["alerts_open"] == 0
+            st, sig = await loop.run_in_executor(
+                None, get, srv.port, "/signals"
+            )
+            assert st == 200
+            payload = SignalsPayload.model_validate(sig)
+            assert payload.max_sustainable_eps == 100.0
+            assert [w.worker for w in payload.workers] == [0, 1]
+            await srv.close()
+
+        asyncio.run(main())
+    finally:
+        gw.close()
+
+
+def test_http_slo_404_when_not_enabled():
+    gw = _fake_gateway()
+
+    import urllib.error
+    import urllib.request
+
+    def get(port, path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30
+            ) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    try:
+        async def main():
+            srv = GatewayHTTPServer(gw)
+            await srv.start()
+            loop = asyncio.get_running_loop()
+            assert await loop.run_in_executor(
+                None, get, srv.port, "/slo"
+            ) == 404
+            assert await loop.run_in_executor(
+                None, get, srv.port, "/signals"
+            ) == 404
+            await srv.close()
+
+        asyncio.run(main())
+    finally:
+        gw.close()
+
+
+# -- solver slo CLI ----------------------------------------------------------
+
+
+def test_slo_cli_offline_check_ok_and_expect_mismatch(tmp_path):
+    from distilp_tpu.cli.solver_cli import main as cli_main
+
+    ok = cli_main(
+        [
+            "slo",
+            "--spec", f"{TRACES}/slo_overload_spec.json",
+            "--timeline", f"{TRACES}/slo_timeline_overload.jsonl",
+            "--step-s", "0.1",
+            "--expect", f"{TRACES}/slo_expected_alerts.json",
+            "--check", "--quiet",
+        ]
+    )
+    assert ok == 0
+    # Tamper with the expectation: exact-sequence mismatch must fail.
+    expect = json.loads(open(f"{TRACES}/slo_expected_alerts.json").read())
+    expect["events"][0]["bucket"] += 1
+    tampered = tmp_path / "expect.json"
+    tampered.write_text(json.dumps(expect))
+    rc = cli_main(
+        [
+            "slo",
+            "--spec", f"{TRACES}/slo_overload_spec.json",
+            "--timeline", f"{TRACES}/slo_timeline_overload.jsonl",
+            "--step-s", "0.1",
+            "--expect", str(tampered),
+            "--check", "--quiet",
+        ]
+    )
+    assert rc == 1
+    # Nothing to evaluate / missing spec are usage errors.
+    assert cli_main(["slo", "--check"]) == 2
+    assert cli_main(["slo", "--timeline", "x.jsonl"]) == 2
+
+
+def test_slo_cli_history_trend_check(tmp_path):
+    from distilp_tpu.cli.solver_cli import main as cli_main
+
+    hist = tmp_path / "BENCH_HISTORY.jsonl"
+    rows = [
+        {"round": 1, "value": 30.0, "warm_tick_ms": 16.0, "spec_hit_rate": 0.93},
+        {"round": 2, "value": 31.0, "warm_tick_ms": 16.5, "spec_hit_rate": 0.92},
+        {"round": 3, "value": 30.5, "warm_tick_ms": 16.2, "spec_hit_rate": 0.94},
+    ]
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert cli_main(
+        ["slo", "--history", str(hist), "--check", "--quiet"]
+    ) == 0
+    # Regress the newest round's warm tick 2x: the trend rule fires.
+    rows.append({"round": 4, "value": 30.2, "warm_tick_ms": 40.0,
+                 "spec_hit_rate": 0.93})
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert cli_main(
+        ["slo", "--history", str(hist), "--check", "--quiet"]
+    ) == 1
+
+
+def test_evaluate_history_table_and_tolerances():
+    from distilp_tpu.obs.slo import evaluate_history
+
+    rows = [
+        {"value": 30.0, "spec_hit_rate": 0.9},
+        {"value": 32.0, "spec_hit_rate": 0.9},
+        {"value": 31.0, "spec_hit_rate": 0.5},  # hit rate collapsed
+    ]
+    table, violations = evaluate_history(rows)
+    assert any(v.startswith("spec_hit_rate") for v in violations)
+    assert not any(v.startswith("value") for v in violations)
+    by_key = {r["key"]: r for r in table}
+    assert by_key["value"]["latest"] == 31.0
+    # One known-key row exists even with zero data.
+    assert by_key["warm_tick_ms"]["median"] is None
+
+
+def test_bench_history_append_load_roundtrip(tmp_path):
+    from tools.bench_history import (
+        HISTORY_KEYS,
+        append_history,
+        load_history,
+    )
+
+    payload = {
+        "value": 26.8, "warm_tick_ms": 16.0, "platform": "cpu",
+        "spec_hit_rate": 0.93, "breakdown": {"ignored": 1},
+        "slo_overhead_pct": 1.2,
+    }
+    path = tmp_path / "h.jsonl"
+    rec = append_history(payload, path, round_no=13)
+    rec2 = append_history(payload, path)
+    rows = load_history(path)
+    assert len(rows) == 2
+    assert rows[0]["round"] == 13 and rows[0]["value"] == 26.8
+    assert "breakdown" not in rows[0]  # only HISTORY_KEYS ride along
+    assert rows[0]["slo_overhead_pct"] == 1.2
+    assert "round" not in rows[1]
+    assert set(rec) - {"round", "captured_at"} <= set(HISTORY_KEYS)
+    assert rec2["captured_at"]

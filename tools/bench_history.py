@@ -1,13 +1,24 @@
 #!/usr/bin/env python3
-"""Tabulate the committed BENCH_r*.json driver artifacts across rounds.
+"""The machine-readable bench trajectory: BENCH_HISTORY.jsonl + tables.
 
-The headline numbers ride a tunneled TPU whose per-operation wire cost
-swings run to run, so raw wall-clocks across rounds are not comparable.
-This prints them side by side with the wire-condition diagnostic
-(``tiny_put_ms``, recorded since round 4) so a regression in the ENGINE is
-distinguishable from a slow tunnel day.
+Two jobs:
 
-    python tools/bench_history.py [repo_root]
+1. **History file** (``BENCH_HISTORY.jsonl``): one JSON line per bench
+   run with the headline keys (``HISTORY_KEYS``) — ``make bench`` appends
+   via ``python bench.py --history BENCH_HISTORY.jsonl``, and
+   ``solver slo --history`` evaluates trend rules against it
+   (``obs.slo.evaluate_history``), so the bench trajectory is a dataset
+   instead of N loose BENCH_r*.json artifacts. ``--rebuild`` seeds (or
+   re-derives) the file from the committed BENCH_r*.json captures.
+
+2. **Table** (default): the committed rounds side by side with the
+   wire-condition diagnostic (``tiny_put_ms``, recorded since round 4) —
+   the headline numbers ride a tunneled TPU whose per-operation wire cost
+   swings run to run, so a regression in the ENGINE must stay
+   distinguishable from a slow tunnel day.
+
+    python tools/bench_history.py [repo_root]            # table
+    python tools/bench_history.py --rebuild [repo_root]  # reseed the JSONL
 """
 
 from __future__ import annotations
@@ -15,7 +26,97 @@ from __future__ import annotations
 import json
 import re
 import sys
+import time
 from pathlib import Path
+
+# The committed-format history line: one value per key per bench run
+# (missing keys simply absent). Trend rules over these live in
+# obs.slo.HISTORY_TREND_RULES; adding a key here is additive and never
+# breaks old lines.
+HISTORY_KEYS = (
+    "platform",
+    "value",
+    "warm_tick_ms",
+    "moe_warm_tick_ms",
+    "vs_baseline",
+    "placements_per_sec",
+    "pipelined_placements_per_sec",
+    "scenario_batch_placements_per_sec",
+    "tiny_put_ms",
+    "scheduler_events_per_sec",
+    "scheduler_p99_ms",
+    "gateway_events_per_sec_100f_4w",
+    "gateway_scaling_100f_4w",
+    "overload_max_sustainable_eps",
+    "overload_plateau_ratio",
+    "spec_hit_rate",
+    "spec_p99_on_ms",
+    "obs_overhead_pct",
+    "conv_ipm_iters_to_certify",
+    "conv_pdhg_iters_to_certify",
+    "slo_overhead_pct",
+    "slo_alerts_fired",
+    "cold_process_ms",
+    "cold_process_cached_ms",
+    "fleet_scale_certified_m_max",
+)
+
+
+def history_record(payload: dict, round_no=None, captured_at=None) -> dict:
+    """One committed-format history line from a bench payload."""
+    rec: dict = {}
+    if round_no is not None:
+        rec["round"] = round_no
+    rec["captured_at"] = (
+        captured_at
+        if captured_at is not None
+        else time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    )
+    for key in HISTORY_KEYS:
+        v = payload.get(key)
+        if isinstance(v, (int, float, str, bool)) and v is not None:
+            rec[key] = v
+    return rec
+
+
+def append_history(payload: dict, path, round_no=None) -> dict:
+    """Append one history line for this run (``bench.py --history``)."""
+    rec = history_record(payload, round_no=round_no)
+    path = Path(path)
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(rec, sort_keys=True) + "\n")
+    return rec
+
+
+def load_history(path) -> list:
+    """History rows, oldest first (the order lines were appended)."""
+    rows = []
+    for ln in Path(path).read_text(encoding="utf-8").splitlines():
+        ln = ln.strip()
+        if ln:
+            rows.append(json.loads(ln))
+    return rows
+
+
+def rebuild_history(root: Path, out_path) -> int:
+    """Re-derive BENCH_HISTORY.jsonl from the committed BENCH_r*.json
+    artifacts (deterministic: captured_at comes from the artifact when
+    present, else is omitted — a rebuild never invents timestamps)."""
+    rows = []
+    for r, payload in load_rounds(root):
+        if "error" in payload and "metric" not in payload:
+            continue
+        rec = history_record(
+            payload, round_no=r, captured_at=payload.get("captured_at", "")
+        )
+        if not rec.get("captured_at"):
+            rec.pop("captured_at", None)
+        rows.append(rec)
+    Path(out_path).write_text(
+        "".join(json.dumps(r, sort_keys=True) + "\n" for r in rows),
+        encoding="utf-8",
+    )
+    return len(rows)
 
 
 def load_rounds(root: Path):
@@ -56,6 +157,15 @@ def fmt(v, suffix=""):
 
 
 def main() -> int:
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--rebuild":
+        root = (
+            Path(argv[1]) if len(argv) > 1
+            else Path(__file__).resolve().parents[1]
+        )
+        n = rebuild_history(root, root / "BENCH_HISTORY.jsonl")
+        print(f"rebuilt {root / 'BENCH_HISTORY.jsonl'}: {n} round(s)")
+        return 0
     root = Path(sys.argv[1]) if len(sys.argv) > 1 else Path(__file__).resolve().parents[1]
     rounds = load_rounds(root)
     if not rounds:
