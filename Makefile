@@ -18,7 +18,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile
 	python -m pytest tests/ -q
 
 # `make bench` also appends the run's headline keys as one line of
@@ -263,6 +263,43 @@ smoke-slo: lint-strict
 		--max-queue-depth 2 --check --expect-sheds \
 		--slo tests/traces/slo_live_spec.json --settle-s 3 \
 		--expect-alert page --quiet
+
+# Compile-ledger smoke: the bundled 10-fleet gateway trace replayed with
+# the XLA compile ledger on (serve --compile-ledger-out). The contract:
+# (1) cold compiles happened and EVERY one is attributed to a registered
+# entry point (no "(unregistered)" executables — the surface DLP020
+# guards statically, checked dynamically here); (2) after every fleet's
+# 2-event warmup, the steady-state warm serving phase recorded ZERO
+# compile events (the zero-recompile invariant the bench gates as
+# compile_warm_phase_count == 0); (3) no exact-signature recompile ever
+# (each distinct static+shape signature compiles at most once); (4) the
+# dumped ledger JSONL round-trips byte-stably and `solver compiles`
+# renders byte-identical reports on repeated replays of the same dump.
+.PHONY: smoke-compile
+smoke-compile: lint-strict
+	@D=$$(mktemp -d) && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+		--trace tests/traces/gateway_smoke_10f.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 2 --k-candidates 8,10 --quiet \
+		--compile-ledger-out $$D/ledger.jsonl --metrics-out $$D/m.json \
+		> /dev/null && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli compiles \
+		--load $$D/ledger.jsonl --check > $$D/report1.txt && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli compiles \
+		--load $$D/ledger.jsonl --check > $$D/report2.txt && \
+	cmp -s $$D/report1.txt $$D/report2.txt && \
+	JAX_PLATFORMS=cpu python -c "import json; \
+		m = json.load(open('$$D/m.json')); c = m['compile']; \
+		assert c['warm_boundary_marked'], 'warm boundary never marked'; \
+		assert c['warm_phase_compiles'] == 0, ('warm phase recompiled', c['warm_phase_compiles']); \
+		assert c['counters']['compiles'] > 0, 'no cold compiles recorded'; \
+		assert c['unregistered_compiles'] == 0, 'unregistered compile event'; \
+		compiled = [n for n, e in c['entries'].items() if e['compiles']]; \
+		assert set(compiled) <= set(c['registered']), compiled; \
+		print('smoke-compile OK: %d cold compile(s) across %s; warm phase 0; ledger byte-stable' \
+			% (c['counters']['compiles'], ', '.join(compiled)))"; \
+	rc=$$?; rm -rf $$D; exit $$rc
 
 .PHONY: smoke-sched
 smoke-sched: lint-strict

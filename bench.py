@@ -69,10 +69,46 @@ MOE_DEVICES = 32
 # or after it) is ignored by scanning for the sentinel rather than trusting
 # line position.
 _PROBE_SENTINEL = "DPERF_PROBE"
+# Phase markers the probe child prints as it advances (flushed, so a
+# killed-at-timeout child leaves a partial trail in its temp-file stdout):
+# the LAST marker seen tells a wedged probe's post-mortem WHERE init died
+# — importing jax, initializing the backend (the axon-tunnel wedge class),
+# or the first compile+dispatch. The first_dispatch marker carries the
+# compile ledger's counters, so "backend up but nothing ever compiled"
+# and "wedged mid-first-compile" are distinguishable states.
+_PHASE_SENTINEL = "DPERF_PHASE"
 _PROBE_SRC = (
-    "import jax; d = jax.devices(); "
+    "import json; "
+    f"print('{_PHASE_SENTINEL} interp', flush=True); "
+    "import jax; "
+    f"print('{_PHASE_SENTINEL} jax_import', flush=True); "
+    "from distilp_tpu.obs import compile_ledger as _cl; _led = _cl.enable(); "
+    "d = jax.devices(); "
+    f"print('{_PHASE_SENTINEL} backend_init', flush=True); "
+    "import jax.numpy as jnp; jnp.add(1, 1).block_until_ready(); "
+    f"print('{_PHASE_SENTINEL} first_dispatch ' "
+    "+ json.dumps(_led.counters(), sort_keys=True), flush=True); "
     f"print('{_PROBE_SENTINEL}', d[0].platform, len(d))"
 )
+
+
+def parse_probe_phases(stdout: str) -> list[dict]:
+    """The probe child's phase trail: ``[{"phase": name, ...}]`` in print
+    order (the last entry is how far init got before success/wedge); the
+    first_dispatch entry carries the child's compile-ledger counters."""
+    out: list[dict] = []
+    for ln in stdout.splitlines():
+        if not ln.startswith(_PHASE_SENTINEL + " "):
+            continue
+        parts = ln[len(_PHASE_SENTINEL) + 1:].split(None, 1)
+        rec: dict = {"phase": parts[0]}
+        if len(parts) > 1:
+            try:
+                rec["ledger"] = json.loads(parts[1])
+            except json.JSONDecodeError:
+                rec["detail"] = parts[1]
+        out.append(rec)
+    return out
 _PROBE_BACKOFF_S = (15.0, 45.0)  # sleep between attempts
 
 
@@ -126,8 +162,13 @@ def run_contained(
 
 
 def _run_probe_once(timeout_s: float) -> tuple[int | None, str, str]:
-    """One backend-liveness probe attempt (see :func:`run_contained`)."""
-    return run_contained([sys.executable, "-c", _PROBE_SRC], timeout_s)
+    """One backend-liveness probe attempt (see :func:`run_contained`).
+    Pinned to the repo root: the probe child imports distilp_tpu (the
+    compile-ledger phase trail), which must resolve regardless of the
+    caller's cwd."""
+    return run_contained(
+        [sys.executable, "-c", _PROBE_SRC], timeout_s, cwd=str(REPO)
+    )
 
 
 def parse_probe_output(rc: int | None, stdout: str) -> str | None:
@@ -193,12 +234,25 @@ def _probe_backend() -> tuple[str | None, dict]:
             "backoff_s": backoff,
             "elapsed_s": round(elapsed, 2),
         }
+        phases = parse_probe_phases(stdout)
+        if phases:
+            rec["phases"] = [p["phase"] for p in phases]
+            ledger = next(
+                (p["ledger"] for p in phases if "ledger" in p), None
+            )
+            if ledger is not None:
+                rec["ledger"] = ledger
         if rc is None:
             detail = (
                 f"probe timed out after {timeout_s}s (backend init wedged; "
                 f"timeout from {timeout_src})"
             )
             rec["outcome"] = "timeout"
+            # The phase trail is the wedge post-mortem: the last marker a
+            # killed child flushed says exactly where init died.
+            rec["wedged_after"] = (
+                phases[-1]["phase"] if phases else "spawn"
+            )
             attempts.append(rec)
             continue
         platform = parse_probe_output(rc, stdout)
@@ -264,6 +318,7 @@ _COMPARE_LOWER_BETTER = (
     "conv_ipm_iters_to_certify", "conv_pdhg_iters_to_certify",
     "conv_pdhg_restarts", "conv_overhead_pct",
     "slo_overhead_pct",
+    "compile_overhead_pct", "compile_warm_phase_count",
 )
 # Instrumentation cost ceiling: tracing + Prometheus exposition may never
 # cost more than this fraction of the loadgen arm's events/sec. Checked
@@ -278,6 +333,9 @@ _CONV_OVERHEAD_MAX_PCT = 5.0
 # round trip per worker per tick) may cost at most this much of the
 # loadgen arm's events/sec — absolute, like the other obs ceilings.
 _SLO_OVERHEAD_MAX_PCT = 5.0
+# And for the compile ledger: dispatch counting + signature hashing on
+# every instrumented entry-point call — same absolute ceiling.
+_COMPILE_OVERHEAD_MAX_PCT = 5.0
 _COMPARE_HIGHER_BETTER = (
     "vs_baseline", "placements_per_sec", "pipelined_placements_per_sec",
     "scenario_batch_placements_per_sec", "scheduler_events_per_sec",
@@ -286,6 +344,7 @@ _COMPARE_HIGHER_BETTER = (
     "gateway_events_per_sec_100f_4w", "gateway_scaling_100f_4w",
     "spec_hit_rate",
     "overload_max_sustainable_eps", "overload_plateau_ratio",
+    "compile_cache_hit_rate",
 )
 # Graceful-saturation floor, checked ABSOLUTE on the new capture (like
 # the obs ceiling): at 10x sustainable load, goodput must stay within
@@ -381,6 +440,27 @@ def _compare_against(payload: dict, against: str) -> int:
         failures.append(
             f"slo_overhead_pct {slo_pct:.1f} > {_SLO_OVERHEAD_MAX_PCT:g} "
             "(timeline-sampler cost ceiling on the sampled arm)"
+        )
+    cmp_pct = payload.get("compile_overhead_pct")
+    if (
+        isinstance(cmp_pct, (int, float))
+        and cmp_pct > _COMPILE_OVERHEAD_MAX_PCT
+    ):
+        failures.append(
+            f"compile_overhead_pct {cmp_pct:.1f} > "
+            f"{_COMPILE_OVERHEAD_MAX_PCT:g} "
+            "(compile-ledger attribution cost ceiling on the ledgered arm)"
+        )
+    # The zero-recompile warm-serving gate, checked ABSOLUTE on the new
+    # capture: a single compile event during the steady-state warm/spec
+    # phase is a silent-recompile regression regardless of the reference
+    # (today's invariant is zero; this is what keeps it an invariant).
+    warm_compiles = payload.get("compile_warm_phase_count")
+    if isinstance(warm_compiles, (int, float)) and warm_compiles != 0:
+        failures.append(
+            f"compile_warm_phase_count {warm_compiles:g} != 0 (the warm "
+            "serving phase paid an XLA compile — see the compile "
+            "section's warm_phase_entries for the offending entry points)"
         )
     # SLO absolute contracts (checked on the new capture, never relative):
     # the committed overload capture must fire AND clear the expected
@@ -778,6 +858,19 @@ def main(against: str | None = None, history: str | None = None) -> int:
         payload.update(_convergence_bench(model, devs))
     except Exception as e:  # pragma: no cover - defensive bench path
         payload["convergence_error"] = f"{type(e).__name__}: {e}"
+
+    # Compile ledger (distilp_tpu.obs.compile_ledger): XLA compile
+    # visibility on the serving path. The loadgen arm re-runs with the
+    # ledger ON (interleaved with OFF for the <= 5% overhead ceiling);
+    # its headline is the zero-recompile gate — NO compile event during
+    # the steady-state warm serving phase (compile_warm_phase_count == 0,
+    # absolute in --against). Cold-process children report the
+    # persistent-cache hit rate as the ledger classifies it (miss-populate
+    # then hit-serve). A failure costs only these keys.
+    try:
+        payload.update(_compile_bench(model))
+    except Exception as e:  # pragma: no cover - defensive bench path
+        payload["compile_error"] = f"{type(e).__name__}: {e}"
 
     # Restart cost (VERDICT r5 item 3): fresh-process first-solve wall
     # clock, uncached vs against the env-gated persistent compilation
@@ -1543,6 +1636,142 @@ def _convergence_bench(model, base_devs) -> dict:
     worst = max(overheads) if overheads else 0.0
     out["conv_overhead_pct"] = round(max(0.0, worst), 2)
     out["conv_overhead_pct_raw"] = round(worst, 2)
+    return out
+
+
+_COMPILE_COLD_SRC = r"""
+import json
+from distilp_tpu.obs import compile_ledger as cl
+led = cl.enable()
+from distilp_tpu.common import load_model_profile
+from distilp_tpu.solver import halda_solve
+from distilp_tpu.utils import make_synthetic_fleet
+
+model = load_model_profile("tests/profiles/llama_3_70b/online/model_profile.json")
+devs = make_synthetic_fleet(4, seed=11)
+res = halda_solve(devs, model, k_candidates=[8, 10], mip_gap=1e-3,
+                  kv_bits="4bit", backend="jax")
+c = led.counters()
+print("DPERF_COMPILE", json.dumps({
+    "certified": bool(res.certified),
+    "compiles": c["compiles"],
+    "cache_hits": c["compile_cache_hits"],
+    "cache_misses": c["compile_cache_misses"],
+    "hit_rate": led.cache_hit_rate(),
+    "unattributed": c["unattributed_compiles"],
+}))
+"""
+
+
+def _compile_bench(model) -> dict:
+    """compile section: ledger overhead, the zero-recompile warm gate,
+    and the persistent-cache hit rate in cold processes.
+
+    (1) The 10-fleet loadgen arm re-runs ledger-ON vs ledger-OFF,
+    interleaved (ON FIRST so the process's true cold compiles land in a
+    ledgered arm): ``compile_overhead_pct`` is the events/sec cost of
+    full compile attribution, gated <= 5% absolute like the other obs
+    ceilings. (2) The headline gate: across every ON arm's TIMED phase
+    (post-warmup steady-state warm/spec serving) the ledger must record
+    ZERO compile events — ``compile_warm_phase_count == 0`` in
+    ``--against``; a warm tick that silently recompiles is exactly the
+    tail-latency bug this section exists to catch. (3) Cold-process
+    children (wedge-contained) share one throwaway persistent-cache dir:
+    the first populates it (ledger classifies misses), the second is
+    served from it — ``compile_cache_hit_rate`` is the second child's
+    ledger-classified hit rate.
+    """
+    from distilp_tpu.gateway.loadgen import run_loadgen
+
+    n_fleets = int(_env_num("DPERF_COMPILE_FLEETS", 10))
+    n_workers = int(_env_num("DPERF_COMPILE_WORKERS", 2))
+    events = int(_env_num("DPERF_COMPILE_EVENTS", 40))
+    repeats = max(1, int(_env_num("DPERF_COMPILE_REPEATS", 2)))
+
+    def arm(led_on: bool) -> dict:
+        return run_loadgen(
+            model,
+            n_fleets=n_fleets,
+            n_workers=n_workers,
+            events_per_fleet=events,
+            fleet_size=int(_env_num("DPERF_GATEWAY_M", 3)),
+            seed=0,
+            k_candidates=[8, 10],
+            mip_gap=MIP_GAP,
+            compile_ledger=led_on,
+        )
+
+    runs = {"off": [], "on": []}
+    for _ in range(repeats):
+        # ON first: the first arm of the whole section pays the process's
+        # cold compiles, and they must land in a LEDGERED arm's warmup so
+        # cold_compiles is the real count, not zero-by-jit-cache.
+        runs["on"].append(arm(True))
+        runs["off"].append(arm(False))
+    med_off = statistics.median(r["events_per_sec"] for r in runs["off"])
+    med_on = statistics.median(r["events_per_sec"] for r in runs["on"])
+    overhead = (med_off - med_on) / med_off * 100.0 if med_off > 0 else 0.0
+    warm_total = sum(
+        r["compile"]["warm_phase_compiles"] for r in runs["on"]
+    )
+    unregistered = sorted(
+        {e for r in runs["on"] for e in r["compile"]["unregistered"]}
+    )
+    out: dict = {
+        "compile": {
+            "fleets": n_fleets,
+            "workers": n_workers,
+            "events_per_fleet": events,
+            "repeats": repeats,
+            "events_per_sec_off": [r["events_per_sec"] for r in runs["off"]],
+            "events_per_sec_on": [r["events_per_sec"] for r in runs["on"]],
+            "cold_compiles_first_arm": runs["on"][0]["compile"][
+                "cold_compiles"
+            ],
+            "warm_phase_compiles_per_arm": [
+                r["compile"]["warm_phase_compiles"] for r in runs["on"]
+            ],
+            "warm_phase_entries": sorted(
+                {e for r in runs["on"] for e in r["compile"]["warm_entries"]}
+            ),
+            "unregistered_entries": unregistered,
+        },
+        "compile_cold_count": runs["on"][0]["compile"]["cold_compiles"],
+        # THE gate: steady-state warm/spec serving never compiles.
+        "compile_warm_phase_count": warm_total,
+        "compile_overhead_pct": round(max(0.0, overhead), 2),
+        "compile_overhead_pct_raw": round(overhead, 2),
+    }
+
+    # -- persistent-cache hit rate, fresh processes ------------------------
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="distilp-ledger-") as cache_dir:
+        env = dict(os.environ)
+        env["DISTILP_COMPILE_CACHE"] = cache_dir
+        cold_children = {}
+        for key in ("populate", "cached"):
+            rc, stdout, stderr = run_contained(
+                [sys.executable, "-c", _COMPILE_COLD_SRC],
+                timeout_s=max(120.0, _env_num("DPERF_COLD_TIMEOUT", 300)),
+                env=env,
+                cwd=str(REPO),
+            )
+            line = next(
+                (
+                    ln for ln in stdout.splitlines()
+                    if ln.startswith("DPERF_COMPILE ")
+                ),
+                None,
+            )
+            if rc != 0 or line is None:
+                out["compile"]["cold_process_error"] = (
+                    f"{key} child rc={rc}: {stderr.strip()[-300:]}"
+                )
+                return out
+            cold_children[key] = json.loads(line[len("DPERF_COMPILE "):])
+        out["compile"]["cold_process"] = cold_children
+        out["compile_cache_hit_rate"] = cold_children["cached"]["hit_rate"]
     return out
 
 

@@ -466,6 +466,39 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "overload` / bench overload section): the /signals payload "
         "reports autoscaling headroom against it",
     )
+    # Compile ledger (obs.compile_ledger; README "Compilation
+    # observability"). Default off — serving without it is byte-identical
+    # to the unledgered daemon (the instrumented entry points are
+    # passthroughs while no ledger is enabled).
+    p.add_argument(
+        "--compile-ledger",
+        action="store_true",
+        help="enable the process-wide XLA compile ledger: every compile "
+        "event is attributed to its registered jit entry point and "
+        "classified (cold / cache-hit / static-arg-flip / "
+        "shape-bucket-change / recompile), ticks that paid a compile say "
+        "so on their span + flight record, and the summary grows a "
+        "'compile' block (render it with `solver compiles`)",
+    )
+    p.add_argument(
+        "--compile-ledger-out",
+        default=None,
+        metavar="FILE",
+        help="dump the compile ledger as JSONL at exit (implies "
+        "--compile-ledger); reload with `solver compiles --load`",
+    )
+    p.add_argument(
+        "--compile-warm-events",
+        type=int,
+        default=2,
+        metavar="N",
+        help="handled events per fleet after which the ledger's "
+        "WARM-phase boundary is marked: the summary's "
+        "compile.warm_phase_compiles counts compile events past it — the "
+        "zero-recompile warm-serving invariant `make smoke-compile` "
+        "gates on (default 2: the cold solve and the first warm tick "
+        "each compile their own layout)",
+    )
     return p
 
 
@@ -702,6 +735,64 @@ def _obs_summary(writer, flight) -> dict:
     return out
 
 
+def _build_compile_ledger(args):
+    """(ledger, owned) from the serve flags; (None, False) on the
+    byte-identical default path (instrumented entry points stay
+    passthroughs). ``owned`` means THIS run enabled the process ledger
+    and must disable it on exit — a leaked global ledger would mint
+    compile counters into every scheduler an in-process caller builds
+    afterwards (the exact leak the test suite's byte-identical pins
+    would trip over)."""
+    if not (args.compile_ledger or args.compile_ledger_out):
+        return None, False
+    from ..obs import compile_ledger
+
+    existing = compile_ledger.current()
+    if existing is not None:
+        return existing, False
+    return compile_ledger.enable(), True
+
+
+def _release_compile_ledger(owned: bool) -> None:
+    if owned:
+        from ..obs import compile_ledger
+
+        compile_ledger.disable()
+
+
+def _compile_summary(args, led, warm_token) -> dict:
+    """The serve summary's "compile" block (+ the JSONL dump side effect).
+
+    ``warm_token`` is the ledger seq at the warm-phase boundary (every
+    fleet past ``--compile-warm-events`` handled events) — compile events
+    after it are warm-phase compiles, the count the zero-recompile gate
+    reads; None when the replay ended before the boundary was reached.
+    """
+    from ..obs import compile_ledger
+
+    warm = (
+        len(led.events_since(warm_token)) if warm_token is not None else None
+    )
+    summary = led.summary()
+    out = {
+        "counters": summary["counters"],
+        "cache_hit_rate": summary["cache_hit_rate"],
+        "causes": summary["causes"],
+        "entries": summary["entries"],
+        "registered": compile_ledger.registered_entry_points(),
+        "unregistered_compiles": summary["counters"][
+            "unattributed_compiles"
+        ],
+        "warm_boundary_marked": warm_token is not None,
+        "warm_phase_compiles": warm,
+        "fallback": summary["fallback"],
+    }
+    if args.compile_ledger_out:
+        led.dump_jsonl(args.compile_ledger_out)
+        out["ledger_path"] = str(args.compile_ledger_out)
+    return out
+
+
 def _build_slo(args, metrics, sample_fn, tracer, flight):
     """(timeline, engine, sampler) from the serve SLO flags, all None
     when neither --slo nor --timeline-dir is set (the byte-identical
@@ -899,15 +990,27 @@ def serve_main(argv=None) -> int:
     timeline, slo_engine, sampler = _build_slo(
         args, sched.metrics, sched.timeline_sample, tracer, flight
     )
+    led, led_owned = _build_compile_ledger(args)
+    compile_state = {"handled": 0, "warm_token": None}
+
+    def on_event(ev, view, ms):
+        log_event(ev, view, ms)
+        if led is not None and compile_state["warm_token"] is None:
+            compile_state["handled"] += 1
+            if compile_state["handled"] >= args.compile_warm_events:
+                # Warm boundary: everything this single fleet compiles,
+                # it compiles in its first --compile-warm-events ticks.
+                compile_state["warm_token"] = led.seq()
+
     chaos = None
     try:
         if plan is not None:
             from ..sched import chaos_replay
 
-            chaos = chaos_replay(sched, events, plan, on_event=log_event)
+            chaos = chaos_replay(sched, events, plan, on_event=on_event)
             report = _chaos_to_replay_report(chaos, sched)
         else:
-            report = replay(sched, events, on_event=log_event)
+            report = replay(sched, events, on_event=on_event)
     except (RuntimeError, ValueError) as e:
         print(f"error: replay failed: {e}", file=sys.stderr)
         return 1
@@ -917,6 +1020,7 @@ def serve_main(argv=None) -> int:
         sched.close()  # release the deadline worker (no-op when unused)
         if tracer is not None:
             tracer.close()  # flush the span JSONL
+        _release_compile_ledger(led_owned)
 
     summary = {
         "replay": report.summary(),
@@ -934,6 +1038,10 @@ def serve_main(argv=None) -> int:
                 sched.metrics.inc("flight_dumps")
     if args.speculate:
         summary["speculation"] = sched.speculation_snapshot()
+    if led is not None:
+        summary["compile"] = _compile_summary(
+            args, led, compile_state["warm_token"]
+        )
     if sampler is not None:
         summary["slo"] = _slo_summary(args, timeline, slo_engine, sampler)
     if writer is not None or flight is not None:
@@ -1117,6 +1225,27 @@ def _serve_gateway(args) -> int:
         # and --listen keeps it (and /slo, /signals) live until ^C.
         gw.attach_sampler(sampler)
         gw.attach_slo(slo_engine, timeline, capacity_eps=args.capacity_eps)
+    led, led_owned = _build_compile_ledger(args)
+    # Warm boundary for the ledger: marked once EVERY fleet actually
+    # REPLAYED this run has handled --compile-warm-events events
+    # (ordering-independent — the smoke trace interleaves fleets
+    # round-robin, but nothing guarantees that). Targets are filled in
+    # from run_items below, AFTER the resume cursor is applied: a fleet
+    # fully covered by a snapshot (or one with fewer events than the
+    # knob) must not hold the boundary open forever, so each fleet's
+    # target is min(knob, its replayed-event count). Compile events past
+    # the mark are warm-phase compiles: the zero-recompile invariant.
+    compile_state = {"counts": {}, "targets": {}, "warm_token": None}
+
+    def _note_handled_for_ledger(fleet_id: str) -> None:
+        targets = compile_state["targets"]
+        if led is None or compile_state["warm_token"] is not None or not targets:
+            return
+        counts = compile_state["counts"]
+        counts[fleet_id] = counts.get(fleet_id, 0) + 1
+        if all(counts.get(f, 0) >= n for f, n in targets.items()):
+            compile_state["warm_token"] = led.seq()
+
     try:
         if args.resume:
             try:
@@ -1151,6 +1280,14 @@ def _serve_gateway(args) -> int:
         # covers (Gateway.uncovered owns the contract — quarantined
         # events advanced the cursor too and must not replay).
         run_items = gw.uncovered(items)
+        if led is not None:
+            totals: dict = {}
+            for f, _ev in run_items:
+                totals[f] = totals.get(f, 0) + 1
+            compile_state["targets"] = {
+                f: min(args.compile_warm_events, n)
+                for f, n in totals.items()
+            }
 
         def log_event(fleet_id, ev, view, ms):
             if args.quiet:
@@ -1172,11 +1309,16 @@ def _serve_gateway(args) -> int:
             from ..sched import chaos_replay
 
             facade = ShardFacade(gw, "default")
+
+            def _chaos_on_event(ev, view, ms):
+                log_event("default", ev, view, ms)
+                _note_handled_for_ledger("default")
+
             chaos = chaos_replay(
                 facade,
                 [ev for _, ev in run_items],
                 plan,
-                on_event=lambda ev, view, ms: log_event("default", ev, view, ms),
+                on_event=_chaos_on_event,
             )
             report = _chaos_to_replay_report(chaos, facade)
             if chaos.views:
@@ -1189,6 +1331,7 @@ def _serve_gateway(args) -> int:
                 ms = (_time.perf_counter() - t0) * 1e3
                 lat.append(ms)
                 final_views[fleet_id] = view
+                _note_handled_for_ledger(fleet_id)
                 if (
                     ev.kind in STRUCTURAL_KINDS
                     and view.events_behind == 0
@@ -1284,6 +1427,10 @@ def _serve_gateway(args) -> int:
                 "stale": totals.get("spec_stale", 0),
                 "hit_rate": round(s_hits / s_probes, 4) if s_probes else 0.0,
             }
+        if led is not None:
+            summary["compile"] = _compile_summary(
+                args, led, compile_state["warm_token"]
+            )
         if chaos is not None:
             summary["chaos"] = chaos.summary()
             if flight is not None and chaos.violations(
@@ -1338,6 +1485,7 @@ def _serve_gateway(args) -> int:
         gw.close()
         if tracer is not None:
             tracer.close()  # flush the span JSONL
+        _release_compile_ledger(led_owned)
 
 
 def _listen_forever(gw, listen: str, quiet: bool = False) -> int:
@@ -2188,9 +2336,172 @@ def diagnose_main(argv=None) -> int:
     return 0
 
 
+def build_compiles_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solver compiles",
+        description="render the XLA compile ledger (obs.compile_ledger): "
+        "per-entry-point compile/dispatch table, cause histogram (cold / "
+        "cache-hit / static-arg-flip / shape-bucket-change / recompile), "
+        "persistent-cache hit rate and the top recompile offenders — from "
+        "a live run (--trace, replayed through `solver serve` with the "
+        "ledger on) or a dumped JSONL (--load). Rendering a dump is a "
+        "pure function: the same dump produces byte-identical reports on "
+        "every replay",
+    )
+    p.add_argument(
+        "--load", default=None, metavar="FILE",
+        help="render a ledger JSONL previously dumped by "
+        "`serve --compile-ledger-out` (or --out below); no backend needed",
+    )
+    p.add_argument(
+        "--trace", default=None,
+        help="live mode: replay this churn trace (single- or multi-fleet) "
+        "with the ledger enabled and render the resulting ledger",
+    )
+    p.add_argument(
+        "--profile", "-p", default=None,
+        help="profile folder (required with --trace)",
+    )
+    p.add_argument("--synthetic-fleet", type=int, default=0, metavar="M")
+    p.add_argument("--fleet-seed", type=int, default=0)
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--mip-gap", type=float, default=1e-3)
+    p.add_argument("--k-candidates", default=None)
+    p.add_argument(
+        "--lp-backend", choices=["ipm", "pdhg", "auto"], default="auto",
+        help="LP engine pin for the live replay — flip it between two "
+        "runs and the ledger attributes the recompile to the static-arg "
+        "flip (walkthrough step 16)",
+    )
+    p.add_argument(
+        "--compile-warm-events", type=int, default=2, metavar="N",
+        help="warm-boundary events per fleet for the live replay (see "
+        "`solver serve --compile-warm-events`)",
+    )
+    p.add_argument(
+        "--out", default=None, metavar="FILE",
+        help="also save the live run's ledger JSONL here",
+    )
+    p.add_argument("--top", type=int, default=5, help="top-N offenders/storms")
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the ledger summary as one JSON object instead of text",
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="exit 1 unless the ledger is clean: every compile attributed "
+        "to a REGISTERED entry point, no exact-signature recompiles, and "
+        "the JSONL round-trips byte-stably (the smoke-compile contract)",
+    )
+    return p
+
+
+def compiles_main(argv=None) -> int:
+    """``solver compiles``: render/check the XLA compile ledger."""
+    args = build_compiles_parser().parse_args(argv)
+
+    from ..obs.compile_ledger import (
+        ledger_from_jsonl,
+        ledger_to_jsonl,
+        render_report,
+    )
+
+    if bool(args.load) == bool(args.trace):
+        print(
+            "error: exactly one of --load or --trace is required",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.load:
+        try:
+            text = Path(args.load).read_text(encoding="utf-8")
+            dump = ledger_from_jsonl(text)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load {args.load}: {e}", file=sys.stderr)
+            return 2
+    else:
+        if not args.profile:
+            print(
+                "error: --trace needs --profile", file=sys.stderr
+            )
+            return 2
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            out_path = Path(args.out) if args.out else Path(tmp) / "ledger.jsonl"
+            serve_argv = [
+                "--trace", args.trace,
+                "--profile", args.profile,
+                "--quiet",
+                "--workers", str(args.workers),
+                "--mip-gap", str(args.mip_gap),
+                "--lp-backend", args.lp_backend,
+                "--compile-warm-events", str(args.compile_warm_events),
+                "--compile-ledger-out", str(out_path),
+            ]
+            if args.synthetic_fleet:
+                serve_argv += [
+                    "--synthetic-fleet", str(args.synthetic_fleet),
+                    "--fleet-seed", str(args.fleet_seed),
+                ]
+            if args.k_candidates:
+                serve_argv += ["--k-candidates", args.k_candidates]
+            # The delegated serve run's summary goes to stderr: stdout
+            # must carry exactly the report (or the --json object), so
+            # piping `solver compiles` stays machine-readable.
+            import contextlib
+            import io
+
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = serve_main(serve_argv)
+            if buf.getvalue():
+                print(buf.getvalue(), end="", file=sys.stderr)
+            if rc != 0:
+                return rc
+            text = out_path.read_text(encoding="utf-8")
+            dump = ledger_from_jsonl(text)
+
+    if args.check:
+        failures = []
+        registry = set(dump["header"].get("registry", []))
+        for ev in dump["events"]:
+            if ev["entry"] not in registry:
+                failures.append(
+                    f"compile of unregistered entry {ev['entry']!r} "
+                    f"(seq {ev['seq']}) — an executable DLP020 missed"
+                )
+            if ev["cause"] == "recompile":
+                failures.append(
+                    f"exact-signature recompile of {ev['entry']} "
+                    f"(seq {ev['seq']}, static=[{ev['static']}])"
+                )
+        if ledger_to_jsonl(dump) != text:
+            failures.append("ledger JSONL does not round-trip byte-stably")
+        if failures:
+            for f in failures:
+                print(f"compile-ledger check FAILED: {f}", file=sys.stderr)
+            return 1
+
+    if args.json:
+        print(json.dumps(dump["header"].get("summary", {}), sort_keys=True))
+    else:
+        print(render_report(dump, top=args.top), end="")
+    if args.check:
+        n = len(dump["events"])
+        print(
+            f"compile-ledger check OK: {n} compile event(s), all "
+            "registered, no exact-signature recompiles, dump byte-stable"
+        )
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
+    if argv and argv[0] == "compiles":
+        return compiles_main(argv[1:])
     if argv and argv[0] == "serve":
         # Subcommand dispatch; the bare flag form stays the one-shot solver
         # (reference-CLI compatible), so existing invocations are untouched.

@@ -66,6 +66,11 @@ _AGGREGATED_SHARD_COUNTERS = (
     "spec_near_hit",
     "spec_near_miss",
     "events_coalesced",
+    # Compile-ledger tick attribution (obs.compile_ledger): which shards'
+    # ticks paid XLA compiles, aggregated for the serving-tier dashboard.
+    "compiles",
+    "compile_cache_hits",
+    "recompile_storms",
 )
 
 
@@ -888,6 +893,16 @@ class Gateway:
         for i, d in enumerate(depths):
             out[f"queue_depth.w{i}"] = float(d)
         out["queue_depth.max"] = float(max(depths) if depths else 0)
+        from ..obs import compile_ledger as _cl
+
+        led = _cl.current()
+        if led is not None:
+            # Process-wide compile telemetry (the ledger sees every
+            # worker thread's compiles, attributed or not); the series
+            # set is timeline_series's one definition, shared with
+            # Scheduler.timeline_sample so the two serving shapes'
+            # names cannot drift.
+            out.update(led.timeline_series())
         return out
 
     def slo_status(self) -> dict:

@@ -137,6 +137,7 @@ async def replay_concurrent(
     gateway: Gateway,
     items: Sequence[Tuple[str, object]],
     measure_from: Dict[str, int],
+    on_timed_start=None,
 ) -> dict:
     """Replay items with one sequential task per fleet, all concurrent.
 
@@ -173,6 +174,11 @@ async def replay_concurrent(
             for f, evs in per_fleet.items()
         )
     )
+    if on_timed_start is not None:
+        # The warmup barrier IS the cold/warm boundary: the compile
+        # ledger's bench arm snapshots its event seq here, so compiles
+        # after this callback are warm-phase compiles by construction.
+        on_timed_start()
     t_start = time.perf_counter()
     await asyncio.gather(
         *(
@@ -213,6 +219,7 @@ def run_loadgen(
     tracer=None,
     prom_scrape_s: Optional[float] = None,
     timeline_period_s: Optional[float] = None,
+    compile_ledger: bool = False,
 ) -> dict:
     """One full loadgen arm: build fleets, replay, report, tear down.
 
@@ -260,6 +267,18 @@ def run_loadgen(
                 metrics=gateway.metrics,
             )
         )
+    # Compile-ledger arm (bench `compile` section): reuse the process
+    # ledger if one is already enabled, otherwise enable for this arm and
+    # disable after — the interleaved ledger-OFF arms must run the true
+    # passthrough path or the overhead measurement lies.
+    led = led_owned = None
+    warm_tok: dict = {"seq": None}
+    if compile_ledger:
+        from ..obs import compile_ledger as _cl
+
+        led = _cl.current()
+        if led is None:
+            led = led_owned = _cl.enable()
     try:
         for fleet_id, spec in specs.items():
             gateway.register_fleet(
@@ -269,8 +288,20 @@ def run_loadgen(
             scraper.start()
         if sampler is not None:
             sampler.start()
+        arm_tok = led.seq() if led is not None else 0
         measure_from = {f: warmup_per_fleet for f in specs}
-        report = asyncio.run(replay_concurrent(gateway, items, measure_from))
+        report = asyncio.run(
+            replay_concurrent(
+                gateway,
+                items,
+                measure_from,
+                on_timed_start=(
+                    None
+                    if led is None
+                    else (lambda: warm_tok.__setitem__("seq", led.seq()))
+                ),
+            )
+        )
         snap = gateway.metrics_snapshot()
         report.update(
             {
@@ -296,11 +327,41 @@ def run_loadgen(
             report["timeline_sample_errors"] = snap["counters"].get(
                 "timeline_sample_error", 0
             )
+        if led is not None:
+            # The arm's compile view, split at the warmup barrier: cold
+            # compiles paid during warmup vs compiles during the TIMED
+            # phase — the latter is the bench's zero-recompile headline.
+            arm_events = led.events_since(arm_tok)
+            boundary = warm_tok["seq"]
+            warm_events = [
+                e for e in arm_events
+                if boundary is not None and e["seq"] > boundary
+            ]
+            report["compile"] = {
+                "cold_compiles": len(arm_events) - len(warm_events),
+                "warm_phase_compiles": len(warm_events),
+                "cache_hits": sum(
+                    1 for e in arm_events if e.get("cache") == "hit"
+                ),
+                "entries": sorted({e["entry"] for e in arm_events}),
+                "unregistered": sorted(
+                    {
+                        e["entry"]
+                        for e in arm_events
+                        if e["entry"] == "(unregistered)"
+                    }
+                ),
+                "warm_entries": sorted({e["entry"] for e in warm_events}),
+            }
         return report
     finally:
         # close() stops the attached scraper first, then the workers —
         # the ordering lives in Gateway.close now, not per harness.
         gateway.close()
+        if led_owned is not None:
+            from ..obs import compile_ledger as _cl
+
+            _cl.disable()
 
 
 def main(argv=None) -> int:

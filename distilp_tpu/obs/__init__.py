@@ -1,7 +1,7 @@
 """Observability for the serving stack: tracing, exporters, flight data,
 solver-interior convergence reports, metrics timelines and SLO alerting.
 
-Six pieces, all opt-in and backend-free (the obs layer imports neither
+Seven pieces, all opt-in and backend-free (the obs layer imports neither
 jax nor numpy nor the solver — it is plumbing the serving layers thread
 data through; ``convergence``/``slo`` add pydantic, already a core
 dependency):
@@ -26,6 +26,11 @@ dependency):
   sampler snapshots the serving tier's own sinks into bounded per-series
   rings of (t, value), with rates/ratios/window fractions derived from
   deltas and a flight-recorder-style JSONL dump/load;
+- ``compile_ledger`` — XLA compilation & dispatch telemetry: every
+  registered jit entry point's compiles classified by cause (cold /
+  cache-hit / static-arg-flip / shape-bucket-change / recompile), a
+  recompile-storm alarm, and the ``solver compiles`` report — the layer
+  the zero-recompile warm-serving gate reads;
 - ``slo`` — declarative SLO specs compiled into error budgets with
   multi-window multi-burn-rate alert rules (hysteretic open/close, the
   ``sched.alert`` span + flight trail), the ``GET /slo``/``GET /signals``
@@ -36,6 +41,7 @@ See README "Observability" / "Convergence diagnostics" for the span model,
 the label table, and the trace-buffer semantics.
 """
 
+from . import compile_ledger
 from .convergence import (
     ConvergenceTrace,
     LPChunkSample,
@@ -80,6 +86,7 @@ from .trace import (
 )
 
 __all__ = [
+    "compile_ledger",
     "Tracer",
     "Span",
     "SpanContext",

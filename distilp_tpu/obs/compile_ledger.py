@@ -1,0 +1,746 @@
+"""Compile ledger: XLA compilation & dispatch telemetry for the serving stack.
+
+Every layer above can now see wall time (spans), solver-interior
+convergence (conv traces) and objective health (SLOs) — but the layer
+that actually dominates tail latency on an accelerator stack, XLA
+compilation, was invisible: a cold solve, a persistent-cache hit, or a
+silent recompile minted by a flipped static argument all showed up only
+as an unexplained multi-second span. The ledger makes compiles first-class
+events:
+
+- **Entry-point registry.** Every module-level jitted entry point is
+  wrapped once via :func:`instrument` (``dlint`` DLP020 enforces this for
+  ``sched//gateway//solver//ops//twin/``): the wrapper is a transparent
+  passthrough while no ledger is enabled (one module-global read per
+  dispatch), and with a ledger enabled it counts dispatches and computes
+  the call's STATIC-ARG signature plus a SHAPE-BUCKET signature, pushed
+  onto a thread-local context stack for the duration of the call.
+- **Compile events.** ``jax.monitoring`` duration/event listeners
+  (registered once per process, dormant while no ledger is enabled)
+  attribute every ``backend_compile`` duration — and every persistent
+  compilation-cache hit/miss — to the innermost entry point on the
+  compiling thread's context stack. Where ``jax.monitoring`` is
+  unavailable the wrapper itself falls back to first-seen-signature
+  detection (``ledger.fallback``): a signature never dispatched before
+  records a synthetic compile event whose duration is that call's wall
+  time.
+- **Cause taxonomy.** Each compile event is classified against the
+  entry point's signature history: ``cold`` (first compile ever),
+  ``cache_hit`` (persistent cache served the executable), ``static_arg_flip``
+  (a static argument changed — ``lp_backend``/``trace``/``diag``/``iters``/
+  ``chunk`` each mint a new executable), ``shape_bucket_change`` (same
+  statics, new argument shapes) and ``recompile`` (an exact signature
+  compiled AGAIN — the storm class the ledger exists to catch).
+- **Recompile-storm alarm.** N compiles of the same entry point inside a
+  sliding window mark the event ``storm`` and bump the ledger's storm
+  counter; the scheduler surfaces storms as the ``recompile_storms``
+  metric (flight-recorded per tick, SLO-rule-able via ``c.recompile_storms``).
+- **Cost attribution** (opt-in, ``cost_analysis=True``): the first real
+  compile of an entry point additionally runs
+  ``fn.lower(*args).compile().cost_analysis()`` and records FLOPs /
+  bytes-accessed next to the compile counters (the AOT re-lowering is
+  paid once per entry point, and its own compile events are suppressed).
+
+Like every obs module this one is stdlib-only at import time (jax loads
+lazily inside :func:`enable`) and opt-in: with no ledger enabled the
+instrumented entry points run the exact pre-ledger path.
+
+The JSONL dump follows the flight-recorder convention (header line +
+one event per line) and round-trips byte-stably; :func:`render_report`
+is a pure function of a dump, so ``solver compiles`` renders the same
+bytes on every replay.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "CompileLedger",
+    "InstrumentedJit",
+    "instrument",
+    "registered_entry_points",
+    "enable",
+    "disable",
+    "current",
+    "ledger_to_jsonl",
+    "ledger_from_jsonl",
+    "render_report",
+    "CAUSES",
+]
+
+# The jax.monitoring event names this ledger listens for (jax 0.4.x).
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+CACHE_HIT_RETRIEVAL_EVENT = "/jax/compilation_cache/cache_retrieval_time_sec"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+CACHE_REQUEST_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+
+CAUSE_COLD = "cold"
+CAUSE_CACHE_HIT = "cache_hit"
+CAUSE_STATIC_FLIP = "static_arg_flip"
+CAUSE_SHAPE = "shape_bucket_change"
+CAUSE_RECOMPILE = "recompile"
+CAUSES = (
+    CAUSE_COLD, CAUSE_CACHE_HIT, CAUSE_STATIC_FLIP, CAUSE_SHAPE,
+    CAUSE_RECOMPILE,
+)
+
+# Attribution bucket for compiles that fired with no instrumented entry
+# point on the compiling thread's stack — exactly the executables DLP020
+# hunts (an inline jit, a stray eager compile in a dependency).
+UNREGISTERED = "(unregistered)"
+
+# name -> {"static_argnames": (...,)}: the process-wide entry-point
+# registry. Populated at import time by the instrument() sites, so the
+# expected cold-compile surface is enumerable without enabling anything.
+_REGISTRY: Dict[str, dict] = {}
+
+_tls = threading.local()
+_LEDGER: Optional["CompileLedger"] = None
+_LEDGER_LOCK = threading.Lock()
+# None = not probed yet; True/False = jax.monitoring listeners installed.
+_MONITORING_OK: Optional[bool] = None
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _static_sig(static_argnames: Sequence[str], kwargs: dict) -> str:
+    """Canonical text of the call's static-argument values.
+
+    Statics at this repo's entry points are always passed by keyword
+    (``M=``, ``lp_backend=``, ``trace=`` ...); a static left to its
+    default is recorded as absent — the jit cache treats the explicit
+    default and the omission identically only when the call sites agree,
+    and the ledger's job is to show what the call actually passed.
+    """
+    parts = [
+        f"{k}={kwargs[k]!r}" for k in static_argnames if k in kwargs
+    ]
+    return ",".join(parts)
+
+
+def _shape_leaf(x) -> Optional[str]:
+    shape = getattr(x, "shape", None)
+    if shape is None:
+        return None
+    dtype = getattr(x, "dtype", "")
+    return f"{dtype}{list(shape)}"
+
+
+def _shape_walk(x, out: List[str]) -> None:
+    # Containers (dicts, NamedTuple batch structs, lists) flatten without
+    # jax: tree structure at these entry points is plain python.
+    leaf = _shape_leaf(x)
+    if leaf is not None:
+        out.append(leaf)
+        return
+    if isinstance(x, dict):
+        for k in sorted(x):
+            _shape_walk(x[k], out)
+    elif isinstance(x, (list, tuple)):
+        for v in x:
+            _shape_walk(v, out)
+
+
+def _shape_sig(
+    args: tuple, kwargs: dict, static_argnames: Sequence[str]
+) -> str:
+    """Shape-bucket signature of the call's ARRAY arguments: dtype+shape
+    per leaf, statics excluded. Long signatures (the twin's ~20-array data
+    dict) compress to a count + stable digest so events stay one line."""
+    out: List[str] = []
+    for a in args:
+        _shape_walk(a, out)
+    for k in sorted(kwargs):
+        if k in static_argnames:
+            continue
+        _shape_walk(kwargs[k], out)
+    sig = ";".join(out)
+    if len(sig) > 120:
+        import hashlib
+
+        digest = hashlib.sha1(sig.encode()).hexdigest()[:10]
+        sig = f"{len(out)}leaves:{digest}"
+    return sig
+
+
+class CompileLedger:
+    """Process-wide compile/dispatch ledger (see module docstring).
+
+    All mutation happens under one re-entrant lock: wrappers dispatch from
+    many shard-worker threads while the monitoring listeners attribute
+    compiles and a timeline sampler reads the counters.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        storm_threshold: int = 5,
+        storm_window_s: float = 60.0,
+        cost_analysis: bool = False,
+    ):
+        if capacity < 1:
+            raise ValueError("compile ledger capacity must be >= 1")
+        if storm_threshold < 2:
+            raise ValueError("storm threshold must be >= 2")
+        self.capacity = capacity
+        self.storm_threshold = storm_threshold
+        self.storm_window_s = storm_window_s
+        self.cost_analysis = cost_analysis
+        # True = no jax.monitoring; the wrappers synthesize compile events
+        # from first-seen signatures (set by enable(), or by tests).
+        self.fallback = False
+        self._lock = threading.RLock()
+        self._t0 = time.monotonic()
+        self.events: "deque[dict]" = deque(maxlen=capacity)
+        self._seq = 0  # total compile events ever (ring may have evicted)
+        self.dispatches: Dict[str, int] = {}
+        self.compiles: Dict[str, int] = {}
+        self.compile_ms: Dict[str, float] = {}
+        self.entry_cache_hits: Dict[str, int] = {}
+        self.causes: Dict[str, int] = {}
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_requests = 0
+        self.storms = 0
+        self.unattributed = 0
+        self.costs: Dict[str, dict] = {}
+        self.cost_errors = 0
+        # Classification state: per entry, the (static, shape) signatures
+        # compiled so far and the statics seen — what separates a flip
+        # from a shape-bucket change from an outright recompile.
+        self._sigs: Dict[str, Dict[Tuple[str, str], int]] = {}
+        self._statics: Dict[str, set] = {}
+        # Storm detection: per entry, recent compile timestamps.
+        self._recent: Dict[str, deque] = {}
+        self._storming: Dict[str, bool] = {}
+
+    # -- the write side ----------------------------------------------------
+
+    def seq(self) -> int:
+        """Monotonic compile-event counter — the capture token the
+        scheduler snapshots around a tick (``events_since``)."""
+        with self._lock:
+            return self._seq
+
+    def note_dispatch(self, entry: str) -> None:
+        with self._lock:
+            self.dispatches[entry] = self.dispatches.get(entry, 0) + 1
+
+    def note_compile(
+        self,
+        entry: str,
+        static_sig: str,
+        shape_sig: str,
+        ms: float,
+        cache: Optional[str] = None,
+    ) -> dict:
+        """Record one compile event and classify its cause."""
+        now = time.monotonic()
+        with self._lock:
+            self._seq += 1
+            sigs = self._sigs.setdefault(entry, {})
+            statics = self._statics.setdefault(entry, set())
+            key = (static_sig, shape_sig)
+            if cache == "hit":
+                cause = CAUSE_CACHE_HIT
+            elif not sigs:
+                cause = CAUSE_COLD
+            elif key in sigs:
+                cause = CAUSE_RECOMPILE
+            elif static_sig not in statics:
+                cause = CAUSE_STATIC_FLIP
+            else:
+                cause = CAUSE_SHAPE
+            sigs[key] = sigs.get(key, 0) + 1
+            statics.add(static_sig)
+            # Storm window: compiles of THIS entry in the last window_s.
+            ring = self._recent.setdefault(entry, deque())
+            ring.append(now)
+            while ring and now - ring[0] > self.storm_window_s:
+                ring.popleft()
+            storm = storm_start = False
+            if len(ring) >= self.storm_threshold:
+                storm = True
+                if not self._storming.get(entry):
+                    # ONE transition per episode: `storms` (and every
+                    # consumer of it — the c.recompile_storms series,
+                    # the scheduler's recompile_storms counter) counts
+                    # alarms, while the per-event `storm` flag keeps
+                    # marking every compile the episode contains.
+                    storm_start = True
+                    self._storming[entry] = True
+                    self.storms += 1
+            else:
+                self._storming[entry] = False
+            ev = {
+                "seq": self._seq,
+                "t": round(now - self._t0, 6),
+                "thread": threading.get_ident(),
+                "entry": entry,
+                "cause": cause,
+                "compile_ms": round(ms, 3),
+                "cache": cache,
+                "static": static_sig,
+                "shapes": shape_sig,
+            }
+            if storm:
+                ev["storm"] = True
+            if storm_start:
+                ev["storm_start"] = True
+            self.events.append(ev)
+            self.compiles[entry] = self.compiles.get(entry, 0) + 1
+            self.compile_ms[entry] = (
+                self.compile_ms.get(entry, 0.0) + ms
+            )
+            self.causes[cause] = self.causes.get(cause, 0) + 1
+            if cache == "hit":
+                self.cache_hits += 1
+                self.entry_cache_hits[entry] = (
+                    self.entry_cache_hits.get(entry, 0) + 1
+                )
+            elif cache == "miss":
+                self.cache_misses += 1
+            if entry == UNREGISTERED:
+                self.unattributed += 1
+            return ev
+
+    # -- listener/wrapper plumbing -----------------------------------------
+
+    def _compile_from_listener(self, ms: float, cache: Optional[str]) -> None:
+        if getattr(_tls, "suppress", False):
+            return  # our own cost-analysis re-lowering, not user work
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            entry, static_sig, shape_sig = stack[-1]
+        else:
+            entry, static_sig, shape_sig = UNREGISTERED, "", ""
+        self.note_compile(entry, static_sig, shape_sig, ms, cache=cache)
+
+    def _fallback_note(self, frame: tuple, ms: float) -> None:
+        """Wrap-the-jit fallback: a first-seen signature is the only
+        compile evidence available, and the call's wall time stands in
+        for the compile duration (an over-estimate that includes the
+        execute — honest enough to count and classify by). Membership
+        check and record happen under ONE (re-entrant) lock hold:
+        concurrent same-signature dispatches — the gateway warmup shape,
+        every fleet compiling the same layout at once — must not record
+        twice and mint a spurious 'recompile'."""
+        entry, static_sig, shape_sig = frame
+        with self._lock:
+            if (static_sig, shape_sig) in self._sigs.get(entry, {}):
+                return
+            self.note_compile(entry, static_sig, shape_sig, ms, cache=None)
+
+    def _note_cost(self, entry: str, wrapper, args, kwargs) -> None:
+        """Opt-in FLOPs/bytes attribution via the AOT path, once per
+        entry point; its own lower/compile events are suppressed."""
+        with self._lock:
+            if entry in self.costs:
+                return
+            self.costs[entry] = {}  # claim before releasing the lock
+        _tls.suppress = True
+        try:
+            cost = wrapper._fn.lower(*args, **kwargs).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops = cost.get("flops")
+            bytes_accessed = cost.get("bytes accessed")
+            with self._lock:
+                self.costs[entry] = {
+                    "flops": float(flops) if flops is not None else None,
+                    "bytes_accessed": (
+                        float(bytes_accessed)
+                        if bytes_accessed is not None
+                        else None
+                    ),
+                }
+        except Exception:  # dlint: disable=DLP017 counted on the ledger itself (cost_errors); cost attribution is advisory and this module owns its own sink
+            with self._lock:
+                self.cost_errors += 1
+                self.costs.pop(entry, None)
+        finally:
+            _tls.suppress = False
+
+    def _dispatch(self, wrapper: "InstrumentedJit", args, kwargs):
+        entry = wrapper.entry_point
+        self.note_dispatch(entry)
+        frame = (
+            entry,
+            _static_sig(wrapper.static_argnames, kwargs),
+            _shape_sig(args, kwargs, wrapper.static_argnames),
+        )
+        stack = _stack()
+        stack.append(frame)
+        tok = self.seq()
+        t0 = time.perf_counter()
+        try:
+            return wrapper._fn(*args, **kwargs)
+        finally:
+            stack.pop()
+            ms = (time.perf_counter() - t0) * 1e3
+            if self.fallback:
+                self._fallback_note(frame, ms)
+            if self.cost_analysis:
+                compiled = any(
+                    e["entry"] == entry and e["cause"] != CAUSE_CACHE_HIT
+                    for e in self.events_since(tok)
+                )
+                if compiled:
+                    self._note_cost(entry, wrapper, args, kwargs)
+
+    # -- the read side -----------------------------------------------------
+
+    def events_since(
+        self, token: int, threads: Optional[set] = None
+    ) -> List[dict]:
+        """Events recorded after ``token`` (a prior ``seq()`` read),
+        optionally filtered to the given thread idents — the scheduler
+        passes its own solve threads so concurrent shards' compiles are
+        never cross-billed to this tick."""
+        with self._lock:
+            out = [e for e in self.events if e["seq"] > token]
+        if threads is not None:
+            out = [e for e in out if e.get("thread") in threads]
+        return out
+
+    def counters(self) -> dict:
+        """Flat totals for timeline emission / serve summaries."""
+        with self._lock:
+            return {
+                "compiles": self._seq,
+                "compile_cache_hits": self.cache_hits,
+                "compile_cache_misses": self.cache_misses,
+                "compile_cache_requests": self.cache_requests,
+                "compile_ms_total": round(
+                    sum(self.compile_ms.values()), 3
+                ),
+                "recompile_storms": self.storms,
+                "dispatches": sum(self.dispatches.values()),
+                "unattributed_compiles": self.unattributed,
+            }
+
+    def timeline_series(self) -> Dict[str, float]:
+        """The ledger's timeline emission — ONE definition shared by
+        ``Scheduler.timeline_sample`` and ``Gateway.timeline_sample`` so
+        the two serving shapes' series names cannot drift. Cumulative,
+        zero-valued from the first sample (a counter minted mid-incident
+        has no baseline — the PR 13 lesson), emitted only while a ledger
+        is enabled so feature-off samples stay byte-identical."""
+        c = self.counters()
+        return {
+            "c.compiles": float(c["compiles"]),
+            "c.compile_cache_hits": float(c["compile_cache_hits"]),
+            "c.recompile_storms": float(c["recompile_storms"]),
+            "compile_ms": float(c["compile_ms_total"]),
+        }
+
+    def cache_hit_rate(self) -> Optional[float]:
+        """Persistent-cache hit rate over cache-visible requests; None
+        when the persistent cache never engaged (DISTILP_COMPILE_CACHE
+        unset — hits and misses both zero)."""
+        with self._lock:
+            total = self.cache_hits + self.cache_misses
+            if total == 0:
+                return None
+            return self.cache_hits / total
+
+    def summary(self) -> dict:
+        """Per-entry-point table + cause histogram, JSON-able."""
+        with self._lock:
+            names = sorted(
+                set(self.dispatches) | set(self.compiles) | set(_REGISTRY)
+            )
+            entries = {}
+            for name in names:
+                entries[name] = {
+                    "registered": name in _REGISTRY,
+                    "dispatches": self.dispatches.get(name, 0),
+                    "compiles": self.compiles.get(name, 0),
+                    "compile_ms": round(self.compile_ms.get(name, 0.0), 3),
+                    "cache_hits": self.entry_cache_hits.get(name, 0),
+                }
+                if name in self.costs and self.costs[name]:
+                    entries[name]["cost"] = dict(self.costs[name])
+            return {
+                "entries": entries,
+                "causes": dict(sorted(self.causes.items())),
+                "counters": self.counters(),
+                "cache_hit_rate": self.cache_hit_rate(),
+                "fallback": self.fallback,
+            }
+
+    def dump(self) -> dict:
+        """The ledger as one JSON-able blob (header + event list)."""
+        with self._lock:
+            return {
+                "header": {
+                    "compile_ledger": 1,
+                    "capacity": self.capacity,
+                    "storm_threshold": self.storm_threshold,
+                    "storm_window_s": self.storm_window_s,
+                    "registry": sorted(_REGISTRY),
+                    "summary": self.summary(),
+                },
+                "events": [dict(e) for e in self.events],
+            }
+
+    def to_jsonl(self) -> str:
+        return ledger_to_jsonl(self.dump())
+
+    def dump_jsonl(self, path) -> None:
+        from pathlib import Path
+
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(self.to_jsonl(), encoding="utf-8")
+
+
+class InstrumentedJit:
+    """Transparent wrapper around one module-level jitted entry point.
+
+    With no ledger enabled the call path is one module-global read plus
+    the underlying dispatch; attribute access (``.lower``, ``.trace``,
+    ``._fun``) forwards to the wrapped jit so AOT consumers are
+    unaffected. Calls that happen INSIDE an outer trace run at trace
+    time only — their dispatch counts are trace-time counts, and their
+    compiles are attributed to the enclosing entry point (the executable
+    that actually gets built).
+    """
+
+    __slots__ = ("entry_point", "_fn", "static_argnames")
+
+    def __init__(self, entry_point: str, fn, static_argnames=()):
+        self.entry_point = entry_point
+        self._fn = fn
+        self.static_argnames = tuple(static_argnames)
+
+    def __call__(self, *args, **kwargs):
+        led = _LEDGER
+        if led is None:
+            return self._fn(*args, **kwargs)
+        return led._dispatch(self, args, kwargs)
+
+    def __getattr__(self, attr):
+        return getattr(self._fn, attr)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"InstrumentedJit({self.entry_point!r}, {self._fn!r})"
+
+
+def instrument(entry_point: str, fn, static_argnames=()) -> InstrumentedJit:
+    """Register + wrap a jitted entry point (the DLP020-sanctioned idiom:
+    ``X = instrument("layer.name", jax.jit(impl, static_argnames=S), S)``).
+
+    Re-registering a name replaces the wrapped callable (the twin's
+    kernel cache rebuilds after ``reset``); the registry entry survives.
+    """
+    _REGISTRY[entry_point] = {"static_argnames": tuple(static_argnames)}
+    return InstrumentedJit(entry_point, fn, static_argnames)
+
+
+def registered_entry_points() -> List[str]:
+    """Sorted names of every instrumented entry point imported so far —
+    the expected cold-compile surface ``make smoke-compile`` checks
+    compiles against."""
+    return sorted(_REGISTRY)
+
+
+# -- process-wide enable/disable ---------------------------------------------
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    led = _LEDGER
+    if led is None or led.fallback:
+        return
+    if event == BACKEND_COMPILE_EVENT:
+        if getattr(_tls, "cache_hit_pending", False):
+            # The event wrapping compile_or_get_cached fires even when the
+            # persistent cache served the executable — that retrieval was
+            # already recorded as THE cache-hit event below; recording the
+            # wrapper too would double-count every hit as a recompile.
+            _tls.cache_hit_pending = False
+            return
+        cache = "miss" if getattr(_tls, "cache_miss", False) else None
+        _tls.cache_miss = False
+        led._compile_from_listener(duration * 1e3, cache=cache)
+    elif event == CACHE_HIT_RETRIEVAL_EVENT:
+        # A persistent-cache hit skips the real backend compile; the
+        # retrieval time is the dispatch-blocking cost that remains.
+        _tls.cache_hit_pending = True
+        led._compile_from_listener(duration * 1e3, cache="hit")
+
+
+def _on_event(event: str, **kw) -> None:
+    led = _LEDGER
+    if led is None or led.fallback:
+        return
+    if event == CACHE_MISS_EVENT:
+        # Pairs with the backend_compile duration that follows on this
+        # same thread (the compile the cache could not serve).
+        _tls.cache_miss = True
+    elif event == CACHE_REQUEST_EVENT:
+        with led._lock:
+            led.cache_requests += 1
+
+
+def enable(ledger: Optional[CompileLedger] = None, **kwargs) -> CompileLedger:
+    """Install ``ledger`` (or a fresh one built from ``kwargs``) as THE
+    process ledger and make sure the jax.monitoring listeners are
+    registered. Idempotent per process; listeners stay registered across
+    disable/enable cycles and are dormant while no ledger is current.
+    Returns the installed ledger.
+    """
+    global _LEDGER, _MONITORING_OK
+    with _LEDGER_LOCK:
+        led = ledger if ledger is not None else CompileLedger(**kwargs)
+        if _MONITORING_OK is None:
+            try:
+                from jax import monitoring  # lazy: obs stays jax-free
+
+                monitoring.register_event_duration_secs_listener(_on_duration)
+                monitoring.register_event_listener(_on_event)
+                _MONITORING_OK = True
+            except Exception:  # dlint: disable=DLP017 recorded as ledger.fallback below — the wrap-the-jit path IS the accounting when listeners are unavailable
+                _MONITORING_OK = False
+        if not _MONITORING_OK:
+            led.fallback = True
+        _LEDGER = led
+        return led
+
+
+def disable() -> Optional[CompileLedger]:
+    """Detach the process ledger (listeners go dormant); returns it."""
+    global _LEDGER
+    with _LEDGER_LOCK:
+        led, _LEDGER = _LEDGER, None
+        return led
+
+
+def current() -> Optional[CompileLedger]:
+    return _LEDGER
+
+
+# -- persistence + report (the flight-recorder JSONL convention) -------------
+
+
+def ledger_to_jsonl(dump: dict) -> str:
+    """Header line + one event per line; pure function of the dump, so
+    ``to_jsonl(from_jsonl(s)) == s`` byte-for-byte."""
+    lines = [json.dumps(dump["header"], sort_keys=True)]
+    for ev in dump["events"]:
+        lines.append(json.dumps(ev, sort_keys=True))
+    return "\n".join(lines) + "\n"
+
+
+def ledger_from_jsonl(text: str) -> dict:
+    """Parse a dumped ledger back into the ``dump()`` shape."""
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("empty compile-ledger dump")
+    header = json.loads(lines[0])
+    if not isinstance(header, dict) or "compile_ledger" not in header:
+        raise ValueError("compile-ledger dump missing its header line")
+    if header["compile_ledger"] != 1:
+        raise ValueError(
+            f"unknown compile-ledger dump version {header['compile_ledger']!r}"
+        )
+    return {
+        "header": header,
+        "events": [json.loads(ln) for ln in lines[1:]],
+    }
+
+
+def render_report(dump: dict, top: int = 5) -> str:
+    """Deterministic text report over a ``dump()``/``ledger_from_jsonl``
+    blob: per-entry-point table, cause histogram, cache hit rate, top-N
+    recompile offenders. No clocks, no thread ids — byte-identical on
+    every replay of the same dump."""
+    summary = dump["header"].get("summary", {})
+    entries = summary.get("entries", {})
+    causes = summary.get("causes", {})
+    counters = summary.get("counters", {})
+    out: List[str] = []
+    out.append("compile ledger")
+    out.append(
+        "  compiles={compiles} dispatches={dispatches} "
+        "storms={recompile_storms} unattributed={unattributed_compiles} "
+        "compile_ms={compile_ms_total}".format(
+            **{
+                k: counters.get(k, 0)
+                for k in (
+                    "compiles", "dispatches", "recompile_storms",
+                    "unattributed_compiles", "compile_ms_total",
+                )
+            }
+        )
+    )
+    rate = summary.get("cache_hit_rate")
+    out.append(
+        "  persistent cache: "
+        + (
+            "not engaged (DISTILP_COMPILE_CACHE unset?)"
+            if rate is None
+            else "hit rate {:.1%} ({} hits / {} misses)".format(
+                rate,
+                counters.get("compile_cache_hits", 0),
+                counters.get("compile_cache_misses", 0),
+            )
+        )
+    )
+    out.append("")
+    out.append(
+        f"  {'entry point':<34s} {'disp':>7s} {'compiles':>8s} "
+        f"{'ms':>10s} {'hits':>5s}  registered"
+    )
+    for name in sorted(entries):
+        e = entries[name]
+        out.append(
+            f"  {name:<34s} {e['dispatches']:>7d} {e['compiles']:>8d} "
+            f"{e['compile_ms']:>10.1f} {e['cache_hits']:>5d}  "
+            f"{'yes' if e['registered'] else 'NO'}"
+        )
+        cost = e.get("cost")
+        if cost and (cost.get("flops") or cost.get("bytes_accessed")):
+            out.append(
+                "  {:<34s} flops={} bytes={}".format(
+                    "", cost.get("flops"), cost.get("bytes_accessed")
+                )
+            )
+    out.append("")
+    out.append("  causes:")
+    for cause in CAUSES:
+        if causes.get(cause):
+            out.append(f"    {cause:<20s} {causes[cause]:>6d}")
+    offenders = sorted(
+        (
+            (name, e["compiles"])
+            for name, e in entries.items()
+            if e["compiles"] > 1
+        ),
+        key=lambda kv: (-kv[1], kv[0]),
+    )[: max(0, top)]
+    if offenders:
+        out.append("")
+        out.append(f"  top recompile offenders (compiles > 1, top {top}):")
+        for name, n in offenders:
+            out.append(f"    {name:<34s} {n:>6d}")
+    storms = [e for e in dump.get("events", []) if e.get("storm")]
+    if storms:
+        out.append("")
+        out.append(f"  storm-flagged events: {len(storms)}")
+        for ev in storms[: max(0, top)]:
+            out.append(
+                f"    seq={ev['seq']} {ev['entry']} cause={ev['cause']} "
+                f"static=[{ev['static']}]"
+            )
+    return "\n".join(out) + "\n"

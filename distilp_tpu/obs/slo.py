@@ -68,6 +68,19 @@ __all__ = [
 # Queue-depth series convention shared by Gateway.timeline_sample and the
 # signals builder (one definition so neither side can drift).
 QUEUE_DEPTH_PREFIX = "queue_depth.w"
+
+# Series a fixed-cadence sampler keeps feeding while the process lives —
+# safe under a threshold-kind SLO with no staleness horizon. Event-fed
+# series (openloop.*, anything per-request) stop getting points when
+# traffic stops, which is exactly when a fired alert needs data to close.
+_GAUGE_SERIES_PREFIXES = ("lat.", "queue_depth.", "conv.")
+_GAUGE_SERIES_EXACT = frozenset({"last_serve_ms", "health", "compile_ms"})
+
+
+def _looks_like_gauge(series: str) -> bool:
+    return series in _GAUGE_SERIES_EXACT or any(
+        series.startswith(p) for p in _GAUGE_SERIES_PREFIXES
+    )
 # Trend window for /signals' queue-depth slope, seconds.
 SIGNAL_TREND_WINDOW_S = 30.0
 
@@ -127,6 +140,18 @@ class SLOSpec(BaseModel):
     # threshold (gauge) / rate_above (counter):
     series: Optional[str] = None
     threshold: Optional[float] = None
+    # Staleness horizon for EVENT-FED series (e.g. openloop.latency_ms,
+    # which only gets a point per completed event): once the newest
+    # sample of the spec's series is older than this, the window is
+    # treated as KNOWN-IDLE — error ratio 0.0 instead of None — so the
+    # alert's hysteretic close can actually run. Without it a
+    # threshold-kind alert over an event feed holds its window-slid
+    # "insufficient data" state FOREVER once traffic stops (the PR 13
+    # gotcha, fixed at the source). Continuously-sampled gauge series
+    # (lat.*.p99_ms, queue_depth.*) never go stale while the sampler
+    # lives, so they don't need this; the validator below steers
+    # threshold specs toward them when no horizon is given.
+    stale_after_s: Optional[float] = Field(default=None, gt=0)
     alerts: List[AlertRule] = Field(default_factory=default_alert_rules)
 
     @model_validator(mode="after")
@@ -142,6 +167,22 @@ class SLOSpec(BaseModel):
                 raise ValueError(
                     f"SLO {self.name!r}: kind={self.kind} needs series "
                     "and threshold"
+                )
+            if (
+                self.kind == "threshold"
+                and self.stale_after_s is None
+                and not _looks_like_gauge(self.series)
+            ):
+                import warnings
+
+                warnings.warn(
+                    f"SLO {self.name!r}: threshold over {self.series!r} "
+                    "looks event-fed — once the alert window slides past "
+                    "the last point the state machine holds (an open "
+                    "alert can never close). Use a continuously-sampled "
+                    "gauge series (lat.*.p99_ms, queue_depth.*) or set "
+                    "stale_after_s so an idle feed reads as error 0.",
+                    stacklevel=2,
                 )
         return self
 
@@ -159,16 +200,39 @@ class SLOSpec(BaseModel):
                 self.bad_series, self.total_series, window_s, now
             )
         if self.kind == "threshold":
-            return timeline.frac_above(
+            frac = timeline.frac_above(
                 self.series, self.threshold, window_s, now
             )
+            if frac is None:
+                return self._stale_zero(timeline, now)
+            return frac
         rate = timeline.rate(self.series, window_s, now)
         if rate is None:
-            return None
+            return self._stale_zero(timeline, now)
         # rate_above: normalize the counter's per-second rate by the
         # bound so "budget's worth of badness" keeps one meaning across
         # kinds (rate == threshold -> ratio == budget -> burn == 1).
         return min(1.0, (rate / self.threshold) * self.budget)
+
+    def _stale_zero(
+        self, timeline: Timeline, now: Optional[float]
+    ) -> Optional[float]:
+        """None → 0.0 when the spec's event-fed series went KNOWN-idle:
+        the series has recorded at least one point, its newest point is
+        older than ``stale_after_s``, and the caller gave a horizon. An
+        idle event feed burns nothing (budgets are request-weighted), so
+        the windowed None must become a closeable zero — otherwise the
+        window slides past the last point and the alert holds open
+        forever. A series that never recorded stays None: a sampler that
+        never came up is missing data, not idleness."""
+        if self.stale_after_s is None or now is None:
+            return None
+        latest = timeline.latest(self.series)
+        if latest is None:
+            return None
+        if now - latest[0] >= self.stale_after_s:
+            return 0.0
+        return None
 
     def burn_rate(
         self, timeline: Timeline, window_s: float, now: Optional[float]

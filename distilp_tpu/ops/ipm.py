@@ -40,7 +40,6 @@ Design notes, TPU-first:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Optional
 
 import jax
@@ -51,6 +50,8 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
+
+from ..obs.compile_ledger import instrument  # noqa: E402  - stdlib-only
 
 BOUND_DTYPE = jnp.float64
 
@@ -426,7 +427,6 @@ def _ipm_single(A, b, c, l, u, iters: int, tol, reg, warm=None, skip=None,
     )
 
 
-@partial(jax.jit, static_argnames=("iters", "chunk", "trace"))
 def ipm_solve_batch(
     batch: LPBatch,
     iters: int = 30,
@@ -477,3 +477,15 @@ def ipm_solve_batch(
         return jax.vmap(single, in_axes=axes)(
             batch.A, batch.b, batch.c, batch.l, batch.u, warm, skip
         )
+
+
+# Registered compile-ledger entry point (obs.compile_ledger; dlint DLP020):
+# the wrapper is a passthrough while no ledger is enabled, and with one
+# enabled it attributes this kernel's XLA compiles — every static below
+# (`iters`/`chunk`/`trace`) mints a distinct executable, which is exactly
+# what the ledger's static-arg-flip cause makes visible.
+ipm_solve_batch = instrument(
+    "ops.ipm.ipm_solve_batch",
+    jax.jit(ipm_solve_batch, static_argnames=("iters", "chunk", "trace")),
+    static_argnames=("iters", "chunk", "trace"),
+)

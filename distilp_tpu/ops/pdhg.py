@@ -55,7 +55,6 @@ interchangeable behind ``backend_jax``:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Optional
 
 import jax
@@ -66,6 +65,7 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
+from ..obs.compile_ledger import instrument  # noqa: E402  - stdlib-only
 from .ipm import (  # noqa: E402
     BOUND_DTYPE,
     TRACE_COLS,
@@ -450,7 +450,6 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
     )
 
 
-@partial(jax.jit, static_argnames=("iters", "chunk", "trace"))
 def pdhg_solve_batch(
     batch: LPBatch,
     iters: int = 1000,
@@ -499,3 +498,13 @@ def pdhg_solve_batch(
         return jax.vmap(single, in_axes=axes)(
             batch.A, batch.b, batch.c, batch.l, batch.u, warm, skip
         )
+
+
+# Registered compile-ledger entry point (obs.compile_ledger; dlint DLP020):
+# same contract as ops.ipm.ipm_solve_batch — the `iters`/`chunk`/`trace`
+# statics each mint a distinct executable, and the ledger attributes them.
+pdhg_solve_batch = instrument(
+    "ops.pdhg.pdhg_solve_batch",
+    jax.jit(pdhg_solve_batch, static_argnames=("iters", "chunk", "trace")),
+    static_argnames=("iters", "chunk", "trace"),
+)

@@ -159,6 +159,10 @@ METRIC_REGISTRY = {
     # -- observability layer ----------------------------------------------
     "flight_dumps": "Flight-recorder post-mortem dumps written",
     "health_state": "Shard health as a gauge (0 healthy, 1 degraded, 2 broken)",
+    # -- compile ledger (obs.compile_ledger) ------------------------------
+    "compiles": "XLA compile events attributed to this scheduler's ticks",
+    "compile_cache_hits": "Compiles served by the persistent compilation cache",
+    "recompile_storms": "Recompile-storm alarms (N same-entry compiles in a window)",
     # -- SLO engine / metrics timelines (obs.timeline + obs.slo) ----------
     "timeline_samples": "Timeline sampler ticks that recorded a sample",
     "timeline_sample_error": "Timeline sampler ticks that failed (counted, never fatal)",
@@ -173,6 +177,7 @@ METRIC_REGISTRY = {
     "gateway_event_to_placement": "Gateway ingest to placement (queue wait included), ms",
     "spec_hit_ms": "Speculative-hit serve latency (bank probe to publish), ms",
     "spec_presolve_ms": "Speculative presolve batch latency (off the serving path), ms",
+    "compile_ms": "XLA compile time a tick paid (ledger-attributed), ms",
 }
 
 # Longest-prefix fallback for dynamically composed names. Every f-string

@@ -26,6 +26,7 @@ from collections import OrderedDict, deque
 from typing import Callable, NamedTuple, Optional, Sequence, Tuple
 
 from ..common import DeviceProfile, ModelProfile
+from ..obs import compile_ledger as _compile_ledger
 from ..obs.trace import NOOP_SPAN, NOOP_TRACER
 from ..solver.result import HALDAResult
 from ..solver.streaming import StreamingReplanner
@@ -96,12 +97,20 @@ class _SolveWorker:
         import threading
 
         self._q: "queue.Queue" = queue.Queue()
+        # The worker's thread ident, read by the compile-ledger capture:
+        # a deadline-path solve compiles on THIS thread, and the tick's
+        # compile attribution must include it (set in _run; reads before
+        # the thread publishes it just see None and skip the filter hit).
+        self.ident = None
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="sched-solve"
         )
         self._thread.start()
 
     def _run(self) -> None:
+        import threading
+
+        self.ident = threading.get_ident()
         while True:
             item = self._q.get()
             if item is None:
@@ -443,6 +452,11 @@ class Scheduler:
         # conv_* digest when solver diagnostics ran. Reset per handle().
         self._tick_exc: dict = {}
         self._tick_conv: Optional[dict] = None
+        # This tick's compile-ledger delta (obs.compile_ledger): set by
+        # _note_compiles when a process ledger is enabled AND the tick's
+        # own threads paid at least one XLA compile; rides the flight
+        # record so a slow tick's post-mortem says WHY it was slow.
+        self._tick_compile: Optional[dict] = None
         self.jax_profile_dir = jax_profile_dir
         self._jax_profiled = False
         if solve_on_init:
@@ -509,11 +523,18 @@ class Scheduler:
             self._span = span
             self._tick_exc = {}
             self._tick_conv = None
+            self._tick_compile = None
+            led = _compile_ledger.current()
+            tok = led.seq() if led is not None else 0
             view: Optional[PlacementView] = None
             try:
                 view = self._handle(event, pressure=pressure)
                 return view
             finally:
+                if led is not None:
+                    # BEFORE the flight note: the compile counters must be
+                    # in this tick's counter delta, not the next one's.
+                    self._note_compiles(led, tok, span)
                 span.set_attr("mode", view.mode if view is not None else "error")
                 if self._flight is not None:
                     self._flight_note(event, view, span)
@@ -554,11 +575,16 @@ class Scheduler:
             self._span = span
             self._tick_exc = {}
             self._tick_conv = None
+            self._tick_compile = None
+            led = _compile_ledger.current()
+            tok = led.seq() if led is not None else 0
             view: Optional[PlacementView] = None
             try:
                 view = self._handle_coalesced(events, pressure)
                 return view
             finally:
+                if led is not None:
+                    self._note_compiles(led, tok, span)
                 span.set_attr("mode", view.mode if view is not None else "error")
                 if self._flight is not None:
                     self._flight_note(last, view, span)
@@ -1235,6 +1261,68 @@ class Scheduler:
         self._span.add_event("served_stale", mode=mode)
         return self.latest()
 
+    def _solve_threads(self) -> set:
+        """Thread idents this tick's solves (and presolves) may compile
+        on: the handling thread itself plus the deadline worker. The
+        compile-ledger capture filters on these so concurrent shards'
+        compiles are never cross-billed to this scheduler's tick."""
+        import threading
+
+        threads = {threading.get_ident()}
+        if self._executor is not None and self._executor.ident is not None:
+            threads.add(self._executor.ident)
+        return threads
+
+    def _note_compiles(self, led, token: int, span) -> None:
+        """Attribute this tick's compile-ledger events: counters
+        (``compiles``/``compile_cache_hits``/``recompile_storms`` +
+        the ``compile_ms`` hist) ride the shared metrics sink — and
+        therefore ``timeline_sample``'s ``c.*`` series and the gateway's
+        shard aggregation — while the event detail lands on the tick
+        span and the flight record. Zero events = zero work, and with no
+        ledger enabled this is never called (the byte-identical pin)."""
+        events = led.events_since(token, threads=self._solve_threads())
+        if not events:
+            return
+        n = len(events)
+        hits = sum(1 for e in events if e.get("cache") == "hit")
+        ms = sum(e.get("compile_ms") or 0.0 for e in events)
+        # Episode TRANSITIONS only (ev["storm_start"]), never the per-
+        # event storm flags: the counter must agree with the ledger's
+        # `storms` total and the c.recompile_storms timeline series —
+        # one alarm per episode, however many compiles it contains.
+        storms = sum(1 for e in events if e.get("storm_start"))
+        causes: dict = {}
+        for e in events:
+            causes[e["cause"]] = causes.get(e["cause"], 0) + 1
+        self.metrics.inc("compiles", n)
+        if hits:
+            self.metrics.inc("compile_cache_hits", hits)
+        if storms:
+            self.metrics.inc("recompile_storms", storms)
+        self.metrics.observe("compile_ms", ms)
+        span.set_attr("compiles", n)
+        span.set_attr("compile_ms", round(ms, 3))
+        span.set_attr(
+            "compile_causes",
+            ",".join(f"{k}:{v}" for k, v in sorted(causes.items())),
+        )
+        self._tick_compile = {
+            "count": n,
+            "ms": round(ms, 3),
+            "cache_hits": hits,
+            "causes": causes,
+            "entries": sorted({e["entry"] for e in events}),
+        }
+        if storms:
+            self._tick_compile["storms"] = storms
+            span.add_event("recompile_storm", count=storms)
+            if self._flight is not None and self._flight_pending is None:
+                # A storm is a post-mortem moment of its own class: dump
+                # the ring after this tick's record lands (same deferred
+                # shape as breaker_open, never clobbering one).
+                self._flight_pending = "recompile_storm"
+
     def _flight_note(self, event, view: Optional[PlacementView], span) -> None:
         """Append this tick's flight record; fire any pending post-mortem.
 
@@ -1272,6 +1360,11 @@ class Scheduler:
             # Solver-diagnostics digest (Scheduler(diagnostics=True)): the
             # tick's convergence facts next to its mode/health/deltas.
             rec["convergence"] = dict(self._tick_conv)
+        if self._tick_compile is not None:
+            # A tick that paid an XLA compile says so — and why (cause
+            # taxonomy + which entry points): the multi-second span a
+            # post-mortem would otherwise call 'unexplained'.
+            rec["compile"] = dict(self._tick_compile)
         if self.speculative:
             # The post-mortem question speculation adds: was THIS tick a
             # hit or a miss, and how full was the bank when it happened?
@@ -1488,6 +1581,13 @@ class Scheduler:
             for k, v in self._tick_conv.items():
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     out[f"conv.{k}"] = float(v)
+        led = _compile_ledger.current()
+        if led is not None:
+            # Process-wide compile telemetry (timeline_series is the one
+            # definition, shared with Gateway.timeline_sample): an SLO
+            # over c.compiles / c.recompile_storms sees a storm's full
+            # delta, and the feature-off sample stays byte-identical.
+            out.update(led.timeline_series())
         return out
 
     # -- warm snapshot / restore (the gateway's drain/restore cycle) -------

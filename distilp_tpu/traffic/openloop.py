@@ -313,6 +313,14 @@ def run_openloop(
         from ..obs.timeline import Timeline
 
         timeline = Timeline()
+    # Per-arm compile-ledger snapshot: when the process ledger is enabled
+    # (bench compile section, serve --compile-ledger) every open-loop arm
+    # reports its own compile delta — a flood arm that silently paid a
+    # recompile storm would otherwise launder it into aggregate wall time.
+    from ..obs import compile_ledger as _cl
+
+    _led = _cl.current()
+    _led_tok = _led.seq() if _led is not None else 0
     try:
         from ..gateway.traces import make_fleet_from_spec
 
@@ -385,6 +393,18 @@ def run_openloop(
         )
         if flight is not None:
             report["shed_violations"] = shed_violations(gateway, flight)
+        if _led is not None:
+            arm_events = _led.events_since(_led_tok)
+            report["compile"] = {
+                "events": len(arm_events),
+                "cache_hits": sum(
+                    1 for e in arm_events if e.get("cache") == "hit"
+                ),
+                "storm_flagged": sum(
+                    1 for e in arm_events if e.get("storm")
+                ),
+                "entries": sorted({e["entry"] for e in arm_events}),
+            }
         if engine is not None:
             report["slo"] = {
                 "alerts_opened": snap["counters"].get("slo_alert_opened", 0),

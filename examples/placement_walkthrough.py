@@ -506,6 +506,54 @@ def main() -> int:
         f"{len(alert_recs)} flight record(s) reconcile the trail "
         f"({slo['timeline_samples']} timeline samples)"
     )
+
+    # ------------------------------------------------------------------
+    # 16. Compile ledger: every second of XLA compile time above was
+    #     invisible — a cold solve and a silently-recompiling one look
+    #     identical from wall clock alone. Enable the process ledger and
+    #     flip the LP engine pin mid-run: this process already compiled
+    #     the ipm executables (step 12), so the ipm arm records ~zero
+    #     compile events, while the pdhg arm mints new executables that
+    #     the ledger attributes to the `lp_backend` STATIC-ARG FLIP —
+    #     entry point, cause, and compile milliseconds, not an
+    #     unexplained multi-second tick. `solver compiles` renders the
+    #     same ledger from a live run or a dumped JSONL; `make
+    #     smoke-compile` gates the zero-recompile warm-serving invariant
+    #     (README "Compilation observability").
+    # ------------------------------------------------------------------
+    from distilp_tpu.obs import compile_ledger
+
+    led = compile_ledger.enable()
+    try:
+        for engine in ("ipm", "pdhg"):
+            tok = led.seq()
+            sched = Scheduler(
+                make_synthetic_fleet(4, seed=11), spec_model, mip_gap=1e-3,
+                kv_bits="4bit", backend="jax", k_candidates=[8, 10],
+                lp_backend=engine,
+            )
+            for ev in spec_events[:3]:
+                sched.handle(ev)
+            sched.close()
+            evs = led.events_since(tok)
+            causes = ",".join(sorted({e["cause"] for e in evs})) or "none"
+            print(
+                f"[16] lp_backend={engine}: {len(evs)} compile event(s) "
+                f"({causes}), "
+                f"{sum(e['compile_ms'] for e in evs):.0f} ms of XLA compile"
+            )
+        flips = [
+            e for e in led.events_since(0)
+            if e["cause"] == "static_arg_flip"
+            and "lp_backend='pdhg'" in e["static"]
+        ]
+        print(
+            f"[16] the engine flip minted {len(flips)} new executable(s), "
+            f"attributed to {sorted({e['entry'] for e in flips})} — "
+            "not an unexplained slow tick"
+        )
+    finally:
+        compile_ledger.disable()
     return 0
 
 

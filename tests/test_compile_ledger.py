@@ -1,0 +1,332 @@
+"""XLA compile ledger (obs/compile_ledger.py): classification, storms,
+persistence, and the serving-path integration.
+
+Two tiers, following the repo's test economics:
+
+- **Unit tier** (no solver): the ledger in wrap-the-jit fallback mode —
+  plain python callables stand in for jitted entry points, so cause
+  classification (cold / static-arg-flip / shape-bucket-change /
+  recompile / cache-hit), storm detection, thread filtering and the
+  byte-stable JSONL round trip are all pinned without compiling anything.
+- **Solver tier**: real schedulers on the JAX CPU backend (test_sched's
+  small-L recipe so jit compiles amortize across the module) pin the
+  tick attribution (counters + span attrs + flight records) and THE
+  invariant this module exists to guard: after warmup, steady-state
+  warm/spec/spec_near serving records ZERO compile events — on both LP
+  engines.
+
+Every test that enables a ledger disables it in a finally: the ledger is
+process-global, and a leaked one would mint ``compiles`` counters into
+other tests' byte-identical serving pins.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from distilp_tpu.obs import compile_ledger as cl
+from distilp_tpu.obs.compile_ledger import (
+    CompileLedger,
+    InstrumentedJit,
+    instrument,
+    ledger_from_jsonl,
+    ledger_to_jsonl,
+    render_report,
+)
+
+GAP = 1e-3
+KS = [4, 8]
+
+
+class _Arr:
+    """Shape-carrying stand-in for an array (no numpy needed)."""
+
+    def __init__(self, *shape, dtype="float32"):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+
+@pytest.fixture()
+def ledger():
+    led = CompileLedger(storm_threshold=3, storm_window_s=60.0)
+    led.fallback = True  # wrap-the-jit mode: nothing real compiles
+    cl.enable(led)
+    try:
+        yield led
+    finally:
+        cl.disable()
+
+
+# -- unit tier: wrapper + classification ------------------------------------
+
+
+def test_wrapper_is_passthrough_with_no_ledger():
+    assert cl.current() is None
+    calls = []
+    fn = instrument("tests.passthrough", lambda x: calls.append(x) or x)
+    assert isinstance(fn, InstrumentedJit)
+    assert fn(7) == 7 and calls == [7]
+    # Registered at import/instrument time regardless of enablement.
+    assert "tests.passthrough" in cl.registered_entry_points()
+
+
+def test_fallback_classifies_cold_flip_and_shape(ledger):
+    fn = instrument(
+        "tests.kernel", lambda batch, n=1: batch, static_argnames=("n",)
+    )
+    a, b = _Arr(2, 3), _Arr(4, 5)
+    fn(a, n=1)  # first signature ever -> cold
+    fn(a, n=2)  # same shapes, new static -> static_arg_flip
+    fn(b, n=2)  # same static, new shapes -> shape_bucket_change
+    fn(a, n=1)  # seen signature -> NO new event in fallback mode
+    causes = [e["cause"] for e in ledger.events_since(0)]
+    assert causes == ["cold", "static_arg_flip", "shape_bucket_change"]
+    assert ledger.dispatches["tests.kernel"] == 4
+    assert ledger.counters()["compiles"] == 3
+    ev = ledger.events_since(0)[1]
+    assert "n=2" in ev["static"]
+    assert "float32[2, 3]" in ev["shapes"]
+
+
+def test_shape_signature_flattens_containers(ledger):
+    fn = instrument("tests.tree", lambda data: data)
+    fn({"b": _Arr(2), "a": (_Arr(3), None, 5.0)})
+    sig = ledger.events_since(0)[0]["shapes"]
+    # dict keys sorted, nested tuple flattened, non-arrays skipped.
+    assert sig == "float32[3];float32[2]"
+
+
+def test_recompile_cause_and_storm_alarm():
+    led = CompileLedger(storm_threshold=3, storm_window_s=60.0)
+    for i in range(4):
+        ev = led.note_compile("tests.hot", "n=1", "f32[2]", ms=10.0)
+    events = list(led.events)
+    assert [e["cause"] for e in events] == [
+        "cold", "recompile", "recompile", "recompile"
+    ]
+    # Storm flags from the threshold on; the storm COUNTER is the
+    # transition (one alarm per storm, however long it lasts), and the
+    # transition event alone carries storm_start — what the scheduler's
+    # recompile_storms counter tallies, so metric and ledger agree.
+    assert [bool(e.get("storm")) for e in events] == [
+        False, False, True, True
+    ]
+    assert [bool(e.get("storm_start")) for e in events] == [
+        False, False, True, False
+    ]
+    assert led.storms == 1
+    assert ev["storm"] is True
+    # A different entry under threshold stays unflagged.
+    led.note_compile("tests.cool", "n=1", "f32[2]", ms=1.0)
+    assert "storm" not in list(led.events)[-1]
+
+
+def test_cache_hit_cause_and_hit_rate():
+    led = CompileLedger()
+    led.note_compile("tests.k", "n=1", "s", ms=100.0, cache="miss")
+    led.note_compile("tests.k", "n=2", "s", ms=20.0, cache="hit")
+    assert [e["cause"] for e in led.events] == ["cold", "cache_hit"]
+    assert led.cache_hit_rate() == pytest.approx(0.5)
+    assert led.counters()["compile_cache_hits"] == 1
+    # No persistent cache engaged at all -> None, not 0.0.
+    assert CompileLedger().cache_hit_rate() is None
+
+
+def test_unregistered_attribution(ledger):
+    # A compile landing with no entry context (inline jit, dependency
+    # compile) is counted under the sentinel bucket — the dynamic view
+    # of what DLP020 guards statically.
+    ledger._compile_from_listener(50.0, cache=None)
+    ev = ledger.events_since(0)[-1]
+    assert ev["entry"] == "(unregistered)"
+    assert ledger.counters()["unattributed_compiles"] == 1
+    assert "NO" in render_report(ledger.dump())
+
+
+def test_events_since_token_and_thread_filter(ledger):
+    fn = instrument("tests.threads", lambda x: x)
+    fn(_Arr(1))
+    tok = ledger.seq()
+    other: list = []
+    t = threading.Thread(target=lambda: other.append(fn(_Arr(2))))
+    t.start()
+    t.join()
+    fn(_Arr(3))
+    all_since = ledger.events_since(tok)
+    assert len(all_since) == 2
+    mine = ledger.events_since(tok, threads={threading.get_ident()})
+    assert len(mine) == 1 and "float32[3]" in mine[0]["shapes"]
+
+
+def test_jsonl_round_trip_byte_stable_and_report_deterministic(ledger):
+    fn = instrument(
+        "tests.dump", lambda x, n=0: x, static_argnames=("n",)
+    )
+    fn(_Arr(2), n=1)
+    fn(_Arr(2), n=2)
+    text = ledger.to_jsonl()
+    dump = ledger_from_jsonl(text)
+    assert ledger_to_jsonl(dump) == text  # byte-stable round trip
+    # Rendering a dump is a pure function: same dump, same bytes —
+    # and it carries the table, causes, and offender sections.
+    r1, r2 = render_report(dump), render_report(ledger_from_jsonl(text))
+    assert r1 == r2
+    assert "tests.dump" in r1 and "static_arg_flip" in r1
+    assert "top recompile offenders" in r1
+
+
+def test_from_jsonl_rejects_bad_dumps():
+    with pytest.raises(ValueError, match="empty"):
+        ledger_from_jsonl("")
+    with pytest.raises(ValueError, match="header"):
+        ledger_from_jsonl('{"not": "a header"}')
+    with pytest.raises(ValueError, match="version"):
+        ledger_from_jsonl('{"compile_ledger": 99}')
+
+
+def test_enable_reuses_and_disable_detaches():
+    led = cl.enable()
+    try:
+        assert cl.current() is led
+        led2 = CompileLedger()
+        assert cl.enable(led2) is led2 and cl.current() is led2
+    finally:
+        assert cl.disable() is led2
+        assert cl.current() is None
+
+
+# -- solver tier: serving-path attribution ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    from distilp_tpu.profiler.api import profile_model
+
+    return profile_model(
+        "tests/configs/llama31_8b_4bit.json", batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+
+
+@pytest.fixture()
+def fleet():
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    return make_synthetic_fleet(4, seed=11)
+
+
+def make_scheduler(fleet, model, **kw):
+    from distilp_tpu.sched import Scheduler
+
+    kw.setdefault("mip_gap", GAP)
+    kw.setdefault("kv_bits", "4bit")
+    kw.setdefault("backend", "jax")
+    kw.setdefault("k_candidates", KS)
+    return Scheduler(fleet, model, **kw)
+
+
+def test_no_ledger_means_no_compile_counters(fleet, model):
+    from distilp_tpu.sched import LoadTick
+
+    assert cl.current() is None
+    sched = make_scheduler(fleet, model)
+    sched.handle(LoadTick(t_comm_jitter={fleet[1].name: 1.1}))
+    assert "compiles" not in sched.metrics.counters
+    assert "compile_ms" not in sched.metrics.hists
+    sched.close()
+
+
+def test_tick_attribution_counters_span_flight(fleet, model):
+    from distilp_tpu.obs.flight import FlightRecorder
+    from distilp_tpu.obs.trace import Tracer
+    from distilp_tpu.sched import LoadTick
+
+    led = cl.enable()
+    try:
+        tracer = Tracer(capacity=256)
+        flight = FlightRecorder()
+        sched = make_scheduler(fleet, model, tracer=tracer, flight=flight)
+        sched.handle(LoadTick(t_comm_jitter={fleet[1].name: 1.1}))
+        sched.handle(LoadTick(t_comm_jitter={fleet[1].name: 1.1}))
+        c = sched.metrics.counters
+        recs = flight.snapshot("default")
+        if c.get("compiles", 0):
+            # Cold layouts not yet jit-cached by earlier tests in this
+            # process: the tick(s) that paid say so, with causes.
+            paid = [r for r in recs if "compile" in r]
+            assert paid, "compiles counted but no flight record carries them"
+            assert sum(r["compile"]["count"] for r in paid) == c["compiles"]
+            assert all(r["compile"]["entries"] for r in paid)
+            spans = [
+                s for s in tracer.spans()
+                if s["name"] == "sched.tick" and "compiles" in s["attrs"]
+            ]
+            assert (
+                sum(s["attrs"]["compiles"] for s in spans) == c["compiles"]
+            )
+            assert sched.metrics.hists["compile_ms"].count == len(paid)
+        else:
+            # Everything was already compiled process-wide; then no tick
+            # may claim otherwise.
+            assert not any("compile" in r for r in recs)
+            assert "compile_ms" not in sched.metrics.hists
+        # Timeline sample always carries the ledger series while enabled.
+        sample = sched.timeline_sample()
+        assert sample["c.compiles"] == float(led.counters()["compiles"])
+        assert "compile_ms" in sample
+        sched.close()
+    finally:
+        cl.disable()
+
+
+@pytest.mark.parametrize("lp_backend", ["ipm", "pdhg"])
+def test_warm_serving_never_recompiles(fleet, model, lp_backend):
+    """THE zero-recompile regression pin: after gateway-style warmup, a
+    drift / spec-hit / spec_near tick sequence records ZERO compile
+    events in the ledger — warm serving never silently recompiles. Until
+    now this invariant was assumed (warmup conventions in every bench);
+    this is the test that fails when a new static arg, a shape-unstable
+    layout, or an inline jit sneaks onto the hot path."""
+    from distilp_tpu.sched import LoadTick
+
+    names = [d.name for d in fleet]
+    led = cl.enable()
+    try:
+        sched = make_scheduler(
+            fleet, model, speculative=True, lp_backend=lp_backend
+        )
+        up = LoadTick(t_comm_jitter={names[1]: 1.4, names[2]: 1.4})
+        down = LoadTick(
+            t_comm_jitter={names[1]: 1 / 1.4, names[2]: 1 / 1.4}
+        )
+        # Warmup: the cold layout, the warm layout, the speculative
+        # scenario batch, and both oscillation states' bank entries all
+        # compile/populate here.
+        sched.handle(up)
+        sched.handle(down)
+        sched.handle(up)
+        token = led.seq()
+        # Steady state: plain drift (warm), oscillation (spec hits), and
+        # a pressure tick served from the bank's near-match.
+        v_warm = sched.handle(down)
+        v_spec = sched.handle(up)
+        v_near = sched.handle(
+            LoadTick(t_comm_jitter={names[1]: 1.12}), pressure=True
+        )
+        assert v_warm.mode in ("warm", "spec")
+        assert v_spec.mode == "spec"
+        assert v_near.mode == "spec_near"
+        stray = led.events_since(token)
+        assert stray == [], (
+            f"warm serving paid {len(stray)} compile(s) under "
+            f"{lp_backend}: "
+            + "; ".join(
+                f"{e['entry']}[{e['cause']}] static=[{e['static']}]"
+                for e in stray
+            )
+        )
+        sched.close()
+    finally:
+        cl.disable()

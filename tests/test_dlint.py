@@ -36,7 +36,7 @@ def test_registry_has_all_rule_codes():
     expected = {
         "DLP001", "DLP002", "DLP010", "DLP011",
         "DLP012", "DLP013", "DLP014", "DLP015", "DLP016", "DLP017",
-        "DLP018", "DLP019",
+        "DLP018", "DLP019", "DLP020",
     }
     assert expected <= set(RULES)
     for code, rule in RULES.items():
@@ -1493,3 +1493,123 @@ def test_slo_and_timeline_modules_are_currently_clean():
         src = Path(mod).read_text()
         for code in ("DLP013", "DLP017", "DLP019"):
             assert findings_for(code, mod, src) == [], (mod, code)
+
+
+# --------------------------------------------------------------------------
+# DLP020 — jax.jit sites must be module-level + ledger-registered
+
+
+def test_unregistered_module_level_jit_flagged():
+    out = findings_for("DLP020", "distilp_tpu/ops/newkernel.py", """\
+        import jax
+
+        def impl(x):
+            return x
+
+        solve = jax.jit(impl, static_argnames=("n",))
+        """)
+    assert len(out) == 1 and "instrument" in out[0].message
+
+
+def test_instrumented_module_level_jit_ok():
+    out = findings_for("DLP020", "distilp_tpu/ops/newkernel.py", """\
+        import jax
+        from ..obs.compile_ledger import instrument
+
+        def impl(x):
+            return x
+
+        solve = instrument(
+            "ops.newkernel.solve",
+            jax.jit(impl, static_argnames=("n",)),
+            static_argnames=("n",),
+        )
+        """)
+    assert out == []
+
+
+def test_jit_decorated_def_flagged():
+    out = findings_for("DLP020", "distilp_tpu/solver/newpath.py", """\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def solve(x, n=1):
+            return x
+
+        @jax.jit
+        def other(x):
+            return x
+        """)
+    assert len(out) == 2
+    assert all("instrument" in f.message for f in out)
+
+
+def test_jit_inside_function_body_flagged():
+    out = findings_for("DLP020", "distilp_tpu/twin/newengine.py", """\
+        def build():
+            import jax
+
+            fn = jax.jit(lambda x: x)
+            return fn
+        """)
+    assert len(out) == 1 and "function body" in out[0].message
+
+
+def test_jit_inside_loop_body_flagged_as_storm():
+    out = findings_for("DLP020", "distilp_tpu/sched/newtick.py", """\
+        import jax
+
+        def serve(items):
+            for it in items:
+                f = jax.jit(lambda x: x)
+                f(it)
+        """)
+    assert len(out) == 1 and "loop body" in out[0].message
+
+
+def test_lazy_kernel_cache_justified_disable_ok():
+    """The twin idiom: a function-scope jit built ONCE into a module
+    global carries a justified inline disable — the sanctioned shape."""
+    out = findings_for("DLP020", "distilp_tpu/twin/newengine.py", """\
+        _KERNEL = None
+
+        def _build():
+            global _KERNEL
+            import jax
+            from ..obs.compile_ledger import instrument
+
+            _KERNEL = instrument(
+                "twin.new_kernel",
+                jax.jit(lambda x: x),  # dlint: disable=DLP020 built once into the module-global kernel cache
+                static_argnames=(),
+            )
+            return _KERNEL
+        """)
+    assert out == []
+
+
+def test_dlp020_out_of_scope_and_tests_exempt():
+    snippet = """\
+        import jax
+
+        probe = jax.jit(lambda v: v * 1.0)
+        """
+    assert findings_for("DLP020", "distilp_tpu/profiler/device2.py", snippet) == []
+    assert findings_for("DLP020", "tests/test_something.py", snippet) == []
+
+
+def test_dlp020_real_jit_modules_are_currently_clean():
+    """Every in-scope module that actually jits passes: the entry points
+    are instrument()-wrapped (ops/, solver/) or carry the one justified
+    lazy-cache disable (twin/engine.py)."""
+    from pathlib import Path
+
+    for mod in (
+        "distilp_tpu/ops/ipm.py",
+        "distilp_tpu/ops/pdhg.py",
+        "distilp_tpu/solver/backend_jax.py",
+        "distilp_tpu/twin/engine.py",
+    ):
+        src = Path(mod).read_text()
+        assert lint_source(mod, src, select=["DLP020"]) == [], mod

@@ -690,3 +690,92 @@ def test_bench_history_append_load_roundtrip(tmp_path):
     assert "round" not in rows[1]
     assert set(rec) - {"round", "captured_at"} <= set(HISTORY_KEYS)
     assert rec2["captured_at"]
+
+
+# --------------------------------------------------------------------------
+# stale_after_s — event-fed threshold series must be able to CLOSE
+
+
+def _event_feed_spec(stale_after_s=None, suppress_warning=False):
+    import warnings
+
+    kw = {}
+    if stale_after_s is not None:
+        kw["stale_after_s"] = stale_after_s
+    with warnings.catch_warnings():
+        if suppress_warning:
+            warnings.simplefilter("ignore")
+        spec = SLOSpec(
+            name="latency", kind="threshold", objective=0.9,
+            series="openloop.latency_ms", threshold=100.0,
+            alerts=[
+                AlertRule(
+                    severity="page",
+                    windows=[BurnWindow(window_s=1.0, burn_rate=1.0)],
+                    clear_hold_s=0.0,
+                )
+            ],
+            **kw,
+        )
+        # Inside the catch block: pydantic re-validates the nested spec
+        # (re-running its validator) when the parent model builds.
+        return SLOConfig(slos=[spec])
+
+
+def _stale_feed_timeline():
+    """An event-fed latency series: every point bad, then traffic STOPS
+    at t=5 — the exact shape that used to hold an alert open forever."""
+    tl = Timeline()
+    for i in range(101):
+        tl.record(
+            "openloop.latency_ms", i * 0.05, 500.0
+        )  # last point at t=5.0
+    return tl
+
+
+def test_threshold_over_event_feed_warns_without_stale_horizon():
+    with pytest.warns(UserWarning, match="event-fed"):
+        _event_feed_spec()
+    # A staleness horizon — or a continuously-sampled gauge series —
+    # makes the spec closeable, so neither warns.
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _event_feed_spec(stale_after_s=2.0)
+        SLOSpec(
+            name="p99", kind="threshold", objective=0.9,
+            series="lat.gateway_event_to_placement.p99_ms", threshold=100.0,
+        )
+
+
+def test_event_feed_alert_holds_forever_without_stale_after():
+    """The PR 13 gotcha, pinned as-is: once the window slides past the
+    last point, a threshold spec with no horizon holds its open alert
+    at every later evaluation — known behavior the fix exists for."""
+    tl = _stale_feed_timeline()
+    engine = SLOEngine(_event_feed_spec(suppress_warning=True), tl)
+    assert [e["state"] for e in engine.evaluate(now=2.0)] == ["open"]
+    for now in (6.0, 10.0, 100.0):
+        assert engine.evaluate(now=now) == []
+    assert len(engine.firing()) == 1  # still firing, forever
+
+
+def test_event_feed_alert_opens_then_closes_with_stale_after():
+    """With stale_after_s the same stale timeline transitions to
+    KNOWN-idle once the feed's newest point ages out: error ratio 0.0,
+    hysteresis runs, the alert CLOSES."""
+    tl = _stale_feed_timeline()
+    engine = SLOEngine(_event_feed_spec(stale_after_s=2.0), tl)
+    assert [e["state"] for e in engine.evaluate(now=2.0)] == ["open"]
+    # Window empty but the feed is not yet stale (6.0 - 5.0 < 2.0):
+    # insufficient data still HOLDS — a brief lull must not close.
+    assert engine.evaluate(now=6.5) == []
+    assert len(engine.firing()) == 1
+    # Past the horizon: known-idle, ratio 0.0, alert closes.
+    assert [e["state"] for e in engine.evaluate(now=8.0)] == ["close"]
+    assert engine.firing() == []
+    # A series that never recorded is missing data, never known-idle.
+    empty = Timeline()
+    spec = _event_feed_spec(stale_after_s=2.0).slos[0]
+    assert spec.error_ratio(empty, 1.0, now=10.0) is None

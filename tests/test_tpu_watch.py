@@ -1,0 +1,110 @@
+"""tools/tpu_watch.py: structured probe attempts + the --json surface.
+
+ROADMAP item 6's watcher had zero test coverage; these pin the parts a
+wedged-tunnel post-mortem depends on: the probe child's phase trail
+(WHERE init died), the compile-ledger counters riding the first-dispatch
+phase, and the machine-readable --json output including the bench-shaped
+``tpu_error`` block. All probe subprocesses are monkeypatched — no test
+here may touch a backend (that wedging is the whole point).
+"""
+
+from __future__ import annotations
+
+import json
+
+import bench
+import tools.tpu_watch as tw
+
+_LIVE_STDOUT = (
+    "DPERF_PHASE interp\n"
+    "DPERF_PHASE jax_import\n"
+    "DPERF_PHASE backend_init\n"
+    'DPERF_PHASE first_dispatch {"compiles": 1, "unattributed_compiles": 1}\n'
+    "DPERF_PROBE tpu 4\n"
+)
+
+
+def test_parse_probe_phases_trail_and_ledger():
+    phases = bench.parse_probe_phases(_LIVE_STDOUT)
+    assert [p["phase"] for p in phases] == [
+        "interp", "jax_import", "backend_init", "first_dispatch"
+    ]
+    assert phases[-1]["ledger"]["compiles"] == 1
+    # Library chatter and the platform sentinel never parse as phases.
+    assert bench.parse_probe_phases("hello\nDPERF_PROBE cpu 1\n") == []
+
+
+def test_probe_attempt_live(monkeypatch):
+    monkeypatch.setattr(
+        bench, "_run_probe_once", lambda t: (0, _LIVE_STDOUT, "")
+    )
+    platform, rec = tw.probe_attempt(5.0, attempt=3)
+    assert platform == "tpu"
+    assert rec["outcome"] == "ok" and rec["platform"] == "tpu"
+    assert rec["attempt"] == 3
+    assert rec["phases"][-1] == "first_dispatch"
+    assert rec["ledger"]["compiles"] == 1
+
+
+def test_probe_attempt_timeout_records_wedge_point(monkeypatch):
+    # A killed-at-timeout child left a partial trail: the wedge is at
+    # backend init — the axon-tunnel class, not an environment problem.
+    partial = "DPERF_PHASE interp\nDPERF_PHASE jax_import\n"
+    monkeypatch.setattr(
+        bench, "_run_probe_once", lambda t: (None, partial, "")
+    )
+    platform, rec = tw.probe_attempt(5.0)
+    assert platform is None
+    assert rec["outcome"] == "timeout"
+    assert rec["wedged_after"] == "jax_import"
+    # No output at all = never got past spawn.
+    monkeypatch.setattr(bench, "_run_probe_once", lambda t: (None, "", ""))
+    _, rec = tw.probe_attempt(5.0)
+    assert rec["wedged_after"] == "spawn"
+
+
+def _isolate_captures(monkeypatch, tmp_path):
+    """Keep the watcher's restart-safe artifact commits OUT of tests: a
+    checkout with a captured BENCH_tpu_capture.json must never have a
+    unit test run `git commit` on it."""
+    monkeypatch.setattr(tw, "_commit", lambda paths, msg: False)
+    monkeypatch.setattr(tw, "BENCH_OUT", tmp_path / "BENCH_tpu_capture.json")
+    monkeypatch.setattr(tw, "FIXDIR", tmp_path / "tpu_v5e")
+
+
+def test_json_once_smoke_cpu_backend(monkeypatch, capsys, tmp_path):
+    _isolate_captures(monkeypatch, tmp_path)
+    cpu = "DPERF_PHASE interp\nDPERF_PROBE cpu 1\n"
+    monkeypatch.setattr(bench, "_run_probe_once", lambda t: (0, cpu, ""))
+    rc = tw.main(["--once", "--json", "--probe-timeout", "1"])
+    out = capsys.readouterr()
+    payload = json.loads(out.out)  # stdout is EXACTLY one JSON object
+    assert rc == 2 and payload["exit"] == 2
+    assert len(payload["attempts"]) == 1
+    assert payload["attempts"][0]["outcome"] == "ok"
+    assert payload["bench_captured"] is False
+    # A cpu-only probe is not a live window: the bench-shaped error
+    # block must say so, not be silently absent.
+    assert "cpu fallback" in payload["tpu_error"]["error"]
+    # Human log moved to stderr in --json mode.
+    assert "probe #1" in out.err
+
+
+def test_json_wedged_emits_bench_shaped_tpu_error(monkeypatch, capsys, tmp_path):
+    _isolate_captures(monkeypatch, tmp_path)
+    partial = (
+        "DPERF_PHASE interp\nDPERF_PHASE jax_import\n"
+        "DPERF_PHASE backend_init\n"
+    )
+    monkeypatch.setattr(
+        bench, "_run_probe_once", lambda t: (None, partial, "")
+    )
+    rc = tw.main(["--once", "--json", "--probe-timeout", "1"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    err = payload["tpu_error"]
+    # The bench block's vocabulary: error text naming the wedge point,
+    # retries, and the full attempt trail.
+    assert "backend_init" in err["error"]
+    assert err["retries"] == 1
+    assert err["attempts"][0]["wedged_after"] == "backend_init"

@@ -59,6 +59,9 @@ HISTORY_KEYS = (
     "cold_process_ms",
     "cold_process_cached_ms",
     "fleet_scale_certified_m_max",
+    "compile_warm_phase_count",
+    "compile_cache_hit_rate",
+    "compile_overhead_pct",
 )
 
 

@@ -972,6 +972,153 @@ class BlockingCallInAsyncGateway(Rule):
 
 
 @register
+class UnregisteredJitEntryPoint(Rule):
+    code = "DLP020"
+    name = "unregistered-jit"
+    rationale = (
+        "Every `jax.jit` call site in the solver/serving layers must be "
+        "MODULE-LEVEL and registered with the compile ledger's entry-point "
+        "registry (`X = instrument(\"name\", jax.jit(impl, "
+        "static_argnames=S), S)` — obs/compile_ledger.py): an inline jit "
+        "inside a function or loop body mints a fresh executable per call "
+        "— the exact recompile storm the ledger exists to catch — and an "
+        "unregistered one compiles as '(unregistered)', invisible to the "
+        "per-entry-point attribution, the cause taxonomy and the "
+        "zero-recompile warm-serving gate. The one sanctioned "
+        "function-scope shape is a lazily-built module-global kernel "
+        "cache (twin/engine.py builds under a lock because jax must not "
+        "import at module scope there), which carries a justified "
+        "`# dlint: disable=DLP020`."
+    )
+
+    _PATH_PREFIXES = (
+        "distilp_tpu/sched/",
+        "distilp_tpu/gateway/",
+        "distilp_tpu/solver/",
+        "distilp_tpu/ops/",
+        "distilp_tpu/twin/",
+    )
+
+    @staticmethod
+    def _is_jit_name(node: ast.AST) -> bool:
+        """A Name/Attribute that denotes jax.jit (jit / jax.jit)."""
+        fn = dotted_name(node)
+        tail = fn.split(".")[-1]
+        return tail == "jit" and (fn == "jit" or "jax" in fn)
+
+    def _jit_call(self, node: ast.Call) -> bool:
+        """True for `jax.jit(...)` and `partial(jax.jit, ...)` calls."""
+        if self._is_jit_name(node.func):
+            return True
+        fn = dotted_name(node.func)
+        if fn.split(".")[-1] == "partial" and node.args:
+            return self._is_jit_name(node.args[0])
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.is_test or not any(
+            ctx.relpath.startswith(p) for p in self._PATH_PREFIXES
+        ):
+            return
+        # The sanctioned registration form: instrument("name", <jit>, ...)
+        # — collect the node ids sitting in the wrapped-callable position.
+        registered_ids: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and dotted_name(node.func).split(".")[-1] == "instrument"
+                and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                registered_ids.add(id(node.args[1]))
+        # Walk with scope context: (inside a def?, inside a loop body?).
+        # Decorator Calls are flagged at their def and skipped by the
+        # general walk (one violation, one finding — a count=1 baseline
+        # entry must be able to absorb it).
+        yield from self._walk(
+            ctx, ctx.tree, registered_ids, set(), False, False
+        )
+
+    def _walk(
+        self, ctx, node, registered_ids, flagged, in_func, in_loop
+    ) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            child_in_func = in_func or isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+            child_in_loop = in_loop or isinstance(
+                child, (ast.For, ast.AsyncFor, ast.While)
+            )
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                for dec in child.decorator_list:
+                    if (
+                        isinstance(dec, ast.Call) and self._jit_call(dec)
+                    ) or (
+                        not isinstance(dec, ast.Call)
+                        and self._is_jit_name(dec)
+                    ):
+                        flagged.add(id(dec))
+                        yield Finding(
+                            ctx.relpath,
+                            dec.lineno,
+                            self.code,
+                            "jit-decorated def cannot register with the "
+                            "compile ledger; use the module-level "
+                            '`X = instrument("layer.name", jax.jit(impl, '
+                            "static_argnames=S), S)` idiom "
+                            "(obs/compile_ledger.py) so its compiles are "
+                            "attributed",
+                        )
+            if (
+                isinstance(child, ast.Call)
+                and id(child) not in flagged
+                and self._jit_call(child)
+            ):
+                if in_loop:
+                    yield Finding(
+                        ctx.relpath,
+                        child.lineno,
+                        self.code,
+                        "jax.jit inside a loop body mints a fresh "
+                        "executable per iteration — the recompile storm "
+                        "the compile ledger exists to catch; hoist it to "
+                        "module level and register it with instrument()",
+                    )
+                elif in_func:
+                    yield Finding(
+                        ctx.relpath,
+                        child.lineno,
+                        self.code,
+                        "jax.jit inside a function body mints a fresh "
+                        "executable per call; hoist it to module level "
+                        "and register it with instrument() — the one "
+                        "sanctioned exception is a lazily-built "
+                        "module-global kernel cache, which carries a "
+                        "justified `# dlint: disable=DLP020` "
+                        "(twin/engine.py)",
+                    )
+                elif id(child) not in registered_ids:
+                    yield Finding(
+                        ctx.relpath,
+                        child.lineno,
+                        self.code,
+                        "module-level jax.jit not registered with the "
+                        "compile ledger's entry-point registry; wrap it: "
+                        '`X = instrument("layer.name", jax.jit(impl, '
+                        "static_argnames=S), S)` (obs/compile_ledger.py) "
+                        "so its compiles are attributed instead of "
+                        "landing in '(unregistered)'",
+                    )
+            yield from self._walk(
+                ctx, child, registered_ids, flagged, child_in_func,
+                child_in_loop,
+            )
+
+
+@register
 class UnregisteredMetricName(Rule):
     code = "DLP019"
     name = "unregistered-metric-name"

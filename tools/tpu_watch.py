@@ -44,8 +44,14 @@ FIXDIR = REPO / "tests" / "profiles" / "tpu_v5e"
 BENCH_OUT = REPO / "BENCH_tpu_capture.json"
 
 
+_JSON_MODE = False
+
+
 def _log(msg: str) -> None:
-    print(f"[tpu_watch {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+    # In --json mode the log stream moves to stderr so stdout carries
+    # exactly one machine-readable object.
+    out = sys.stderr if _JSON_MODE else sys.stdout
+    print(f"[tpu_watch {time.strftime('%H:%M:%S')}] {msg}", file=out, flush=True)
 
 
 def _run(cmd: list[str], timeout_s: float, env: dict | None = None) -> tuple[int | None, str, str]:
@@ -54,10 +60,39 @@ def _run(cmd: list[str], timeout_s: float, env: dict | None = None) -> tuple[int
     return bench.run_contained(cmd, timeout_s, env=env, cwd=str(REPO))
 
 
-def _probe_once(timeout_s: float) -> str | None:
-    """One live-backend probe; returns the platform string or None."""
-    rc, stdout, _ = bench._run_probe_once(timeout_s)
-    return bench.parse_probe_output(rc, stdout)
+def probe_attempt(timeout_s: float, attempt: int = 0) -> tuple[str | None, dict]:
+    """One live-backend probe; (platform-or-None, structured record).
+
+    The record is SHAPED LIKE bench.py's ``tpu_error.attempts`` entries
+    (outcome, elapsed, the probe child's phase trail and its compile
+    ledger counters), so a watcher log and a bench capture describe a
+    wedged init in the same vocabulary: ``wedged_after`` names the last
+    phase the killed child flushed (``backend_init`` = the axon-tunnel
+    wedge class; ``jax_import`` = environment, not tunnel), and
+    ``ledger`` says whether the backend ever compiled anything.
+    """
+    t0 = time.monotonic()
+    rc, stdout, _stderr = bench._run_probe_once(timeout_s)
+    rec: dict = {
+        "attempt": attempt,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+    }
+    phases = bench.parse_probe_phases(stdout)
+    if phases:
+        rec["phases"] = [p["phase"] for p in phases]
+        ledger = next((p["ledger"] for p in phases if "ledger" in p), None)
+        if ledger is not None:
+            rec["ledger"] = ledger
+    platform = bench.parse_probe_output(rc, stdout)
+    if rc is None:
+        rec["outcome"] = "timeout"
+        rec["wedged_after"] = phases[-1]["phase"] if phases else "spawn"
+    elif platform is None:
+        rec["outcome"] = f"failed rc={rc}"
+    else:
+        rec["outcome"] = "ok"
+        rec["platform"] = platform
+    return platform, rec
 
 
 def _capture_bench(timeout_s: float) -> bool:
@@ -187,7 +222,59 @@ def main(argv=None) -> int:
                     help="give up after this long (default 11h)")
     ap.add_argument("--once", action="store_true",
                     help="single probe+capture attempt, then exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit ONE machine-readable JSON object on stdout "
+                    "at exit (probe attempts with phase trails + compile-"
+                    "ledger counters, capture status, and — when no live "
+                    "window ever opened — a bench-shaped tpu_error block); "
+                    "human logs move to stderr")
     args = ap.parse_args(argv)
+    global _JSON_MODE
+    _JSON_MODE = bool(args.json)
+    attempts: list[dict] = []
+
+    def _finish(rc: int, have_bench: bool, have_fixtures: bool) -> int:
+        if args.json:
+            payload: dict = {
+                "exit": rc,
+                "attempts": attempts,
+                "bench_captured": have_bench,
+                "fixtures_captured": have_fixtures,
+            }
+            live = any(
+                a.get("platform") and not a["platform"].startswith("cpu")
+                for a in attempts
+            )
+            if not live:
+                # Fold the trail into the bench's structured tpu_error
+                # shape: a watcher that never saw a live TPU window
+                # reports the same block a fallback bench capture would.
+                # A cpu-only probe is NOT a live window (the watcher
+                # never captures on it), so it gets the block too.
+                last = attempts[-1] if attempts else {}
+                if last.get("outcome") == "timeout":
+                    error = (
+                        "probe timed out (backend init wedged after "
+                        f"{last.get('wedged_after', 'spawn')})"
+                    )
+                elif last.get("platform", "").startswith("cpu"):
+                    error = (
+                        "probe found only the cpu fallback "
+                        "(no live TPU window)"
+                    )
+                else:
+                    error = (
+                        "probe never found a live backend "
+                        f"({last.get('outcome', 'no attempt')})"
+                    )
+                payload["tpu_error"] = {
+                    "error": error,
+                    "timeout_s": args.probe_timeout,
+                    "retries": len(attempts),
+                    "attempts": attempts,
+                }
+            print(json.dumps(payload))
+        return rc
 
     deadline = time.monotonic() + args.max_hours * 3600.0
     # Restart-safe: a relaunched watcher must not burn a live window redoing
@@ -208,10 +295,16 @@ def main(argv=None) -> int:
     attempt = 0
     while time.monotonic() < deadline:
         attempt += 1
-        platform = _probe_once(args.probe_timeout)
+        platform, rec = probe_attempt(args.probe_timeout, attempt=attempt)
+        attempts.append(rec)
         if platform is None or platform.startswith("cpu"):
-            _log(f"probe #{attempt}: backend={platform or 'wedged/down'}; "
-                 f"sleeping {args.interval:.0f}s")
+            where = (
+                f" (wedged after {rec['wedged_after']})"
+                if rec.get("outcome") == "timeout"
+                else ""
+            )
+            _log(f"probe #{attempt}: backend={platform or 'wedged/down'}"
+                 f"{where}; sleeping {args.interval:.0f}s")
         else:
             _log(f"probe #{attempt}: LIVE backend platform={platform!r} — capturing")
             if not have_bench and _capture_bench(args.bench_timeout):
@@ -224,12 +317,15 @@ def main(argv=None) -> int:
                     "Capture measured tpu_v5e device fixtures on live TPU")
             if have_bench and have_fixtures:
                 _log("all captures committed; done")
-                return 0
+                return _finish(0, have_bench, have_fixtures)
         if args.once:
-            return 0 if (have_bench and have_fixtures) else 2
+            return _finish(
+                0 if (have_bench and have_fixtures) else 2,
+                have_bench, have_fixtures,
+            )
         time.sleep(args.interval)
     _log("deadline reached without a full capture")
-    return 3
+    return _finish(3, have_bench, have_fixtures)
 
 
 if __name__ == "__main__":
