@@ -18,7 +18,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory
 	python -m pytest tests/ -q
 
 # `make bench` also appends the run's headline keys as one line of
@@ -299,6 +299,45 @@ smoke-compile: lint-strict
 		assert set(compiled) <= set(c['registered']), compiled; \
 		print('smoke-compile OK: %d cold compile(s) across %s; warm phase 0; ledger byte-stable' \
 			% (c['counters']['compiles'], ', '.join(compiled)))"; \
+	rc=$$?; rm -rf $$D; exit $$rc
+
+# Memory-ledger smoke: the bundled 10-fleet gateway trace (drift-only,
+# so steady-state serving is pure warm path) replayed with the memory
+# ledger on (serve --memory-out). The contract: (1) at least one
+# registered entry point got a static memory model from the AOT XLA
+# memory_analysis pass (graceful None is for backends that don't report
+# — the CPU backend does); (2) the leak gate was marked at the warm
+# boundary and live-array bytes stayed FLAT through the steady-state
+# warm phase (the zero-leak invariant the bench gates absolutely as
+# memory_leak_bytes); (3) no watermark sample failed; (4) the dumped
+# ledger JSONL round-trips byte-stably and `solver memory` renders
+# byte-identical reports on repeated replays of the same dump.
+.PHONY: smoke-memory
+smoke-memory: lint-strict
+	@D=$$(mktemp -d) && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+		--trace tests/traces/gateway_smoke_10f.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 2 --k-candidates 8,10 --quiet \
+		--memory-out $$D/memory.jsonl --metrics-out $$D/m.json \
+		> /dev/null && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli memory \
+		--load $$D/memory.jsonl --check > $$D/report1.txt && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli memory \
+		--load $$D/memory.jsonl --check > $$D/report2.txt && \
+	cmp -s $$D/report1.txt $$D/report2.txt && \
+	JAX_PLATFORMS=cpu python -c "import json; \
+		m = json.load(open('$$D/m.json'))['memory']; \
+		leak = m['leak']; \
+		assert leak is not None, 'leak gate never marked'; \
+		assert leak['flat'], ('warm phase grew live-array bytes', leak); \
+		assert m['watermarks']['samples'] > 0, 'no watermark samples'; \
+		assert m['watermarks']['sample_errors'] == 0, 'watermark sample failed'; \
+		analyzed = [n for n, e in m['entries'].items() if e.get('memory')]; \
+		assert analyzed, 'no entry point got a static memory model'; \
+		print('smoke-memory OK: %d entry model(s) (%s), leak gate FLAT (%+d B), peak live %.2f MB' \
+			% (len(analyzed), ', '.join(analyzed), leak['growth_bytes'], \
+			   m['watermarks']['peak_live_bytes'] / 1e6))"; \
 	rc=$$?; rm -rf $$D; exit $$rc
 
 .PHONY: smoke-sched

@@ -319,6 +319,7 @@ _COMPARE_LOWER_BETTER = (
     "conv_pdhg_restarts", "conv_overhead_pct",
     "slo_overhead_pct",
     "compile_overhead_pct", "compile_warm_phase_count",
+    "memory_overhead_pct", "memory_leak_bytes",
 )
 # Instrumentation cost ceiling: tracing + Prometheus exposition may never
 # cost more than this fraction of the loadgen arm's events/sec. Checked
@@ -336,6 +337,9 @@ _SLO_OVERHEAD_MAX_PCT = 5.0
 # And for the compile ledger: dispatch counting + signature hashing on
 # every instrumented entry-point call — same absolute ceiling.
 _COMPILE_OVERHEAD_MAX_PCT = 5.0
+# And for the memory ledger: per-dispatch hook + throttled live-array/RSS
+# watermark sampling — same absolute ceiling.
+_MEM_OVERHEAD_MAX_PCT = 5.0
 _COMPARE_HIGHER_BETTER = (
     "vs_baseline", "placements_per_sec", "pipelined_placements_per_sec",
     "scenario_batch_placements_per_sec", "scheduler_events_per_sec",
@@ -461,6 +465,32 @@ def _compare_against(payload: dict, against: str) -> int:
             f"compile_warm_phase_count {warm_compiles:g} != 0 (the warm "
             "serving phase paid an XLA compile — see the compile "
             "section's warm_phase_entries for the offending entry points)"
+        )
+    mem_pct = payload.get("memory_overhead_pct")
+    if isinstance(mem_pct, (int, float)) and mem_pct > _MEM_OVERHEAD_MAX_PCT:
+        failures.append(
+            f"memory_overhead_pct {mem_pct:.1f} > {_MEM_OVERHEAD_MAX_PCT:g} "
+            "(memory-ledger watermark/analysis cost ceiling on the "
+            "ledgered arm)"
+        )
+    # The zero-leak warm-serving gate, absolute like its compile twin:
+    # net live-array growth across >= 100 warm ticks on EITHER engine is
+    # a leak regardless of the reference (warm ticks allocate nothing
+    # persistent; growth compounds into an OOM at fleet scale).
+    leak = payload.get("memory_leak_bytes")
+    if isinstance(leak, (int, float)) and leak > 0:
+        failures.append(
+            f"memory_leak_bytes {leak:g} > 0 (the warm serving phase "
+            "pinned live jax arrays — see the memory section's per-"
+            "engine leak reports for which engine and how much per tick)"
+        )
+    if payload.get("mem_calibration_ok") is False:
+        failures.append(
+            "mem_calibration_ok is false (the ops/memmodel analytic "
+            "proxy fell outside its measured calibration band vs XLA "
+            "memory_analysis temp bytes — fleet_scale's skip decisions "
+            "can no longer trust it; see the memory section's "
+            "calibration block)"
         )
     # SLO absolute contracts (checked on the new capture, never relative):
     # the committed overload capture must fire AND clear the expected
@@ -871,6 +901,19 @@ def main(against: str | None = None, history: str | None = None) -> int:
         payload.update(_compile_bench(model))
     except Exception as e:  # pragma: no cover - defensive bench path
         payload["compile_error"] = f"{type(e).__name__}: {e}"
+
+    # Memory ledger (distilp_tpu.obs.memory): the last unobserved axis.
+    # Three contracts, all absolute in `--against`: (1) ledger overhead
+    # on the interleaved loadgen arm <= 5% like every obs ceiling; (2)
+    # the zero-leak warm gate — live-array bytes FLAT across >= 100 warm
+    # ticks on BOTH LP engines; (3) the analytic memory model
+    # (ops/memmodel.py, the proxies fleet_scale skips arms on) calibrated
+    # against XLA's measured memory_analysis temp bytes at two M sizes.
+    # A failure costs only these keys.
+    try:
+        payload.update(_memory_bench(model))
+    except Exception as e:  # pragma: no cover - defensive bench path
+        payload["memory_error"] = f"{type(e).__name__}: {e}"
 
     # Restart cost (VERDICT r5 item 3): fresh-process first-solve wall
     # clock, uncached vs against the env-gated persistent compilation
@@ -1775,6 +1818,175 @@ def _compile_bench(model) -> dict:
     return out
 
 
+def _memory_bench(model) -> dict:
+    """memory section: ledger overhead, the zero-leak warm gate, and the
+    analytic-model calibration.
+
+    (1) The 10-fleet loadgen arm re-runs ledger-ON vs ledger-OFF,
+    interleaved (ON FIRST so the once-per-entry AOT analyses land in a
+    ledgered arm's warmup): ``memory_overhead_pct`` is the events/sec
+    cost of dispatch counting + throttled watermark sampling, gated
+    <= 5% absolute like the other obs ceilings. (2) The headline gate:
+    a dedicated scheduler per LP engine runs >= 100 steady-state warm
+    drift ticks with the ledger live — live-array bytes must show ZERO
+    net growth (``mem_leak_bytes_<engine>``, absolute in ``--against``;
+    a warm tick that pins arrays is tomorrow's OOM). (3) Calibration:
+    ``halda_solve`` at two M sizes per engine, each under a FRESH ledger
+    so ``solver._solve_packed`` re-analyzes at that size — the measured
+    XLA temp bytes over the ops/memmodel analytic proxy is the
+    calibration ratio. The proxy models the dominant working-set term,
+    so the ratio is a constant-factor > 1 that must sit inside a sanity
+    band AND be STABLE across M (ratio_large/ratio_small near 1): a
+    proxy that scales wrongly with M would steer fleet_scale's skip
+    decisions (and ROADMAP item 3's per-shard sizing) off a cliff.
+    Measured this box: ipm ratio ~7-8, pdhg ~58-68, scaling 0.85-0.88.
+    """
+    from distilp_tpu.gateway.loadgen import run_loadgen
+    from distilp_tpu.obs import memory as obs_memory
+    from distilp_tpu.ops import memmodel
+    from distilp_tpu.sched import Scheduler
+    from distilp_tpu.sched.sim import generate_trace
+    from distilp_tpu.solver import halda_solve
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    n_fleets = int(_env_num("DPERF_MEM_FLEETS", 10))
+    n_workers = int(_env_num("DPERF_MEM_WORKERS", 2))
+    events = int(_env_num("DPERF_MEM_EVENTS", 40))
+    repeats = max(1, int(_env_num("DPERF_MEM_REPEATS", 2)))
+    leak_ticks = max(100, int(_env_num("DPERF_MEM_LEAK_TICKS", 110)))
+    cal_ms = [
+        int(x)
+        for x in os.environ.get("DPERF_MEM_MS", "16,48").split(",")
+        if x.strip()
+    ][:2]
+
+    # -- (1) overhead, interleaved ----------------------------------------
+    def arm(mem_on: bool) -> dict:
+        return run_loadgen(
+            model,
+            n_fleets=n_fleets,
+            n_workers=n_workers,
+            events_per_fleet=events,
+            fleet_size=int(_env_num("DPERF_GATEWAY_M", 3)),
+            seed=0,
+            k_candidates=[8, 10],
+            mip_gap=MIP_GAP,
+            memory_ledger=mem_on,
+        )
+
+    runs = {"off": [], "on": []}
+    for _ in range(repeats):
+        runs["on"].append(arm(True))
+        runs["off"].append(arm(False))
+    med_off = statistics.median(r["events_per_sec"] for r in runs["off"])
+    med_on = statistics.median(r["events_per_sec"] for r in runs["on"])
+    overhead = (med_off - med_on) / med_off * 100.0 if med_off > 0 else 0.0
+    arm_leaks = [
+        (r["mem"]["leak"] or {}).get("growth_bytes") for r in runs["on"]
+    ]
+    out: dict = {
+        "memory": {
+            "fleets": n_fleets,
+            "workers": n_workers,
+            "events_per_fleet": events,
+            "repeats": repeats,
+            "events_per_sec_off": [r["events_per_sec"] for r in runs["off"]],
+            "events_per_sec_on": [r["events_per_sec"] for r in runs["on"]],
+            "loadgen_leak_bytes_per_arm": arm_leaks,
+            "entries_analyzed_first_arm": runs["on"][0]["mem"][
+                "entries_analyzed"
+            ],
+            "watermarks_first_arm": runs["on"][0]["mem"]["watermarks"],
+        },
+        "memory_overhead_pct": round(max(0.0, overhead), 2),
+        "memory_overhead_pct_raw": round(overhead, 2),
+    }
+
+    # -- (2) the zero-leak warm gate, per engine ---------------------------
+    leak_max = None
+    for engine in ("ipm", "pdhg"):
+        fleet = make_synthetic_fleet(4, seed=11)
+        trace = generate_trace(
+            "drift", leak_ticks + 5, seed=5, base_fleet=fleet
+        )
+        led = obs_memory.enable(obs_memory.MemoryLedger())
+        try:
+            sched = Scheduler(
+                fleet, model, mip_gap=MIP_GAP, kv_bits="4bit",
+                backend="jax", k_candidates=[8, 10], lp_backend=engine,
+                speculative=True,
+            )
+            for ev in trace[:5]:  # cold + warm layouts + scenario batch
+                sched.handle(ev)
+            led.mark_warm()
+            for ev in trace[5:]:
+                sched.handle(ev)
+            led.sample(force=True)
+            leak = led.leak_report()
+            sched.close()
+        finally:
+            obs_memory.disable()
+        growth = leak["growth_bytes"] if leak else None
+        out[f"mem_leak_bytes_{engine}"] = growth
+        out["memory"][f"leak_{engine}"] = leak
+        if growth is not None:
+            leak_max = growth if leak_max is None else max(leak_max, growth)
+    # THE gate: steady-state warm serving pins nothing (both engines).
+    out["memory_leak_bytes"] = leak_max
+
+    # -- (3) analytic-model calibration ------------------------------------
+    cal: dict = {"entry": "solver._solve_packed", "sizes": {}}
+    ratios: dict = {}
+    ok = True
+    for M in cal_ms:
+        row: dict = {}
+        for engine in ("ipm", "pdhg"):
+            led = obs_memory.enable(obs_memory.MemoryLedger())
+            try:
+                halda_solve(
+                    make_synthetic_fleet(M, seed=123), model,
+                    mip_gap=MIP_GAP, kv_bits="4bit", backend="jax",
+                    lp_backend=engine,
+                )
+                rec = led.analyses.get("solver._solve_packed") or {}
+                mem = rec.get("memory") or {}
+                temp = mem.get("temp_bytes")
+            finally:
+                obs_memory.disable()
+            proxy = memmodel.peak_bytes(M, engine)
+            ratio = round(temp / proxy, 3) if temp else None
+            row[engine] = {
+                "measured_temp_bytes": temp,
+                "analytic_proxy_bytes": proxy,
+                "ratio": ratio,
+                "flops": rec.get("flops"),
+            }
+            ratios.setdefault(engine, []).append(ratio)
+        cal["sizes"][str(M)] = row
+    for engine, rs in ratios.items():
+        rs = [r for r in rs if r is not None]
+        if len(rs) < 2:
+            # A backend that reports no memory stats cannot calibrate —
+            # record the absence, do not fabricate a verdict.
+            ok = None if ok is True else ok
+            continue
+        out[f"mem_calibration_ratio_{engine}"] = rs[-1]
+        scaling = round(rs[-1] / rs[0], 3) if rs[0] else None
+        cal[f"scaling_{engine}"] = scaling
+        # Sanity band: the proxy is the dominant-term model, so measured
+        # temp must sit ABOVE it but within two orders; and the ratio
+        # must be stable across M (the proxy's scaling law is the part
+        # fleet_scale's skip decision actually leans on).
+        if not (1.0 <= rs[-1] <= 100.0) or scaling is None or not (
+            0.25 <= scaling <= 4.0
+        ):
+            ok = False
+    cal["ms"] = cal_ms
+    out["memory"]["calibration"] = cal
+    out["mem_calibration_ok"] = ok
+    return out
+
+
 _COLD_PROCESS_SRC = r"""
 import json, time
 t0 = time.perf_counter()
@@ -1950,7 +2162,11 @@ def _fleet_scale_bench() -> dict:
     per_timeout = max(120.0, _env_num("DPERF_FLEET_TIMEOUT", 3600))
     budget_s = max(per_timeout, _env_num("DPERF_FLEET_BUDGET", 4200))
     mem_cap_gb = _env_num("DPERF_FLEET_IPM_MEM_GB", 8.0)
-    beam = 6  # dense default_search_params beam — the IPM's LP batch size
+    # The per-(M, engine) peak formulas moved to ops/memmodel.py (PR 15):
+    # ONE copy shared with the bench memory section's calibration gate and
+    # the `solver memory` report; fleet_scale behavior unchanged (pinned
+    # by the memmodel parity test in tests/test_memory.py).
+    from distilp_tpu.ops import memmodel
 
     def _run_arm(
         M: int, engine: str, timeout_s: float, conv: bool = False
@@ -1989,12 +2205,12 @@ def _fleet_scale_bench() -> dict:
     ipm_lost = False  # first IPM loss settles every larger M
     out: dict = {}
     for M in ms_list:
-        # Dense HALDA standard form: m = 6M+3 rows (w/n/y blocks + cycle/
-        # memory/prefetch + couplers), n_cols ~ 3M. The proxies are the
-        # per-iteration working sets the engines cannot avoid.
-        m_rows = 6 * M + 3
-        ipm_gb = beam * m_rows * m_rows * 4 / 1e9
-        pdhg_gb = m_rows * 3 * M * 4 / 1e9
+        # Dense HALDA standard form (ops/memmodel.py): m = 6M+3 rows,
+        # n_cols ~ 3M. The proxies are the per-iteration working sets the
+        # engines cannot avoid — analytic, and calibrated against XLA's
+        # measured temp bytes by the bench `memory` section.
+        ipm_gb = memmodel.peak_gb(M, "ipm")
+        pdhg_gb = memmodel.peak_gb(M, "pdhg")
         row: dict = {
             "ipm_mem_proxy_gb": round(ipm_gb, 2),
             "pdhg_mem_proxy_gb": round(pdhg_gb, 3),
@@ -2030,13 +2246,9 @@ def _fleet_scale_bench() -> dict:
         # 1.5x PDHG's wall clock to prove itself: if it is still running
         # past that, it has lost the comparison by definition — which is
         # an answer, not a measurement failure.
-        if ipm_gb > mem_cap_gb:
-            row["ipm"] = {
-                "status": (
-                    f"memory-infeasible (~{ipm_gb:.1f} GB batched "
-                    f"normal matrices > {mem_cap_gb:g} GB cap)"
-                )
-            }
+        infeasible = memmodel.ipm_memory_infeasible(M, mem_cap_gb)
+        if infeasible is not None:
+            row["ipm"] = {"status": infeasible}
         elif ipm_lost:
             row["ipm"] = {
                 "status": "skipped (crossover settled at smaller M)"

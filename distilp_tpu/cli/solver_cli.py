@@ -497,7 +497,50 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "compile.warm_phase_compiles counts compile events past it — the "
         "zero-recompile warm-serving invariant `make smoke-compile` "
         "gates on (default 2: the cold solve and the first warm tick "
-        "each compile their own layout)",
+        "each compile their own layout); with --memory-ledger the same "
+        "boundary pins the leak-gate baseline (warm serving must stay "
+        "FLAT in live-array bytes from there on)",
+    )
+    # Memory ledger (obs.memory; README "Memory observability"). Default
+    # off — serving without it is byte-identical to the unledgered
+    # daemon (the entry-point dispatch hook is one dormant module-global
+    # read).
+    p.add_argument(
+        "--memory-ledger",
+        action="store_true",
+        help="enable the process-wide memory ledger: every registered "
+        "jit entry point gets a static memory model on first dispatch "
+        "(AOT XLA memory_analysis: temp/argument/output bytes + FLOPs), "
+        "ticks carry live-array/RSS watermark attrs on their spans and "
+        "flight records, mem.* series ride the metrics timeline, "
+        "GET /signals grows mem_headroom_bytes, and the summary grows a "
+        "'memory' block (render it with `solver memory`)",
+    )
+    p.add_argument(
+        "--memory-out",
+        default=None,
+        metavar="FILE",
+        help="dump the memory ledger as JSONL at exit (implies "
+        "--memory-ledger); reload with `solver memory --load`",
+    )
+    p.add_argument(
+        "--memory-budget-mb",
+        type=float,
+        default=None,
+        help="memory-headroom budget in MB (default: /proc/meminfo "
+        "MemTotal): mem_headroom_bytes in GET /signals = budget - RSS, "
+        "and --mem-degrade-headroom-mb degrades against it",
+    )
+    p.add_argument(
+        "--mem-degrade-headroom-mb",
+        type=float,
+        default=None,
+        metavar="MB",
+        help="gateway admission: when memory headroom (budget - RSS) "
+        "drops below this many MB, ingest marks ticks under PRESSURE — "
+        "composing with --degrade-depth, so a memory-squeezed gateway "
+        "serves certified near-matches (mode='spec_near') instead of "
+        "queueing fresh allocations; needs --memory-ledger",
     )
     return p
 
@@ -793,6 +836,54 @@ def _compile_summary(args, led, warm_token) -> dict:
     return out
 
 
+def _build_memory_ledger(args):
+    """(ledger, owned) from the serve memory flags; (None, False) on the
+    byte-identical default path. Same ownership contract as the compile
+    ledger: a serve-OWNED ledger is disable()d in the finally so
+    in-process callers never inherit the process-global hook."""
+    if not (args.memory_ledger or args.memory_out):
+        return None, False
+    from ..obs import memory
+
+    existing = memory.current()
+    if existing is not None:
+        if args.memory_budget_mb is not None:
+            # The explicit flag wins over whatever budget the reused
+            # process ledger resolved: silently ignoring it would leave
+            # --mem-degrade-headroom-mb degrading against MemTotal and
+            # never firing, with nothing saying why.
+            existing.budget_bytes = int(args.memory_budget_mb * 1e6)
+        return existing, False
+    kwargs = {}
+    if args.memory_budget_mb is not None:
+        kwargs["budget_bytes"] = int(args.memory_budget_mb * 1e6)
+    return memory.enable(**kwargs), True
+
+
+def _release_memory_ledger(owned: bool) -> None:
+    if owned:
+        from ..obs import memory
+
+        memory.disable()
+
+
+def _memory_summary(args, mled) -> dict:
+    """The serve summary's "memory" block (+ the JSONL dump side effect):
+    per-entry static models, watermarks, and the leak-gate verdict
+    (marked at the --compile-warm-events boundary)."""
+    # One forced sample first: the replay is drained here, and the leak
+    # verdict must compare the baseline against the run's TRUE final
+    # live bytes — a final tick that allocated inside the throttle
+    # window would otherwise be judged on a stale cached sample (the
+    # same hazard loadgen/openloop force-sample against).
+    mled.sample(force=True)
+    out = mled.summary()
+    if args.memory_out:
+        mled.dump_jsonl(args.memory_out)
+        out["ledger_path"] = str(args.memory_out)
+    return out
+
+
 def _build_slo(args, metrics, sample_fn, tracer, flight):
     """(timeline, engine, sampler) from the serve SLO flags, all None
     when neither --slo nor --timeline-dir is set (the byte-identical
@@ -861,7 +952,19 @@ def serve_main(argv=None) -> int:
         or args.max_queue_depth is not None
         or args.coalesce
         or args.degrade_depth is not None
+        or args.mem_degrade_headroom_mb is not None
     )
+    if args.mem_degrade_headroom_mb is not None and not (
+        args.memory_ledger or args.memory_out
+    ):
+        # Degrading on headroom nobody measures would silently never
+        # fire; make the dependency explicit instead.
+        print(
+            "error: --mem-degrade-headroom-mb needs --memory-ledger "
+            "(headroom comes from the memory ledger's budget - RSS)",
+            file=sys.stderr,
+        )
+        return 2
     if not gateway_mode and Path(args.trace).is_file():
         from ..gateway.traces import is_gateway_trace
 
@@ -991,16 +1094,25 @@ def serve_main(argv=None) -> int:
         args, sched.metrics, sched.timeline_sample, tracer, flight
     )
     led, led_owned = _build_compile_ledger(args)
-    compile_state = {"handled": 0, "warm_token": None}
+    mled, mled_owned = _build_memory_ledger(args)
+    compile_state = {"handled": 0, "warm_token": None, "warm_marked": False}
 
     def on_event(ev, view, ms):
         log_event(ev, view, ms)
-        if led is not None and compile_state["warm_token"] is None:
-            compile_state["handled"] += 1
-            if compile_state["handled"] >= args.compile_warm_events:
-                # Warm boundary: everything this single fleet compiles,
-                # it compiles in its first --compile-warm-events ticks.
+        if (led is None and mled is None) or compile_state["warm_marked"]:
+            return
+        compile_state["handled"] += 1
+        if compile_state["handled"] >= args.compile_warm_events:
+            # Warm boundary: everything this single fleet compiles (and
+            # persistently allocates), it does in its first
+            # --compile-warm-events ticks — the same boundary marks the
+            # compile ledger's warm phase and pins the memory ledger's
+            # leak-gate baseline.
+            compile_state["warm_marked"] = True
+            if led is not None:
                 compile_state["warm_token"] = led.seq()
+            if mled is not None:
+                mled.mark_warm()
 
     chaos = None
     try:
@@ -1021,6 +1133,7 @@ def serve_main(argv=None) -> int:
         if tracer is not None:
             tracer.close()  # flush the span JSONL
         _release_compile_ledger(led_owned)
+        _release_memory_ledger(mled_owned)
 
     summary = {
         "replay": report.summary(),
@@ -1042,6 +1155,8 @@ def serve_main(argv=None) -> int:
         summary["compile"] = _compile_summary(
             args, led, compile_state["warm_token"]
         )
+    if mled is not None:
+        summary["memory"] = _memory_summary(args, mled)
     if sampler is not None:
         summary["slo"] = _slo_summary(args, timeline, slo_engine, sampler)
     if writer is not None or flight is not None:
@@ -1216,6 +1331,11 @@ def _serve_gateway(args) -> int:
         max_queue_depth=args.max_queue_depth,
         coalesce=args.coalesce,
         degrade_depth=args.degrade_depth,
+        mem_degrade_headroom_bytes=(
+            args.mem_degrade_headroom_mb * 1e6
+            if args.mem_degrade_headroom_mb is not None
+            else None
+        ),
     )
     timeline, slo_engine, sampler = _build_slo(
         args, gw.metrics, gw.timeline_sample, tracer, flight
@@ -1226,6 +1346,7 @@ def _serve_gateway(args) -> int:
         gw.attach_sampler(sampler)
         gw.attach_slo(slo_engine, timeline, capacity_eps=args.capacity_eps)
     led, led_owned = _build_compile_ledger(args)
+    mled, mled_owned = _build_memory_ledger(args)
     # Warm boundary for the ledger: marked once EVERY fleet actually
     # REPLAYED this run has handled --compile-warm-events events
     # (ordering-independent — the smoke trace interleaves fleets
@@ -1235,16 +1356,29 @@ def _serve_gateway(args) -> int:
     # knob) must not hold the boundary open forever, so each fleet's
     # target is min(knob, its replayed-event count). Compile events past
     # the mark are warm-phase compiles: the zero-recompile invariant.
-    compile_state = {"counts": {}, "targets": {}, "warm_token": None}
+    compile_state = {
+        "counts": {}, "targets": {}, "warm_token": None, "warm_marked": False,
+    }
 
     def _note_handled_for_ledger(fleet_id: str) -> None:
         targets = compile_state["targets"]
-        if led is None or compile_state["warm_token"] is not None or not targets:
+        if (
+            (led is None and mled is None)
+            or compile_state["warm_marked"]
+            or not targets
+        ):
             return
         counts = compile_state["counts"]
         counts[fleet_id] = counts.get(fleet_id, 0) + 1
         if all(counts.get(f, 0) >= n for f, n in targets.items()):
-            compile_state["warm_token"] = led.seq()
+            # One warm boundary for BOTH ledgers: compile events past it
+            # are warm-phase compiles, live-array growth past it is a
+            # leak.
+            compile_state["warm_marked"] = True
+            if led is not None:
+                compile_state["warm_token"] = led.seq()
+            if mled is not None:
+                mled.mark_warm()
 
     try:
         if args.resume:
@@ -1280,7 +1414,7 @@ def _serve_gateway(args) -> int:
         # covers (Gateway.uncovered owns the contract — quarantined
         # events advanced the cursor too and must not replay).
         run_items = gw.uncovered(items)
-        if led is not None:
+        if led is not None or mled is not None:
             totals: dict = {}
             for f, _ev in run_items:
                 totals[f] = totals.get(f, 0) + 1
@@ -1431,6 +1565,8 @@ def _serve_gateway(args) -> int:
             summary["compile"] = _compile_summary(
                 args, led, compile_state["warm_token"]
             )
+        if mled is not None:
+            summary["memory"] = _memory_summary(args, mled)
         if chaos is not None:
             summary["chaos"] = chaos.summary()
             if flight is not None and chaos.violations(
@@ -1486,6 +1622,7 @@ def _serve_gateway(args) -> int:
         if tracer is not None:
             tracer.close()  # flush the span JSONL
         _release_compile_ledger(led_owned)
+        _release_memory_ledger(mled_owned)
 
 
 def _listen_forever(gw, listen: str, quiet: bool = False) -> int:
@@ -2497,11 +2634,192 @@ def compiles_main(argv=None) -> int:
     return 0
 
 
+def build_memory_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solver memory",
+        description="render the memory ledger (obs.memory): per-entry "
+        "static memory models (AOT XLA memory_analysis temp/argument/"
+        "output bytes + FLOPs per dispatch), live-array/RSS watermarks, "
+        "and the warm-path leak-gate verdict — from a live run (--trace, "
+        "replayed through `solver serve` with the ledger on) or a dumped "
+        "JSONL (--load). Rendering a dump is a pure function: the same "
+        "dump produces byte-identical reports on every replay",
+    )
+    p.add_argument(
+        "--load", default=None, metavar="FILE",
+        help="render a ledger JSONL previously dumped by "
+        "`serve --memory-out` (or --out below); no backend needed",
+    )
+    p.add_argument(
+        "--trace", default=None,
+        help="live mode: replay this churn trace (single- or multi-fleet) "
+        "with the memory ledger enabled and render the resulting ledger",
+    )
+    p.add_argument(
+        "--profile", "-p", default=None,
+        help="profile folder (required with --trace)",
+    )
+    p.add_argument("--synthetic-fleet", type=int, default=0, metavar="M")
+    p.add_argument("--fleet-seed", type=int, default=0)
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--mip-gap", type=float, default=1e-3)
+    p.add_argument("--k-candidates", default=None)
+    p.add_argument(
+        "--lp-backend", choices=["ipm", "pdhg", "auto"], default="auto",
+        help="LP engine pin for the live replay (each engine's entry "
+        "points get their own static model)",
+    )
+    p.add_argument(
+        "--warm-events", type=int, default=2, metavar="N",
+        help="leak-gate baseline: marked once every replayed fleet has "
+        "handled N events (see `solver serve --compile-warm-events`)",
+    )
+    p.add_argument(
+        "--memory-budget-mb", type=float, default=None,
+        help="headroom budget for the live replay (default: MemTotal)",
+    )
+    p.add_argument(
+        "--out", default=None, metavar="FILE",
+        help="also save the live run's ledger JSONL here",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the ledger summary as one JSON object instead of text",
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="exit 1 unless the ledger is clean: the leak gate was "
+        "marked AND live-array bytes stayed flat across the warm phase, "
+        "no watermark sample failed, and the JSONL round-trips "
+        "byte-stably (the smoke-memory contract)",
+    )
+    return p
+
+
+def memory_main(argv=None) -> int:
+    """``solver memory``: render/check the memory ledger."""
+    args = build_memory_parser().parse_args(argv)
+
+    from ..obs.memory import (
+        memory_from_jsonl,
+        memory_to_jsonl,
+        render_report,
+    )
+
+    if bool(args.load) == bool(args.trace):
+        print(
+            "error: exactly one of --load or --trace is required",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.load:
+        try:
+            text = Path(args.load).read_text(encoding="utf-8")
+            dump = memory_from_jsonl(text)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load {args.load}: {e}", file=sys.stderr)
+            return 2
+    else:
+        if not args.profile:
+            print("error: --trace needs --profile", file=sys.stderr)
+            return 2
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            out_path = (
+                Path(args.out) if args.out else Path(tmp) / "memory.jsonl"
+            )
+            serve_argv = [
+                "--trace", args.trace,
+                "--profile", args.profile,
+                "--quiet",
+                "--workers", str(args.workers),
+                "--mip-gap", str(args.mip_gap),
+                "--lp-backend", args.lp_backend,
+                "--compile-warm-events", str(args.warm_events),
+                "--memory-out", str(out_path),
+            ]
+            if args.memory_budget_mb is not None:
+                serve_argv += [
+                    "--memory-budget-mb", str(args.memory_budget_mb),
+                ]
+            if args.synthetic_fleet:
+                serve_argv += [
+                    "--synthetic-fleet", str(args.synthetic_fleet),
+                    "--fleet-seed", str(args.fleet_seed),
+                ]
+            if args.k_candidates:
+                serve_argv += ["--k-candidates", args.k_candidates]
+            # The delegated serve run's summary goes to stderr: stdout
+            # must carry exactly the report (or the --json object), the
+            # `solver compiles` convention.
+            import contextlib
+            import io
+
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = serve_main(serve_argv)
+            if buf.getvalue():
+                print(buf.getvalue(), end="", file=sys.stderr)
+            if rc != 0:
+                return rc
+            text = out_path.read_text(encoding="utf-8")
+            dump = memory_from_jsonl(text)
+
+    if args.check:
+        failures = []
+        summary = dump["header"].get("summary", {})
+        leak = summary.get("leak")
+        if leak is None:
+            failures.append(
+                "leak gate never marked (the replay ended before the "
+                "warm boundary — fewer events than --warm-events?)"
+            )
+        elif not leak.get("flat"):
+            failures.append(
+                f"warm serving GREW live-array bytes: "
+                f"{leak['baseline_bytes']} -> {leak['last_bytes']} "
+                f"({leak['growth_bytes']:+d} B) — drift/spec ticks must "
+                "allocate nothing persistent"
+            )
+        marks = summary.get("watermarks", {})
+        if marks.get("sample_errors", 0):
+            failures.append(
+                f"{marks['sample_errors']} watermark sample(s) failed"
+            )
+        if memory_to_jsonl(dump) != text:
+            failures.append("memory JSONL does not round-trip byte-stably")
+        if failures:
+            for f in failures:
+                print(f"memory-ledger check FAILED: {f}", file=sys.stderr)
+            return 1
+
+    if args.json:
+        print(json.dumps(dump["header"].get("summary", {}), sort_keys=True))
+    else:
+        print(render_report(dump), end="")
+    if args.check:
+        summary = dump["header"].get("summary", {})
+        analyzed = sum(
+            1
+            for e in summary.get("entries", {}).values()
+            if e.get("memory")
+        )
+        print(
+            f"memory-ledger check OK: {analyzed} entry model(s), warm "
+            "phase flat, dump byte-stable"
+        )
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "compiles":
         return compiles_main(argv[1:])
+    if argv and argv[0] == "memory":
+        return memory_main(argv[1:])
     if argv and argv[0] == "serve":
         # Subcommand dispatch; the bare flag form stays the one-shot solver
         # (reference-CLI compatible), so existing invocations are untouched.

@@ -140,6 +140,7 @@ class Gateway:
         max_queue_depth: Optional[int] = None,
         coalesce: bool = False,
         degrade_depth: Optional[int] = None,
+        mem_degrade_headroom_bytes: Optional[float] = None,
     ):
         # Library entry point that dispatches backend work (via the
         # schedulers it builds): arm the axon-wedge guard exactly like
@@ -189,14 +190,22 @@ class Gateway:
         #   degrade_depth  — depth at which ingest marks the tick as
         #       under PRESSURE: a speculative shard whose exact bank probe
         #       misses may serve a certified near-match (mode='spec_near')
-        #       instead of queueing a solve past its deadline.
+        #       instead of queueing a solve past its deadline;
+        #   mem_degrade_headroom_bytes — memory-headroom floor (needs a
+        #       live obs.memory ledger): when budget - RSS drops below
+        #       it, ingest marks ticks under the SAME pressure flag —
+        #       composing with degrade_depth, so a memory-squeezed
+        #       gateway degrades to spec_near serving before the OOM
+        #       killer degrades it to nothing.
         self.max_queue_depth = max_queue_depth
         self.coalesce = coalesce
         self.degrade_depth = degrade_depth
+        self.mem_degrade_headroom_bytes = mem_degrade_headroom_bytes
         self._admission = bool(
             max_queue_depth is not None
             or coalesce
             or degrade_depth is not None
+            or mem_degrade_headroom_bytes is not None
         )
         # Pending coalesce batches: shard key -> the batch dict its queued
         # drain closure will consume. Guarded by one lock (ingest may come
@@ -336,6 +345,7 @@ class Gateway:
         max_queue_depth: Optional[int] = None,
         coalesce: bool = False,
         degrade_depth: Optional[int] = None,
+        mem_degrade_headroom_bytes: Optional[float] = None,
     ) -> None:
         """Reconfigure the admission knobs (see ``__init__``).
 
@@ -354,11 +364,33 @@ class Gateway:
             self.max_queue_depth = max_queue_depth
             self.coalesce = coalesce
             self.degrade_depth = degrade_depth
+            self.mem_degrade_headroom_bytes = mem_degrade_headroom_bytes
             self._admission = bool(
                 max_queue_depth is not None
                 or coalesce
                 or degrade_depth is not None
+                or mem_degrade_headroom_bytes is not None
             )
+
+    def _mem_pressure(self) -> bool:
+        """True when the memory-headroom floor is configured AND the live
+        memory ledger reports headroom below it. Cost: a cached-or-/proc
+        RSS read (~0.1 ms worst case, no live-array walk) — cheap enough
+        per ingest. No ledger (or no readable RSS) means no verdict:
+        degrade-on-low-headroom degrades on EVIDENCE, never on absence.
+        """
+        if self.mem_degrade_headroom_bytes is None:
+            return False
+        from ..obs import memory as _mem
+
+        led = _mem.current()
+        if led is None:
+            return False
+        headroom = led.headroom_bytes()
+        if headroom is None or headroom >= self.mem_degrade_headroom_bytes:
+            return False
+        self.metrics.inc("mem_pressure")
+        return True
 
     def _tick_closure(
         self, fleet_id: str, key: str, worker, event, parent=None,
@@ -451,7 +483,7 @@ class Gateway:
             )
         pressure = (
             self.degrade_depth is not None and depth >= self.degrade_depth
-        )
+        ) or self._mem_pressure()
         structural = getattr(event, "kind", None) in STRUCTURAL_KINDS
         if self.coalesce and not structural:
             return self._submit_coalesced(
@@ -903,6 +935,15 @@ class Gateway:
             # Scheduler.timeline_sample so the two serving shapes'
             # names cannot drift.
             out.update(led.timeline_series())
+        from ..obs import memory as _mem
+
+        mled = _mem.current()
+        if mled is not None:
+            # mem.* watermark gauges (obs.memory.timeline_series — same
+            # one-definition contract as the compile series): live-array
+            # bytes by platform, RSS/HWM, headroom. Absent when
+            # unavailable, never zeroed; feature-off byte-identical.
+            out.update(mled.timeline_series())
         return out
 
     def slo_status(self) -> dict:

@@ -220,6 +220,7 @@ def run_loadgen(
     prom_scrape_s: Optional[float] = None,
     timeline_period_s: Optional[float] = None,
     compile_ledger: bool = False,
+    memory_ledger: bool = False,
 ) -> dict:
     """One full loadgen arm: build fleets, replay, report, tear down.
 
@@ -279,6 +280,16 @@ def run_loadgen(
         led = _cl.current()
         if led is None:
             led = led_owned = _cl.enable()
+    # Memory-ledger arm (bench `memory` section): same reuse-or-own
+    # contract as the compile ledger — the interleaved OFF arms must run
+    # the true passthrough path or the overhead measurement lies.
+    mled = mled_owned = None
+    if memory_ledger:
+        from ..obs import memory as _mem
+
+        mled = _mem.current()
+        if mled is None:
+            mled = mled_owned = _mem.enable()
     try:
         for fleet_id, spec in specs.items():
             gateway.register_fleet(
@@ -289,6 +300,16 @@ def run_loadgen(
         if sampler is not None:
             sampler.start()
         arm_tok = led.seq() if led is not None else 0
+
+        def _on_timed_start() -> None:
+            # The warmup barrier is BOTH ledgers' warm boundary: compile
+            # events after it are warm-phase compiles, live-array growth
+            # after it is a leak.
+            if led is not None:
+                warm_tok["seq"] = led.seq()
+            if mled is not None:
+                mled.mark_warm()
+
         measure_from = {f: warmup_per_fleet for f in specs}
         report = asyncio.run(
             replay_concurrent(
@@ -297,8 +318,8 @@ def run_loadgen(
                 measure_from,
                 on_timed_start=(
                     None
-                    if led is None
-                    else (lambda: warm_tok.__setitem__("seq", led.seq()))
+                    if (led is None and mled is None)
+                    else _on_timed_start
                 ),
             )
         )
@@ -353,6 +374,19 @@ def run_loadgen(
                 ),
                 "warm_entries": sorted({e["entry"] for e in warm_events}),
             }
+        if mled is not None:
+            # One forced end-of-arm sample (the gateway is quiescent
+            # here: every fleet's last event resolved), so the leak
+            # verdict compares the warm baseline against the arm's true
+            # final live bytes, not a stale mid-phase throttle hit.
+            mled.sample(force=True)
+            report["mem"] = {
+                "leak": mled.leak_report(),
+                "watermarks": mled.summary()["watermarks"],
+                "entries_analyzed": sum(
+                    1 for r in mled.analyses.values() if r.get("memory")
+                ),
+            }
         return report
     finally:
         # close() stops the attached scraper first, then the workers —
@@ -362,6 +396,10 @@ def run_loadgen(
             from ..obs import compile_ledger as _cl
 
             _cl.disable()
+        if mled_owned is not None:
+            from ..obs import memory as _mem
+
+            _mem.disable()
 
 
 def main(argv=None) -> int:

@@ -31,6 +31,11 @@ dependency):
   cache-hit / static-arg-flip / shape-bucket-change / recompile), a
   recompile-storm alarm, and the ``solver compiles`` report — the layer
   the zero-recompile warm-serving gate reads;
+- ``memory`` — the memory ledger riding the same entry-point registry:
+  per-entry static memory models from AOT ``memory_analysis()`` (+
+  FLOPs), live-array/RSS watermark sampling with a warm-path leak gate,
+  the ``mem_headroom_bytes`` signal, and the ``solver memory`` report —
+  the layer the zero-leak warm-serving gate reads;
 - ``slo`` — declarative SLO specs compiled into error budgets with
   multi-window multi-burn-rate alert rules (hysteretic open/close, the
   ``sched.alert`` span + flight trail), the ``GET /slo``/``GET /signals``
@@ -41,7 +46,7 @@ See README "Observability" / "Convergence diagnostics" for the span model,
 the label table, and the trace-buffer semantics.
 """
 
-from . import compile_ledger
+from . import compile_ledger, memory
 from .convergence import (
     ConvergenceTrace,
     LPChunkSample,
@@ -87,6 +92,7 @@ from .trace import (
 
 __all__ = [
     "compile_ledger",
+    "memory",
     "Tracer",
     "Span",
     "SpanContext",

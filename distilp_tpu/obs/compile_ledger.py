@@ -64,6 +64,8 @@ __all__ = [
     "InstrumentedJit",
     "instrument",
     "registered_entry_points",
+    "set_dispatch_hook",
+    "parse_cost_analysis",
     "enable",
     "disable",
     "current",
@@ -104,6 +106,12 @@ _LEDGER: Optional["CompileLedger"] = None
 _LEDGER_LOCK = threading.Lock()
 # None = not probed yet; True/False = jax.monitoring listeners installed.
 _MONITORING_OK: Optional[bool] = None
+# Registry ride-along (obs.memory): one callable invoked per dispatch of
+# every instrumented entry point, BEFORE the call — (wrapper, args,
+# kwargs). None (the default) keeps the passthrough path at one extra
+# module-global read; the hook owner is responsible for its own dormancy
+# check and for never raising into the dispatch.
+_DISPATCH_HOOK = None
 
 
 def _stack() -> list:
@@ -111,6 +119,24 @@ def _stack() -> list:
     if stack is None:
         stack = _tls.stack = []
     return stack
+
+
+def parse_cost_analysis(cost) -> Tuple[Optional[float], Optional[float]]:
+    """(flops, bytes_accessed) from an XLA ``cost_analysis()`` return —
+    a dict on some jaxlibs, a one-element list of dicts on others. ONE
+    copy, shared with ``obs.memory``'s AOT pass: the two ledgers' FLOPs
+    must come from the same parse or the analytic-vs-measured report
+    silently compares different numbers."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not hasattr(cost, "get"):
+        return None, None
+    flops = cost.get("flops")
+    bytes_accessed = cost.get("bytes accessed")
+    return (
+        float(flops) if flops is not None else None,
+        float(bytes_accessed) if bytes_accessed is not None else None,
+    )
 
 
 def _static_sig(static_argnames: Sequence[str], kwargs: dict) -> str:
@@ -351,18 +377,11 @@ class CompileLedger:
         _tls.suppress = True
         try:
             cost = wrapper._fn.lower(*args, **kwargs).compile().cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0] if cost else {}
-            flops = cost.get("flops")
-            bytes_accessed = cost.get("bytes accessed")
+            flops, bytes_accessed = parse_cost_analysis(cost)
             with self._lock:
                 self.costs[entry] = {
-                    "flops": float(flops) if flops is not None else None,
-                    "bytes_accessed": (
-                        float(bytes_accessed)
-                        if bytes_accessed is not None
-                        else None
-                    ),
+                    "flops": flops,
+                    "bytes_accessed": bytes_accessed,
                 }
         except Exception:  # dlint: disable=DLP017 counted on the ledger itself (cost_errors); cost attribution is advisory and this module owns its own sink
             with self._lock:
@@ -525,6 +544,12 @@ class InstrumentedJit:
         self.static_argnames = tuple(static_argnames)
 
     def __call__(self, *args, **kwargs):
+        hook = _DISPATCH_HOOK
+        if hook is not None:
+            # The memory ledger's registry ride-along (set_dispatch_hook):
+            # runs before the call so a first-dispatch AOT analysis sees
+            # the exact arguments the real dispatch is about to compile.
+            hook(self, args, kwargs)
         led = _LEDGER
         if led is None:
             return self._fn(*args, **kwargs)
@@ -553,6 +578,16 @@ def registered_entry_points() -> List[str]:
     the expected cold-compile surface ``make smoke-compile`` checks
     compiles against."""
     return sorted(_REGISTRY)
+
+
+def set_dispatch_hook(hook) -> None:
+    """Install (or clear, with None) the per-dispatch registry hook —
+    the seam ``obs.memory`` rides to AOT-analyze each entry point once.
+    Process-wide like the ledger itself; the hook must check its own
+    dormancy and swallow its own failures (a raising hook would take
+    every instrumented dispatch down with it)."""
+    global _DISPATCH_HOOK
+    _DISPATCH_HOOK = hook
 
 
 # -- process-wide enable/disable ---------------------------------------------

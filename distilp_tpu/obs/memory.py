@@ -1,0 +1,685 @@
+"""Memory ledger: per-entry XLA memory analysis + live watermarks + leak gate.
+
+Spans (PR 8), convergence (PR 10), SLOs (PR 13) and compilation (PR 14)
+left exactly one axis of the serving stack unobserved: memory — the axis
+that is now the binding scaling constraint (the bench's fleet_scale
+section skips the IPM's M=4096 arm on an analytic, never-validated
+proxy, and ROADMAP item 3's operator sharding needs per-kernel memory
+attribution before any mesh decision). The ledger makes memory
+first-class:
+
+- **Static memory model per entry point.** The ledger rides the compile
+  ledger's ``instrument()`` registry (a dispatch hook, see
+  ``compile_ledger.set_dispatch_hook``): the first time a registered jit
+  entry point is dispatched from Python (never inside an outer trace —
+  tracers cannot lower), the ledger AOT-lowers it at the call's own
+  arguments and records ``lower(...).compile().memory_analysis()`` —
+  temp / argument / output / generated-code bytes — plus the same
+  compiled object's ``cost_analysis()`` FLOPs. The AOT pass's own
+  compile events are suppressed through PR 14's ``_tls.suppress``
+  machinery, exactly like the compile ledger's cost attribution; a
+  backend that does not report (``memory_analysis()`` returning None, or
+  the AOT path raising) records a graceful ``None`` — absent, never
+  zeroed.
+- **Live watermarks.** ``sample()`` records jax live-array bytes by
+  backend platform plus host RSS/HWM parsed stdlib-only from
+  ``/proc/self/status`` (``VmHWM`` is genuinely absent on some container
+  kernels — absent fields stay ``None``). A live-array walk costs ~3 us
+  per live array, so samples are throttled (``sample_min_interval_s``);
+  the serving path attaches watermark attrs only on ticks where a fresh
+  sample actually landed.
+- **Leak gate.** ``mark_warm()`` pins the warm-serving baseline;
+  ``leak_report()`` compares the newest live-array bytes against it.
+  The warm path's contract is FLAT — drift/spec/spec_near ticks allocate
+  nothing persistent — gated absolutely by ``bench --against`` and
+  pinned by the >=100-tick regression test on both LP engines.
+- **Headroom.** ``headroom_bytes()`` = budget - RSS (budget defaults to
+  ``/proc/meminfo`` MemTotal; override per deployment). It feeds the
+  ``mem_headroom_bytes`` field of ``GET /signals`` and the gateway's
+  optional degrade-on-low-headroom admission hint.
+
+Like every obs module: stdlib-only at import (jax loads lazily inside
+the sampling/analysis paths), opt-in (no ledger enabled means the
+instrumented entry points run the exact pre-ledger path — one extra
+module-global read per dispatch), and JSONL persistence follows the
+flight-recorder convention with a byte-stable round trip;
+``render_report`` is a pure function of a dump, so ``solver memory``
+renders identical bytes on every replay of the same dump.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from . import compile_ledger as _cl
+
+__all__ = [
+    "MemoryLedger",
+    "enable",
+    "disable",
+    "current",
+    "parse_proc_status",
+    "read_proc_status",
+    "read_meminfo_total",
+    "live_array_bytes",
+    "memory_to_jsonl",
+    "memory_from_jsonl",
+    "render_report",
+]
+
+# memory_analysis() attribute -> dump key. host_* fields exist on newer
+# jaxlibs; missing attributes record None (absent, never zero).
+MEM_ANALYSIS_FIELDS = (
+    ("temp_bytes", "temp_size_in_bytes"),
+    ("argument_bytes", "argument_size_in_bytes"),
+    ("output_bytes", "output_size_in_bytes"),
+    ("alias_bytes", "alias_size_in_bytes"),
+    ("generated_code_bytes", "generated_code_size_in_bytes"),
+    ("host_temp_bytes", "host_temp_size_in_bytes"),
+)
+
+_tls = threading.local()
+_LEDGER: Optional["MemoryLedger"] = None
+_LEDGER_LOCK = threading.Lock()
+# Cached jax.core.trace_state_clean (probed once): an inner-trace
+# dispatch sees tracer arguments, which cannot be AOT-lowered.
+_TRACE_STATE = None
+
+
+# -- stdlib probes ------------------------------------------------------------
+
+
+def _kb_value(line: str) -> Optional[int]:
+    """Bytes from a ``Vm...:   1234 kB`` /proc status line; None when the
+    line does not parse (proc(5) promises kB, but a parser that crashes
+    on a weird kernel would take the watermark sampler down with it)."""
+    parts = line.split()
+    if len(parts) < 2:
+        return None
+    try:
+        kb = int(parts[1])
+    except ValueError:  # dlint: disable=DLP017 the None return IS the signal (absent-not-zero contract): every consumer renders it as n/a, and the summary's sample accounting stays intact
+        return None
+    return kb * 1024
+
+
+def parse_proc_status(text: str) -> Dict[str, Optional[int]]:
+    """``{"rss_bytes", "hwm_bytes"}`` from a ``/proc/<pid>/status`` blob.
+
+    ``VmHWM`` is missing on some container/sandbox kernels (this repo's
+    own CI box among them) — a missing field is ``None``, and every
+    consumer treats None as "absent", never as zero.
+    """
+    out: Dict[str, Optional[int]] = {"rss_bytes": None, "hwm_bytes": None}
+    for line in text.splitlines():
+        if line.startswith("VmRSS:"):
+            out["rss_bytes"] = _kb_value(line)
+        elif line.startswith("VmHWM:"):
+            out["hwm_bytes"] = _kb_value(line)
+    return out
+
+
+def read_proc_status(path: str = "/proc/self/status") -> Dict[str, Optional[int]]:
+    """Parsed RSS/HWM of this process; all-None off Linux (no /proc)."""
+    try:
+        with open(path, "r", encoding="ascii", errors="replace") as fh:
+            text = fh.read()
+    except OSError:  # dlint: disable=DLP017 no /proc on this platform: the all-None record IS the signal (absent-not-zero), rendered as n/a everywhere — not a fault to count
+        return {"rss_bytes": None, "hwm_bytes": None}
+    return parse_proc_status(text)
+
+
+def read_meminfo_total(path: str = "/proc/meminfo") -> Optional[int]:
+    """MemTotal in bytes — the default headroom budget when none is
+    configured; None off Linux (headroom then reports None, not a lie)."""
+    try:
+        with open(path, "r", encoding="ascii", errors="replace") as fh:
+            for line in fh:
+                if line.startswith("MemTotal:"):
+                    return _kb_value(line)
+    except OSError:  # dlint: disable=DLP017 no /proc on this platform: a None budget makes headroom report None (honest absence), never a fabricated number
+        return None
+    return None
+
+
+def live_array_bytes() -> dict:
+    """Live jax-array bytes: ``{"total_bytes", "count", "by_platform"}``.
+
+    Lazy jax import (the obs layer stays jax-free at import time); the
+    walk costs ~3 us per live array, which is why the ledger throttles
+    its samples. A process with no jax loaded yet reports zero live
+    arrays honestly — importing jax here just to count nothing would
+    drag backend init into a watermark read.
+    """
+    import sys
+
+    if "jax" not in sys.modules:
+        return {"total_bytes": 0, "count": 0, "by_platform": {}}
+    import jax  # lazy: already loaded, this is just the name
+
+    total = 0
+    count = 0
+    by_platform: Dict[str, int] = {}
+    for a in jax.live_arrays():
+        nbytes = getattr(a, "nbytes", None)
+        if nbytes is None:
+            continue
+        total += int(nbytes)
+        count += 1
+        try:
+            platform = next(iter(a.devices())).platform
+        except Exception:  # dlint: disable=DLP017 per-array platform lookup is cosmetic grouping; the byte total above already counted this array and a deleted-buffer race here must not kill the sampler
+            platform = "unknown"
+        by_platform[platform] = by_platform.get(platform, 0) + int(nbytes)
+    return {"total_bytes": total, "count": count, "by_platform": by_platform}
+
+
+def _trace_clean() -> bool:
+    global _TRACE_STATE
+    if _TRACE_STATE is None:
+        try:
+            from jax.core import trace_state_clean
+
+            _TRACE_STATE = trace_state_clean
+        except Exception:  # dlint: disable=DLP017 probed once: no jax (unit-tier stand-ins) means no traces to collide with — analysis then fails gracefully on the missing .lower instead
+            _TRACE_STATE = lambda: True  # noqa: E731
+    try:
+        return bool(_TRACE_STATE())
+    except Exception:  # dlint: disable=DLP017 a trace-state probe that raises mid-teardown must read as "not clean": skipping one analysis opportunity is recoverable, crashing the dispatch is not
+        return False
+
+
+class MemoryLedger:
+    """Process-wide memory ledger (see module docstring).
+
+    One re-entrant lock covers all mutation: the dispatch hook fires from
+    every shard-worker thread, the timeline sampler reads watermarks, and
+    the AOT analysis claims entries before releasing the lock.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        budget_bytes: Optional[int] = None,
+        sample_min_interval_s: float = 0.25,
+    ):
+        if capacity < 2:
+            raise ValueError("memory ledger capacity must be >= 2")
+        self.capacity = capacity
+        # Headroom budget; enable() fills in MemTotal when left None.
+        self.budget_bytes = budget_bytes
+        # A live-array walk is ~3 us/array — at thousands of live arrays
+        # an unthrottled per-tick walk would blow the <=5% overhead gate,
+        # so sample() returns the cached record inside this window.
+        self.sample_min_interval_s = sample_min_interval_s
+        self._lock = threading.RLock()
+        self._t0 = time.monotonic()
+        # entry -> analysis record (claimed at first Python-side
+        # dispatch): {"memory": {...}|None, "flops": float|None,
+        # "bytes_accessed": float|None, "error": str|None}.
+        self.analyses: Dict[str, dict] = {}
+        self.analysis_errors = 0
+        self.dispatches: Dict[str, int] = {}
+        self.samples: "deque[dict]" = deque(maxlen=capacity)
+        self.sample_count = 0  # total ever (ring may have evicted)
+        self.sample_errors = 0
+        self.peak: Dict[str, Optional[int]] = {
+            "live_bytes": None,
+            "rss_bytes": None,
+            "hwm_bytes": None,
+        }
+        self._last: Optional[dict] = None
+        self._last_t: Optional[float] = None
+        # The leak-gate baseline (mark_warm); None until marked.
+        self._warm_sample: Optional[dict] = None
+
+    # -- dispatch hook (the compile-ledger registry ride-along) ------------
+
+    def _on_dispatch(self, wrapper, args, kwargs) -> None:
+        """Per-dispatch hook: count, and AOT-analyze the entry once.
+
+        Steady state (entry already analyzed) is one lock hold — a
+        counter bump and a membership check; the <=5% bench gate measures
+        exactly this path. The analysis itself runs at most once per
+        entry, only on a Python-side dispatch (never inside an outer
+        trace — the enclosing entry's analysis covers the executable
+        that actually allocates), and never re-entrantly (the AOT lower
+        re-dispatches inner instrumented kernels at trace time).
+        """
+        entry = wrapper.entry_point
+        with self._lock:
+            self.dispatches[entry] = self.dispatches.get(entry, 0) + 1
+            analyzed = entry in self.analyses
+        if analyzed or getattr(_tls, "in_analysis", False):
+            return
+        if not _trace_clean():
+            return
+        _tls.in_analysis = True
+        try:
+            self._analyze(entry, wrapper, args, kwargs)
+        finally:
+            _tls.in_analysis = False
+
+    def _analyze(self, entry: str, wrapper, args, kwargs) -> None:
+        """AOT memory+cost analysis of one entry at these arguments."""
+        with self._lock:
+            if entry in self.analyses:
+                return
+            rec: dict = {
+                "memory": None,
+                "flops": None,
+                "bytes_accessed": None,
+                "error": None,
+            }
+            self.analyses[entry] = rec  # claim before releasing the lock
+        lower = getattr(wrapper, "lower", None)
+        if lower is None:
+            with self._lock:
+                rec["error"] = "entry point has no AOT lower()"
+                self.analysis_errors += 1
+            return
+        # PR 14's suppression machinery: the AOT re-lowering below fires
+        # real backend_compile events, and counting our own analysis as a
+        # recompile would poison the zero-recompile warm gate.
+        _cl._tls.suppress = True
+        try:
+            compiled = lower(*args, **kwargs).compile()
+            mem = None
+            try:
+                ma = compiled.memory_analysis()
+            except Exception:  # dlint: disable=DLP017 counted on the ledger (analysis_errors, surfaced per entry as rec.error): a backend without buffer-assignment stats is the documented graceful-None path, not a fault to crash serving over
+                ma = None
+                with self._lock:
+                    rec["error"] = "memory_analysis() unsupported"
+                    self.analysis_errors += 1
+            if ma is not None:
+                mem = {
+                    key: (
+                        int(v)
+                        if (v := getattr(ma, attr, None)) is not None
+                        else None
+                    )
+                    for key, attr in MEM_ANALYSIS_FIELDS
+                }
+            flops = bytes_accessed = None
+            try:
+                flops, bytes_accessed = _cl.parse_cost_analysis(
+                    compiled.cost_analysis()
+                )
+            except Exception:  # dlint: disable=DLP017 counted on the ledger (analysis_errors): FLOPs attribution is advisory — a backend that reports memory but not cost must still keep its memory record
+                with self._lock:
+                    self.analysis_errors += 1
+            with self._lock:
+                rec["memory"] = mem
+                rec["flops"] = flops
+                rec["bytes_accessed"] = bytes_accessed
+        except Exception as e:  # dlint: disable=DLP017 counted on the ledger (analysis_errors) and surfaced per entry as rec.error — an unlowerable call (donated buffers, exotic statics) must cost one missing analysis, never the dispatch
+            with self._lock:
+                rec["error"] = f"{type(e).__name__}: {e}"[:200]
+                self.analysis_errors += 1
+        finally:
+            _cl._tls.suppress = False
+
+    # -- watermark sampling -------------------------------------------------
+
+    def sample(self, force: bool = False) -> dict:
+        """One watermark record (throttled; ``force=True`` bypasses).
+
+        Inside the throttle window the CACHED record returns (its
+        ``fresh`` key False) so per-tick callers can attach-or-skip
+        without a second live-array walk. Failures are counted, never
+        raised: the serving path outranks its own observability.
+        """
+        now = time.monotonic()
+        with self._lock:
+            if (
+                not force
+                and self._last is not None
+                and now - self._last_t < self.sample_min_interval_s
+            ):
+                cached = dict(self._last)
+                cached["fresh"] = False
+                return cached
+        try:
+            live = live_array_bytes()
+        except Exception:  # dlint: disable=DLP017 counted on the ledger (sample_errors, surfaced in summary/watermarks): a failed live-array walk mid-teardown must degrade to an RSS-only sample, not kill the sampler thread
+            with self._lock:
+                self.sample_errors += 1
+            live = {"total_bytes": None, "count": None, "by_platform": {}}
+        status = read_proc_status()
+        rec: dict = {
+            "t": round(now - self._t0, 6),
+            "live_bytes": live["total_bytes"],
+            "live_count": live["count"],
+            "rss_bytes": status["rss_bytes"],
+            "hwm_bytes": status["hwm_bytes"],
+        }
+        if live["by_platform"]:
+            rec["by_platform"] = dict(sorted(live["by_platform"].items()))
+        with self._lock:
+            self.sample_count += 1
+            self.samples.append(rec)
+            self._last = rec
+            self._last_t = now
+            for key in ("live_bytes", "rss_bytes", "hwm_bytes"):
+                v = rec[key]
+                if v is not None and (
+                    self.peak[key] is None or v > self.peak[key]
+                ):
+                    self.peak[key] = v
+        out = dict(rec)
+        out["fresh"] = True
+        return out
+
+    def mark_warm(self) -> dict:
+        """Pin the leak-gate baseline: the warm serving path's live-array
+        bytes must stay flat from here on. Returns the baseline sample."""
+        rec = self.sample(force=True)
+        rec.pop("fresh", None)
+        with self._lock:
+            self._warm_sample = rec
+        return dict(rec)
+
+    def note_structural(self) -> None:
+        """A problem-identity change legitimately re-allocates (new
+        layouts, new warm-state shapes): re-pin the leak baseline IF one
+        was already marked. Growth ACROSS a structural boundary is
+        provisioning; growth BETWEEN them is a leak — which is exactly
+        the warm-path contract (drift/spec/spec_near ticks allocate
+        nothing persistent). Before ``mark_warm`` this is a no-op: the
+        cold warmup phase owns its own boundary."""
+        with self._lock:
+            marked = self._warm_sample is not None
+        if marked:
+            self.mark_warm()
+
+    def leak_report(self, tolerance_bytes: int = 0) -> Optional[dict]:
+        """The leak gate's verdict vs the ``mark_warm`` baseline; None
+        until the baseline is marked or while live bytes are unreadable.
+        ``flat`` is the contract: no net live-array growth across the
+        warm serving path."""
+        with self._lock:
+            base = self._warm_sample
+            last = self._last
+        if base is None or last is None:
+            return None
+        b, l = base.get("live_bytes"), last.get("live_bytes")
+        if b is None or l is None:
+            return None
+        growth = int(l) - int(b)
+        return {
+            "baseline_bytes": int(b),
+            "last_bytes": int(l),
+            "growth_bytes": growth,
+            "tolerance_bytes": int(tolerance_bytes),
+            "flat": growth <= tolerance_bytes,
+        }
+
+    def headroom_bytes(self, max_age_s: float = 1.0) -> Optional[float]:
+        """budget - RSS; None without a budget or a readable RSS.
+
+        Uses the cached sample when fresh enough, else ONE cheap /proc
+        read (~0.1 ms, no live-array walk) — cheap enough for the
+        gateway's per-ingest degrade check.
+        """
+        if self.budget_bytes is None:
+            return None
+        rss = None
+        now = time.monotonic()
+        with self._lock:
+            if (
+                self._last is not None
+                and self._last_t is not None
+                and now - self._last_t <= max_age_s
+            ):
+                rss = self._last.get("rss_bytes")
+        if rss is None:
+            rss = read_proc_status()["rss_bytes"]
+        if rss is None:
+            return None
+        return float(self.budget_bytes - rss)
+
+    # -- the read side -------------------------------------------------------
+
+    def timeline_series(self) -> Dict[str, float]:
+        """The ledger's ``mem.*`` timeline emission — ONE definition
+        shared by ``Scheduler.timeline_sample`` and
+        ``Gateway.timeline_sample`` (the compile ledger's convention, so
+        the two serving shapes' series cannot drift). These are GAUGES:
+        an unavailable value is ABSENT, never zero — a zero RSS would be
+        a lie, unlike the counter-baseline case PR 13 zero-fills.
+        Sampling is throttled, so a sampler outpacing the throttle
+        re-emits the cached watermark (windows stay populated)."""
+        rec = self.sample()
+        out: Dict[str, float] = {}
+        if rec.get("live_bytes") is not None:
+            out["mem.live_bytes"] = float(rec["live_bytes"])
+            out["mem.live_count"] = float(rec["live_count"])
+        for platform, nbytes in (rec.get("by_platform") or {}).items():
+            out[f"mem.live_bytes.{platform}"] = float(nbytes)
+        if rec.get("rss_bytes") is not None:
+            out["mem.rss_bytes"] = float(rec["rss_bytes"])
+        if rec.get("hwm_bytes") is not None:
+            out["mem.hwm_bytes"] = float(rec["hwm_bytes"])
+        headroom = self.headroom_bytes()
+        if headroom is not None:
+            out["mem.headroom_bytes"] = headroom
+        return out
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "mem_entries_analyzed": len(self.analyses),
+                "mem_analysis_errors": self.analysis_errors,
+                "mem_samples": self.sample_count,
+                "mem_sample_errors": self.sample_errors,
+                "mem_dispatches": sum(self.dispatches.values()),
+            }
+
+    def summary(self) -> dict:
+        """Per-entry table + watermarks + leak verdict, JSON-able."""
+        with self._lock:
+            entries = {}
+            names = sorted(set(self.analyses) | set(self.dispatches))
+            for name in names:
+                rec = self.analyses.get(name)
+                e: dict = {"dispatches": self.dispatches.get(name, 0)}
+                if rec is not None:
+                    e["memory"] = (
+                        dict(rec["memory"]) if rec["memory"] else None
+                    )
+                    e["flops"] = rec["flops"]
+                    e["bytes_accessed"] = rec["bytes_accessed"]
+                    if rec["error"]:
+                        e["error"] = rec["error"]
+                entries[name] = e
+            watermarks = {
+                "peak_live_bytes": self.peak["live_bytes"],
+                "peak_rss_bytes": self.peak["rss_bytes"],
+                "peak_hwm_bytes": self.peak["hwm_bytes"],
+                "samples": self.sample_count,
+                "sample_errors": self.sample_errors,
+            }
+        return {
+            "entries": entries,
+            "watermarks": watermarks,
+            "leak": self.leak_report(),
+            "budget_bytes": self.budget_bytes,
+            "counters": self.counters(),
+        }
+
+    def dump(self) -> dict:
+        """One JSON-able blob: header + watermark sample list."""
+        with self._lock:
+            samples = [dict(s) for s in self.samples]
+        return {
+            "header": {
+                "memory_ledger": 1,
+                "capacity": self.capacity,
+                "budget_bytes": self.budget_bytes,
+                "summary": self.summary(),
+            },
+            "samples": samples,
+        }
+
+    def to_jsonl(self) -> str:
+        return memory_to_jsonl(self.dump())
+
+    def dump_jsonl(self, path) -> None:
+        from pathlib import Path
+
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(self.to_jsonl(), encoding="utf-8")
+
+
+# -- process-wide enable/disable ---------------------------------------------
+
+
+def _on_dispatch(wrapper, args, kwargs) -> None:
+    led = _LEDGER
+    if led is None:
+        return
+    led._on_dispatch(wrapper, args, kwargs)
+
+
+def enable(ledger: Optional[MemoryLedger] = None, **kwargs) -> MemoryLedger:
+    """Install ``ledger`` (or a fresh one from ``kwargs``) as THE process
+    memory ledger and register the dispatch hook on the compile ledger's
+    entry-point registry. The hook stays registered across
+    disable/enable cycles and is dormant (one module-global read) while
+    no ledger is current. A budget left None resolves to MemTotal."""
+    global _LEDGER
+    with _LEDGER_LOCK:
+        led = ledger if ledger is not None else MemoryLedger(**kwargs)
+        if led.budget_bytes is None:
+            led.budget_bytes = read_meminfo_total()
+        _cl.set_dispatch_hook(_on_dispatch)
+        _LEDGER = led
+        return led
+
+
+def disable() -> Optional[MemoryLedger]:
+    """Detach the process memory ledger (hook goes dormant); returns it.
+    Every test/CLI owner must call this in a finally — a leaked global
+    ledger would AOT-analyze (and watermark) other tests' dispatches,
+    exactly like a leaked compile ledger would mint counters."""
+    global _LEDGER
+    with _LEDGER_LOCK:
+        led, _LEDGER = _LEDGER, None
+        return led
+
+
+def current() -> Optional[MemoryLedger]:
+    return _LEDGER
+
+
+# -- persistence + report (the flight-recorder JSONL convention) -------------
+
+
+def memory_to_jsonl(dump: dict) -> str:
+    """Header line + one watermark sample per line; pure function of the
+    dump, so ``to_jsonl(from_jsonl(s)) == s`` byte-for-byte."""
+    lines = [json.dumps(dump["header"], sort_keys=True)]
+    for rec in dump["samples"]:
+        lines.append(json.dumps(rec, sort_keys=True))
+    return "\n".join(lines) + "\n"
+
+
+def memory_from_jsonl(text: str) -> dict:
+    """Parse a dumped memory ledger back into the ``dump()`` shape."""
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("empty memory-ledger dump")
+    header = json.loads(lines[0])
+    if not isinstance(header, dict) or "memory_ledger" not in header:
+        raise ValueError("memory-ledger dump missing its header line")
+    if header["memory_ledger"] != 1:
+        raise ValueError(
+            f"unknown memory-ledger dump version {header['memory_ledger']!r}"
+        )
+    return {
+        "header": header,
+        "samples": [json.loads(ln) for ln in lines[1:]],
+    }
+
+
+def _fmt_bytes(v: Optional[int]) -> str:
+    """Deterministic human-scale bytes: exact value, MB alongside."""
+    if v is None:
+        return "n/a"
+    return f"{v} ({v / 1e6:.2f} MB)"
+
+
+def render_report(dump: dict) -> str:
+    """Deterministic text over a ``dump()``/``memory_from_jsonl`` blob:
+    watermarks, leak verdict, per-entry static model (bytes + FLOPs per
+    dispatch). No clocks, no live reads — byte-identical on every replay
+    of the same dump (the ``solver memory --check`` contract)."""
+    summary = dump["header"].get("summary", {})
+    entries = summary.get("entries", {})
+    marks = summary.get("watermarks", {})
+    leak = summary.get("leak")
+    out: List[str] = []
+    out.append("memory ledger")
+    budget = summary.get("budget_bytes")
+    out.append(f"  headroom budget: {_fmt_bytes(budget)}")
+    out.append(
+        "  watermarks: peak_live={} peak_rss={} peak_hwm={} "
+        "(samples={}, errors={})".format(
+            _fmt_bytes(marks.get("peak_live_bytes")),
+            _fmt_bytes(marks.get("peak_rss_bytes")),
+            _fmt_bytes(marks.get("peak_hwm_bytes")),
+            marks.get("samples", 0),
+            marks.get("sample_errors", 0),
+        )
+    )
+    if leak is None:
+        out.append("  leak gate: not marked (no warm baseline)")
+    else:
+        out.append(
+            "  leak gate: {} — baseline={} last={} growth={:+d} B".format(
+                "FLAT" if leak["flat"] else "GREW",
+                _fmt_bytes(leak["baseline_bytes"]),
+                _fmt_bytes(leak["last_bytes"]),
+                leak["growth_bytes"],
+            )
+        )
+    out.append("")
+    out.append(
+        f"  {'entry point':<34s} {'disp':>7s} {'temp MB':>9s} "
+        f"{'args MB':>9s} {'out MB':>8s} {'code MB':>8s} {'flops':>12s}"
+    )
+    for name in sorted(entries):
+        e = entries[name]
+        mem = e.get("memory")
+
+        def _mb(key: str) -> str:
+            if not mem or mem.get(key) is None:
+                return "n/a"
+            return f"{mem[key] / 1e6:.2f}"
+
+        flops = e.get("flops")
+        out.append(
+            f"  {name:<34s} {e.get('dispatches', 0):>7d} "
+            f"{_mb('temp_bytes'):>9s} {_mb('argument_bytes'):>9s} "
+            f"{_mb('output_bytes'):>8s} {_mb('generated_code_bytes'):>8s} "
+            f"{(f'{flops:.3g}' if flops is not None else 'n/a'):>12s}"
+        )
+        if e.get("error"):
+            out.append(f"  {'':<34s} ! {e['error']}")
+    unanalyzed = [
+        n for n, e in sorted(entries.items())
+        if "memory" in e and e["memory"] is None and not e.get("error")
+    ]
+    if unanalyzed:
+        out.append("")
+        out.append(
+            "  no static model (backend reported none): "
+            + ", ".join(unanalyzed)
+        )
+    return "\n".join(out) + "\n"

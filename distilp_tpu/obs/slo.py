@@ -532,6 +532,11 @@ class SignalsPayload(BaseModel):
     # From the PR 12 closed-loop capacity probe (bench/serve config).
     max_sustainable_eps: Optional[float] = None
     headroom_eps: Optional[float] = None
+    # From the memory ledger (obs.memory, serve --memory-ledger): budget
+    # minus host RSS, bytes. Additive (None without a ledger or budget),
+    # so version stays 1 — old consumers ignore it, the federation tier
+    # scales on it the same way it scales on headroom_eps.
+    mem_headroom_bytes: Optional[float] = None
 
 
 def build_signals(
@@ -612,6 +617,13 @@ def build_signals(
     headroom = None
     if capacity_eps is not None and recent is not None:
         headroom = capacity_eps - recent
+    # Memory headroom rides the same payload when a memory ledger is
+    # live: the scale-up signal (headroom_eps says "can take more load",
+    # mem_headroom_bytes says "has the memory to take it on").
+    from . import memory as _memory
+
+    mled = _memory.current()
+    mem_headroom = mled.headroom_bytes() if mled is not None else None
     return SignalsPayload(
         t=now,
         workers=workers,
@@ -622,6 +634,7 @@ def build_signals(
         shed_eps=shed,
         max_sustainable_eps=capacity_eps,
         headroom_eps=headroom,
+        mem_headroom_bytes=mem_headroom,
     )
 
 

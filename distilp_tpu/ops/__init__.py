@@ -1,4 +1,11 @@
-"""Numerical kernels (JAX/XLA; Pallas where XLA fusion is not enough)."""
+"""Numerical kernels (JAX/XLA; Pallas where XLA fusion is not enough).
+
+``memmodel`` (the stdlib-only analytic memory model) is deliberately not
+re-exported here: consumers import ``distilp_tpu.ops.memmodel`` lazily
+(function scope) from backend-free layers — reaching it still executes
+this package's jax imports, which is why obs/ and the CLI defer it to
+call time, the same DLP013 idiom as every other backend-touching import.
+"""
 
 from .ipm import IPMResult, IPMWarmState, LPBatch, ipm_solve_batch
 from .pdhg import PDHGWarmState, pdhg_solve_batch

@@ -1,0 +1,117 @@
+"""Analytic memory model for the HALDA LP engines — ONE source of truth.
+
+Until PR 15 the per-(M, engine) peak-working-set formulas lived inline in
+``bench.py``'s fleet_scale section, where they decide whether the IPM arm
+is even attempted (the M=4096 arm is skipped on the proxy alone) — an
+*analytic, never-validated* guess steering a measurement. This module is
+the factored-out model, shared by:
+
+- ``bench.py`` fleet_scale (the skip decision and the per-M proxy rows —
+  behavior unchanged, pinned by a parity test in tests/test_memory.py);
+- ``bench.py``'s ``memory`` section, which CALIBRATES the model: the
+  proxy is compared against XLA's measured ``memory_analysis()`` temp
+  bytes for the real solve executables at two M sizes, and ``--against``
+  gates the ratio inside a band (a proxy that drifts out of band stops
+  being allowed to skip arms silently);
+- the ``solver memory`` report, which prints analytic-vs-measured side
+  by side;
+- ROADMAP item 3's per-shard sizing (sharding the PDHG operators needs a
+  bytes-per-device-row model before any mesh decision).
+
+The model (dense HALDA standard form, see bench.py's original comment):
+``m_rows = 6M + 3`` constraint rows (w/n/y blocks + cycle/memory/prefetch
++ couplers) and ``n_cols ~ 3M`` variables. The engines' unavoidable
+per-iteration working sets differ structurally:
+
+- **IPM**: ``beam`` batched dense (m, m) f32 normal matrices — the
+  factorizing engine's quadratic wall (beam = the B&B LP batch width);
+- **PDHG**: ONE shared (m, n) f32 operator — matrix-free in iterates,
+  so the operator itself is the footprint (and the thing ROADMAP item 3
+  shards away).
+
+Stdlib-only at module level on purpose — but note the PACKAGE is not:
+``import distilp_tpu.ops.memmodel`` still executes ``ops/__init__``,
+which eagerly imports the jax kernels. Backend-free layers (obs/, the
+CLI's offline paths) therefore import this lazily at call time — by
+then a backend is in play anyway — and the formulas themselves never
+touch one.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = [
+    "DENSE_BEAM",
+    "F32_BYTES",
+    "ENGINES",
+    "standard_form_dims",
+    "ipm_peak_bytes",
+    "pdhg_peak_bytes",
+    "peak_bytes",
+    "peak_gb",
+    "ipm_memory_infeasible",
+]
+
+# The dense default_search_params beam — the IPM's LP batch size (see
+# backend_jax's dense search-knob defaults; bench.py pinned the same 6).
+DENSE_BEAM = 6
+F32_BYTES = 4
+
+ENGINES = ("ipm", "pdhg")
+
+
+def standard_form_dims(M: int) -> tuple:
+    """(m_rows, n_cols) of the dense HALDA standard form at fleet size M:
+    m = 6M+3 constraint rows, n ~ 3M variables."""
+    if M < 1:
+        raise ValueError(f"fleet size must be >= 1 (got {M})")
+    return 6 * M + 3, 3 * M
+
+
+def ipm_peak_bytes(
+    M: int, beam: int = DENSE_BEAM, dtype_bytes: int = F32_BYTES
+) -> int:
+    """The IPM's peak working set: ``beam`` batched (m, m) normal
+    matrices — the quadratic term that makes M=4096 memory-infeasible."""
+    m_rows, _ = standard_form_dims(M)
+    return beam * m_rows * m_rows * dtype_bytes
+
+
+def pdhg_peak_bytes(M: int, dtype_bytes: int = F32_BYTES) -> int:
+    """PDHG's peak working set: the ONE shared (m, n) operator (iterates
+    are vectors; A is only touched through opA/opAT — the fleet-scale
+    invariant PR 6 documented)."""
+    m_rows, n_cols = standard_form_dims(M)
+    return m_rows * n_cols * dtype_bytes
+
+
+def peak_bytes(M: int, engine: str, beam: int = DENSE_BEAM) -> int:
+    """Per-(M, engine) analytic peak working set in bytes."""
+    if engine == "ipm":
+        return ipm_peak_bytes(M, beam=beam)
+    if engine == "pdhg":
+        return pdhg_peak_bytes(M)
+    raise ValueError(f"unknown LP engine {engine!r} (expected ipm|pdhg)")
+
+
+def peak_gb(M: int, engine: str, beam: int = DENSE_BEAM) -> float:
+    """``peak_bytes`` in (decimal) gigabytes — the unit the fleet_scale
+    section reports and caps in."""
+    return peak_bytes(M, engine, beam=beam) / 1e9
+
+
+def ipm_memory_infeasible(
+    M: int, cap_gb: float, beam: int = DENSE_BEAM
+) -> Optional[str]:
+    """The fleet_scale skip decision: a human-readable reason when the
+    IPM's proxy exceeds ``cap_gb``, else None. Centralized so the bench,
+    the memory report and future per-shard sizing all phrase (and make)
+    the call identically."""
+    gb = peak_gb(M, "ipm", beam=beam)
+    if gb > cap_gb:
+        return (
+            f"memory-infeasible (~{gb:.1f} GB batched "
+            f"normal matrices > {cap_gb:g} GB cap)"
+        )
+    return None

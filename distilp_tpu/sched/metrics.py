@@ -163,6 +163,10 @@ METRIC_REGISTRY = {
     "compiles": "XLA compile events attributed to this scheduler's ticks",
     "compile_cache_hits": "Compiles served by the persistent compilation cache",
     "recompile_storms": "Recompile-storm alarms (N same-entry compiles in a window)",
+    # -- memory ledger (obs.memory) ---------------------------------------
+    "mem_samples": "Ticks that recorded a fresh memory-ledger watermark sample",
+    "mem_pressure": "Ticks marked under pressure by low memory headroom "
+    "(gateway degrade-on-low-headroom)",
     # -- SLO engine / metrics timelines (obs.timeline + obs.slo) ----------
     "timeline_samples": "Timeline sampler ticks that recorded a sample",
     "timeline_sample_error": "Timeline sampler ticks that failed (counted, never fatal)",
@@ -178,6 +182,10 @@ METRIC_REGISTRY = {
     "spec_hit_ms": "Speculative-hit serve latency (bank probe to publish), ms",
     "spec_presolve_ms": "Speculative presolve batch latency (off the serving path), ms",
     "compile_ms": "XLA compile time a tick paid (ledger-attributed), ms",
+    "mem_live_mb": "Live jax-array megabytes at tick end (memory-ledger "
+    "watermark; gauge-like, exposed as a summary)",
+    "mem_rss_mb": "Host RSS megabytes at tick end (memory-ledger "
+    "watermark; gauge-like, exposed as a summary)",
 }
 
 # Longest-prefix fallback for dynamically composed names. Every f-string

@@ -27,6 +27,7 @@ from typing import Callable, NamedTuple, Optional, Sequence, Tuple
 
 from ..common import DeviceProfile, ModelProfile
 from ..obs import compile_ledger as _compile_ledger
+from ..obs import memory as _memory
 from ..obs.trace import NOOP_SPAN, NOOP_TRACER
 from ..solver.result import HALDAResult
 from ..solver.streaming import StreamingReplanner
@@ -457,6 +458,19 @@ class Scheduler:
         # own threads paid at least one XLA compile; rides the flight
         # record so a slow tick's post-mortem says WHY it was slow.
         self._tick_compile: Optional[dict] = None
+        # This tick's memory watermark (obs.memory): set by _note_memory
+        # on ticks where the throttled ledger actually took a FRESH
+        # sample (live-array walks are ~3 us/array — unthrottled per-tick
+        # walks would blow the obs overhead budget); rides the tick span
+        # and the flight record. _mem_prev_live is the previous fresh
+        # sample's live bytes — the per-tick delta the leak gate's
+        # post-mortem reads.
+        self._tick_mem: Optional[dict] = None
+        self._mem_prev_live: Optional[int] = None
+        # Whether THIS tick applied a structural (identity-changing)
+        # event — the memory ledger re-pins its leak baseline there
+        # (structural re-allocation is provisioning, not a leak).
+        self._tick_structural = False
         self.jax_profile_dir = jax_profile_dir
         self._jax_profiled = False
         if solve_on_init:
@@ -524,6 +538,8 @@ class Scheduler:
             self._tick_exc = {}
             self._tick_conv = None
             self._tick_compile = None
+            self._tick_mem = None
+            self._tick_structural = False
             led = _compile_ledger.current()
             tok = led.seq() if led is not None else 0
             view: Optional[PlacementView] = None
@@ -535,6 +551,19 @@ class Scheduler:
                     # BEFORE the flight note: the compile counters must be
                     # in this tick's counter delta, not the next one's.
                     self._note_compiles(led, tok, span)
+                mled = _memory.current()
+                if mled is not None:
+                    # No-solve ticks (spec hits, breaker short-circuits,
+                    # quarantines) still watermark at tick exit; solved
+                    # ticks already sampled on the sched.solve span. Same
+                    # ordering contract as the compile note: the memory
+                    # counters/attrs land in THIS tick's record. A
+                    # structural tick then re-pins the leak baseline —
+                    # its allocation is provisioning, not a leak.
+                    if self._tick_mem is None:
+                        self._note_memory(mled, span)
+                    if self._tick_structural:
+                        mled.note_structural()
                 span.set_attr("mode", view.mode if view is not None else "error")
                 if self._flight is not None:
                     self._flight_note(event, view, span)
@@ -576,6 +605,8 @@ class Scheduler:
             self._tick_exc = {}
             self._tick_conv = None
             self._tick_compile = None
+            self._tick_mem = None
+            self._tick_structural = False
             led = _compile_ledger.current()
             tok = led.seq() if led is not None else 0
             view: Optional[PlacementView] = None
@@ -585,6 +616,12 @@ class Scheduler:
             finally:
                 if led is not None:
                     self._note_compiles(led, tok, span)
+                mled = _memory.current()
+                if mled is not None:
+                    if self._tick_mem is None:
+                        self._note_memory(mled, span)
+                    if self._tick_structural:
+                        mled.note_structural()
                 span.set_attr("mode", view.mode if view is not None else "error")
                 if self._flight is not None:
                     self._flight_note(last, view, span)
@@ -598,6 +635,7 @@ class Scheduler:
             structural = self.fleet.apply(event)
         except (ValueError, TypeError) as e:
             return self._quarantine(event, f"{type(e).__name__}: {e}")
+        self._tick_structural = structural
         self._absorbed(event, structural)
         return self._tick(structural=structural, pressure=pressure)
 
@@ -628,6 +666,7 @@ class Scheduler:
                     "placement was published; nothing safe to serve"
                 )
             return self.latest()
+        self._tick_structural = structural
         return self._tick(structural=structural, pressure=pressure)
 
     def _absorbed(self, event, structural: bool) -> None:
@@ -771,6 +810,14 @@ class Scheduler:
                     solve_span.set_attr(k, tick_tm[k])
             conv = {k: tick_tm[k] for k in _CONV_DIGEST_KEYS if k in tick_tm}
             self._tick_conv = conv or None
+            mled = _memory.current()
+            if mled is not None:
+                # The solve is where allocation happens: the watermark
+                # sampled HERE rides the sched.solve span (and, via
+                # _tick_mem, the flight record). No-solve ticks (spec
+                # hits, short-circuits) fall back to the handle()-exit
+                # note instead.
+                self._note_memory(mled, solve_span)
         finally:
             solve_span.end()
         self._on_clean_solve(probing)
@@ -1323,6 +1370,39 @@ class Scheduler:
                 # shape as breaker_open, never clobbering one).
                 self._flight_pending = "recompile_storm"
 
+    def _note_memory(self, mled, span) -> None:
+        """Attribute this tick's memory watermark (obs.memory): one
+        throttled ledger sample; on ticks where a FRESH sample landed the
+        live/RSS bytes (+ the live-byte delta vs the previous fresh
+        sample — the leak gate's per-tick view) ride the tick span, the
+        ``mem_live_mb``/``mem_rss_mb`` hists and the flight record. A
+        cached (throttled) sample records nothing — attaching a stale
+        watermark to this tick would claim a measurement that did not
+        happen. With no ledger enabled this is never called (the
+        byte-identical pin)."""
+        rec = mled.sample()
+        if not rec.get("fresh"):
+            return
+        self.metrics.inc("mem_samples")
+        live = rec.get("live_bytes")
+        rss = rec.get("rss_bytes")
+        tick_mem: dict = {}
+        if live is not None:
+            self.metrics.observe("mem_live_mb", live / 1e6)
+            span.set_attr("mem_live_bytes", live)
+            tick_mem["live_bytes"] = live
+            prev = self._mem_prev_live
+            if prev is not None:
+                span.set_attr("mem_live_delta", live - prev)
+                tick_mem["live_delta"] = live - prev
+            self._mem_prev_live = live
+        if rss is not None:
+            self.metrics.observe("mem_rss_mb", rss / 1e6)
+            span.set_attr("mem_rss_bytes", rss)
+            tick_mem["rss_bytes"] = rss
+        if tick_mem:
+            self._tick_mem = tick_mem
+
     def _flight_note(self, event, view: Optional[PlacementView], span) -> None:
         """Append this tick's flight record; fire any pending post-mortem.
 
@@ -1365,6 +1445,10 @@ class Scheduler:
             # taxonomy + which entry points): the multi-second span a
             # post-mortem would otherwise call 'unexplained'.
             rec["compile"] = dict(self._tick_compile)
+        if self._tick_mem is not None:
+            # The tick's memory watermark (fresh samples only): a leak's
+            # post-mortem reads which tick the live bytes stepped on.
+            rec["mem"] = dict(self._tick_mem)
         if self.speculative:
             # The post-mortem question speculation adds: was THIS tick a
             # hit or a miss, and how full was the bank when it happened?
@@ -1588,6 +1672,13 @@ class Scheduler:
             # over c.compiles / c.recompile_storms sees a storm's full
             # delta, and the feature-off sample stays byte-identical.
             out.update(led.timeline_series())
+        mled = _memory.current()
+        if mled is not None:
+            # mem.* watermark gauges (obs.memory.timeline_series, the one
+            # definition shared with Gateway.timeline_sample): absent —
+            # never zeroed — when a value is unavailable, and emitted
+            # only while a ledger is enabled (feature-off byte-identical).
+            out.update(mled.timeline_series())
         return out
 
     # -- warm snapshot / restore (the gateway's drain/restore cycle) -------

@@ -318,9 +318,14 @@ def run_openloop(
     # reports its own compile delta — a flood arm that silently paid a
     # recompile storm would otherwise launder it into aggregate wall time.
     from ..obs import compile_ledger as _cl
+    from ..obs import memory as _obs_memory
 
     _led = _cl.current()
     _led_tok = _led.seq() if _led is not None else 0
+    # Same per-arm convention for the memory ledger: enabled process-wide
+    # (serve --memory-ledger / the bench memory section), every open-loop
+    # arm reports its own leak/watermark view.
+    _mled = _obs_memory.current()
     try:
         from ..gateway.traces import make_fleet_from_spec
 
@@ -404,6 +409,18 @@ def run_openloop(
                     1 for e in arm_events if e.get("storm")
                 ),
                 "entries": sorted({e["entry"] for e in arm_events}),
+            }
+        if _mled is not None:
+            # Per-arm memory view (one forced end-of-arm sample — the
+            # schedule has drained, so this is the flood's true residue):
+            # a flood whose queued-up ticks silently pinned live arrays
+            # would otherwise launder the growth into process-level RSS
+            # noise.
+            _mled.sample(force=True)
+            report["mem"] = {
+                "leak": _mled.leak_report(),
+                "watermarks": _mled.summary()["watermarks"],
+                "headroom_bytes": _mled.headroom_bytes(),
             }
         if engine is not None:
             report["slo"] = {

@@ -554,6 +554,82 @@ def main() -> int:
         )
     finally:
         compile_ledger.disable()
+
+    # ------------------------------------------------------------------
+    # 17. Memory ledger: the axis that decides how far any of this
+    #     scales. First, the analytic model (ops/memmodel.py — the SAME
+    #     formulas the bench's fleet_scale section skips arms on)
+    #     diagnoses the IPM's M=4096 infeasibility WITHOUT running it:
+    #     the factorizing engine's beam-batched (m, m) normal matrices
+    #     are ~14.5 GB at M=4096 — nearly 2x the 8 GB HBM-class cap,
+    #     and that analytic figure is a LOWER bound (the bench's memory
+    #     section measures XLA temp bytes at ~7-8x the proxy for the
+    #     full IPM executable); the matrix-free PDHG's one (m, n)
+    #     operator is ~1.2 GB. Then the live half: enable the
+    #     ledger and watch live-array bytes across cold -> warm -> spec
+    #     ticks — provisioning happens at the cold tick, and the warm
+    #     path stays FLAT (the zero-leak gate `make smoke-memory` and
+    #     the bench pin absolutely; README "Memory observability").
+    # ------------------------------------------------------------------
+    from distilp_tpu.obs import memory as obs_memory
+    from distilp_tpu.ops import memmodel
+
+    M_big2 = 4096
+    print(
+        f"[17] analytic model at M={M_big2}: ipm needs "
+        f"~{memmodel.peak_gb(M_big2, 'ipm'):.0f} GB (beam-batched normal "
+        f"matrices), pdhg ~{memmodel.peak_gb(M_big2, 'pdhg'):.1f} GB "
+        "(one matrix-free operator)"
+    )
+    print(
+        f"[17] fleet_scale's skip verdict, without solving: ipm is "
+        f"{memmodel.ipm_memory_infeasible(M_big2, 8.0)}"
+    )
+
+    led = obs_memory.enable(
+        obs_memory.MemoryLedger(sample_min_interval_s=0.0)
+    )
+    try:
+        sched = Scheduler(
+            make_synthetic_fleet(4, seed=11), spec_model, mip_gap=1e-3,
+            kv_bits="4bit", backend="jax", k_candidates=[8, 10],
+            speculative=True,
+        )
+        marks = []
+        for i, ev in enumerate(spec_events[:16]):
+            view = sched.handle(ev)
+            rec = led.sample(force=True)
+            marks.append((view.mode, rec["live_bytes"]))
+            if i == 4:
+                led.mark_warm()  # cold + warm layouts + scenario batch in
+        first_spec = next(
+            (i for i, (m, _) in enumerate(marks) if m == "spec"), None
+        )
+        spec_bytes = marks[
+            first_spec if first_spec is not None else -1
+        ][1]
+        print(
+            f"[17] live-array bytes: cold tick {marks[0][1]} B -> "
+            f"warm tick {marks[2][1]} B -> spec tick {spec_bytes} B "
+            f"(modes: {' '.join(m for m, _ in marks[:8])} ...)"
+        )
+        leak = led.leak_report()
+        entry = led.analyses.get("solver._solve_packed", {})
+        mem = entry.get("memory") or {}
+        flops = entry.get("flops")
+        growth = f"{leak['growth_bytes']:+d} B" if leak else "n/a"
+        print(
+            f"[17] leak gate across the warm/spec phase: "
+            f"{'FLAT' if leak and leak['flat'] else 'GREW'} "
+            f"({growth}); static model for solver._solve_packed: "
+            f"temp={(mem.get('temp_bytes') or 0) / 1e6:.2f} MB, "
+            f"flops={f'{flops:.3g}' if flops is not None else 'n/a'}"
+            f"/dispatch; headroom "
+            f"{(led.headroom_bytes() or 0) / 1e9:.1f} GB"
+        )
+        sched.close()
+    finally:
+        obs_memory.disable()
     return 0
 
 

@@ -1496,6 +1496,67 @@ def test_slo_and_timeline_modules_are_currently_clean():
 
 
 # --------------------------------------------------------------------------
+# obs/memory.py (the memory ledger) joins the same obs-layer contracts:
+# lazy-jax (DLP013), accounted excepts (DLP017), registered metric names
+# (DLP019) — fixture-pinned per module so the prefix coverage cannot
+# silently regress out from under it. ops/memmodel.py rides the repo-wide
+# contracts (no bare asserts, guarded entry points don't apply).
+
+
+def test_memory_module_joins_lazy_jax_contract():
+    # The exact temptation this module must resist: live_array_bytes
+    # wants jax at module level; the obs layer must stay importable
+    # without a backend.
+    out = findings_for("DLP013", "distilp_tpu/obs/memory.py", """\
+        import jax
+
+        def live_array_bytes():
+            return sum(a.nbytes for a in jax.live_arrays())
+        """)
+    assert len(out) == 1 and "lazy" in out[0].message
+
+
+def test_memory_module_joins_silent_except_contract():
+    # A swallowed watermark failure is an invisible observability
+    # outage — the same failure mode the sampler rule exists for.
+    out = findings_for("DLP017", "distilp_tpu/obs/memory.py", """\
+        def sample(self):
+            try:
+                return self._walk()
+            except Exception:
+                return None
+        """)
+    assert len(out) == 1 and "metrics sink" in out[0].message
+
+
+def test_memory_module_joins_metric_registry_contract():
+    out = findings_for("DLP019", "distilp_tpu/obs/memory.py", """\
+        def _note(self):
+            self.metrics.inc("mem_totally_unregistered")
+        """)
+    assert len(out) == 1 and "METRIC_REGISTRY" in out[0].message
+    # The real counters ARE registered: the scheduler's watermark note
+    # and the gateway's headroom-pressure note both resolve.
+    ok = findings_for("DLP019", "distilp_tpu/obs/memory.py", """\
+        def _note(self, pressure):
+            self.metrics.inc("mem_pressure" if pressure else "mem_samples")
+        """)
+    assert ok == []
+
+
+def test_memory_and_memmodel_modules_are_currently_clean():
+    """The REAL obs/memory.py + ops/memmodel.py pass their layers'
+    contracts (lazy jax, accounted-or-justified excepts, registered
+    literal counters, no bare asserts)."""
+    from pathlib import Path
+
+    for mod in ("distilp_tpu/obs/memory.py", "distilp_tpu/ops/memmodel.py"):
+        src = Path(mod).read_text()
+        for code in ("DLP012", "DLP013", "DLP017", "DLP019"):
+            assert findings_for(code, mod, src) == [], (mod, code)
+
+
+# --------------------------------------------------------------------------
 # DLP020 — jax.jit sites must be module-level + ledger-registered
 
 

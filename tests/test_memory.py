@@ -1,0 +1,523 @@
+"""Memory ledger (obs/memory.py) + analytic model (ops/memmodel.py).
+
+Two tiers, the compile-ledger test economics:
+
+- **Unit tier** (no solver): the /proc parsers (fixture texts including
+  the missing-VmHWM kernel this repo's own CI runs on), the analytic
+  model's parity with the formulas bench.py used inline before PR 15,
+  graceful ``None`` when a backend reports no memory stats, sample
+  throttling, the leak gate's structural re-pin, and the byte-stable
+  JSONL round trip.
+- **Solver tier**: real schedulers on the JAX CPU backend pin the tick
+  attribution (span attrs + flight records + counters + mem.* timeline
+  series), the additive-only contract (a live ledger changes no
+  pre-existing counter), and THE invariant this module exists to guard:
+  live-array bytes are FLAT across >= 100 steady-state warm ticks — on
+  both LP engines.
+
+Every test that enables a ledger disables it in a finally: the ledger
+(and its dispatch hook) is process-global, exactly like the compile
+ledger's.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from distilp_tpu.obs import memory
+from distilp_tpu.obs import compile_ledger as cl
+from distilp_tpu.obs.memory import (
+    MemoryLedger,
+    memory_from_jsonl,
+    memory_to_jsonl,
+    parse_proc_status,
+    read_proc_status,
+    render_report,
+)
+from distilp_tpu.ops import memmodel
+
+GAP = 1e-3
+KS = [4, 8]
+
+# A real-shaped /proc/self/status excerpt (Linux) and the container
+# kernel variant this repo's CI actually runs on: VmHWM absent entirely.
+_STATUS_FULL = (
+    "Name:\tpython\n"
+    "VmPeak:\t  200000 kB\n"
+    "VmSize:\t  150000 kB\n"
+    "VmHWM:\t   99184 kB\n"
+    "VmRSS:\t   98304 kB\n"
+    "Threads:\t12\n"
+)
+_STATUS_NO_HWM = "Name:\tpython\nVmRSS:\t   6888 kB\nThreads:\t2\n"
+
+
+# -- unit tier: /proc parsing -------------------------------------------------
+
+
+def test_parse_proc_status_full():
+    out = parse_proc_status(_STATUS_FULL)
+    assert out == {
+        "rss_bytes": 98304 * 1024,
+        "hwm_bytes": 99184 * 1024,
+    }
+
+
+def test_parse_proc_status_missing_hwm_is_none_not_zero():
+    out = parse_proc_status(_STATUS_NO_HWM)
+    assert out["rss_bytes"] == 6888 * 1024
+    assert out["hwm_bytes"] is None  # absent, never fabricated as 0
+
+
+def test_parse_proc_status_garbage_lines_parse_to_none():
+    out = parse_proc_status("VmRSS:\tnot-a-number kB\nVmHWM:\n")
+    assert out == {"rss_bytes": None, "hwm_bytes": None}
+    assert parse_proc_status("") == {"rss_bytes": None, "hwm_bytes": None}
+
+
+def test_read_proc_status_missing_file_is_all_none():
+    assert read_proc_status("/definitely/not/a/proc/path") == {
+        "rss_bytes": None,
+        "hwm_bytes": None,
+    }
+
+
+def test_read_meminfo_total(tmp_path):
+    p = tmp_path / "meminfo"
+    p.write_text("MemTotal:       139460608 kB\nMemFree: 1 kB\n")
+    assert memory.read_meminfo_total(str(p)) == 139460608 * 1024
+    assert memory.read_meminfo_total("/not/a/path") is None
+
+
+# -- unit tier: the analytic model (ops/memmodel.py) --------------------------
+
+
+@pytest.mark.parametrize("M", [16, 48, 512, 1024, 2048, 4096])
+def test_memmodel_parity_with_the_old_inline_formulas(M):
+    """PR 15 factored the fleet_scale proxies out of bench.py; the
+    factored model must reproduce the inline formulas EXACTLY (these
+    numbers decide which bench arms even run)."""
+    beam = 6
+    m_rows = 6 * M + 3
+    assert memmodel.standard_form_dims(M) == (m_rows, 3 * M)
+    assert memmodel.ipm_peak_bytes(M) == beam * m_rows * m_rows * 4
+    assert memmodel.pdhg_peak_bytes(M) == m_rows * 3 * M * 4
+    assert memmodel.peak_gb(M, "ipm") == pytest.approx(
+        beam * m_rows * m_rows * 4 / 1e9
+    )
+    assert memmodel.peak_gb(M, "pdhg") == pytest.approx(
+        m_rows * 3 * M * 4 / 1e9
+    )
+
+
+def test_memmodel_skip_decision_matches_the_old_bench_message():
+    # The exact string fleet_scale rows carried before the factoring.
+    reason = memmodel.ipm_memory_infeasible(4096, 8.0)
+    gb = memmodel.peak_gb(4096, "ipm")
+    assert reason == (
+        f"memory-infeasible (~{gb:.1f} GB batched normal matrices "
+        "> 8 GB cap)"
+    )
+    assert memmodel.ipm_memory_infeasible(512, 8.0) is None
+
+
+def test_memmodel_rejects_bad_inputs():
+    with pytest.raises(ValueError, match="fleet size"):
+        memmodel.standard_form_dims(0)
+    with pytest.raises(ValueError, match="unknown LP engine"):
+        memmodel.peak_bytes(16, "simplex")
+
+
+# -- unit tier: ledger mechanics ----------------------------------------------
+
+
+@pytest.fixture()
+def ledger():
+    led = MemoryLedger(sample_min_interval_s=0.0)
+    memory.enable(led)
+    try:
+        yield led
+    finally:
+        memory.disable()
+
+
+class _FakeCompiled:
+    def __init__(self, mem, cost):
+        self._mem, self._cost = mem, cost
+
+    def memory_analysis(self):
+        if isinstance(self._mem, Exception):
+            raise self._mem
+        return self._mem
+
+    def cost_analysis(self):
+        return self._cost
+
+
+class _FakeLowered:
+    def __init__(self, compiled):
+        self._compiled = compiled
+
+    def compile(self):
+        return self._compiled
+
+
+class _FakeJit:
+    """Stand-in for a jitted callable with an AOT surface."""
+
+    def __init__(self, mem=None, cost=None):
+        self.compiled = _FakeCompiled(mem, cost or [])
+
+    def __call__(self, *a, **k):
+        return a
+
+    def lower(self, *a, **k):
+        return _FakeLowered(self.compiled)
+
+
+class _Stats:
+    """memory_analysis()-shaped object (attribute access)."""
+
+    temp_size_in_bytes = 1000
+    argument_size_in_bytes = 200
+    output_size_in_bytes = 30
+    alias_size_in_bytes = 0
+    generated_code_size_in_bytes = 4
+    host_temp_size_in_bytes = 0
+
+
+def test_analysis_records_memory_and_flops(ledger):
+    fn = cl.instrument(
+        "tests.mem.fake",
+        _FakeJit(mem=_Stats(), cost=[{"flops": 7.0, "bytes accessed": 9.0}]),
+    )
+    fn(1, 2)
+    rec = ledger.analyses["tests.mem.fake"]
+    assert rec["memory"]["temp_bytes"] == 1000
+    assert rec["memory"]["argument_bytes"] == 200
+    assert rec["flops"] == 7.0 and rec["bytes_accessed"] == 9.0
+    assert rec["error"] is None
+    assert ledger.dispatches["tests.mem.fake"] == 1
+    # Analyzed ONCE: a second dispatch only counts.
+    fn(1, 2)
+    assert ledger.dispatches["tests.mem.fake"] == 2
+    assert ledger.analysis_errors == 0
+
+
+def test_analysis_none_when_backend_reports_nothing(ledger):
+    """The graceful-None contract: memory_analysis() returning None (a
+    backend without buffer-assignment stats) records an entry with
+    memory=None and NO error — absent, never zeroed, never fatal."""
+    fn = cl.instrument("tests.mem.none", _FakeJit(mem=None, cost=[{"flops": 1.0}]))
+    fn(1)
+    rec = ledger.analyses["tests.mem.none"]
+    assert rec["memory"] is None
+    assert rec["error"] is None
+    assert rec["flops"] == 1.0
+    # And raising memory_analysis() is counted + surfaced, still not fatal.
+    fn2 = cl.instrument(
+        "tests.mem.raises", _FakeJit(mem=NotImplementedError("no stats"))
+    )
+    fn2(1)
+    rec2 = ledger.analyses["tests.mem.raises"]
+    assert rec2["memory"] is None
+    assert rec2["error"] == "memory_analysis() unsupported"
+    assert ledger.analysis_errors >= 1
+
+
+def test_analysis_graceful_without_aot_lower(ledger):
+    # Plain callables (the compile ledger's unit-tier stand-ins) have no
+    # .lower: the entry records an explicit error, dispatch unaffected.
+    fn = cl.instrument("tests.mem.plain", lambda x: x + 1)
+    assert fn(41) == 42
+    rec = ledger.analyses["tests.mem.plain"]
+    assert rec["memory"] is None and "lower" in rec["error"]
+
+
+def test_dispatch_hook_dormant_without_ledger():
+    assert memory.current() is None
+    fn = cl.instrument("tests.mem.dormant", _FakeJit(mem=_Stats()))
+    fn(1)
+    led = memory.enable(MemoryLedger())
+    try:
+        assert "tests.mem.dormant" not in led.analyses  # pre-enable call
+        assert led.dispatches.get("tests.mem.dormant") is None
+    finally:
+        memory.disable()
+
+
+def test_sample_throttle_returns_cached_between_intervals():
+    led = MemoryLedger(sample_min_interval_s=3600.0)
+    first = led.sample()
+    assert first["fresh"] is True
+    second = led.sample()
+    assert second["fresh"] is False  # cached: inside the throttle window
+    forced = led.sample(force=True)
+    assert forced["fresh"] is True
+    assert led.sample_count == 2  # the cached read counted no sample
+
+
+def test_leak_gate_and_structural_repin():
+    led = MemoryLedger(sample_min_interval_s=0.0)
+    # Before mark_warm: no verdict, and note_structural is a no-op.
+    assert led.leak_report() is None
+    led.note_structural()
+    assert led.leak_report() is None
+    led.mark_warm()
+    rep = led.leak_report()
+    assert rep is not None and rep["flat"] and rep["growth_bytes"] == 0
+    # Simulate growth: a fake newer sample with more live bytes.
+    led._last = dict(led._last, live_bytes=led._last["live_bytes"] + 512)
+    assert led.leak_report()["flat"] is False
+    assert led.leak_report()["growth_bytes"] == 512
+    assert led.leak_report(tolerance_bytes=512)["flat"] is True
+    # A structural boundary re-pins: growth across it is provisioning.
+    led.note_structural()
+    assert led.leak_report()["flat"] is True
+
+
+def test_headroom_budget_semantics():
+    led = MemoryLedger(budget_bytes=None)
+    assert led.headroom_bytes() is None  # no budget, no fabricated number
+    led2 = MemoryLedger(budget_bytes=1 << 40)
+    hr = led2.headroom_bytes()
+    rss = read_proc_status()["rss_bytes"]
+    if rss is None:
+        assert hr is None
+    else:
+        assert hr is not None and 0 < hr < float(1 << 40)
+
+
+def test_timeline_series_absent_not_zero():
+    led = MemoryLedger(sample_min_interval_s=0.0, budget_bytes=1 << 40)
+    series = led.timeline_series()
+    status = read_proc_status()
+    if status["rss_bytes"] is not None:
+        assert series["mem.rss_bytes"] > 0
+    if status["hwm_bytes"] is None:
+        # This repo's CI kernel has no VmHWM: the series must be ABSENT,
+        # not a zero-valued lie.
+        assert "mem.hwm_bytes" not in series
+    assert all(isinstance(v, float) for v in series.values())
+
+
+def test_jsonl_round_trip_byte_stable_and_report_deterministic(ledger):
+    fn = cl.instrument("tests.mem.dump", _FakeJit(mem=_Stats(), cost=[{"flops": 2.0}]))
+    fn(1)
+    ledger.sample(force=True)
+    ledger.mark_warm()
+    text = ledger.to_jsonl()
+    dump = memory_from_jsonl(text)
+    assert memory_to_jsonl(dump) == text
+    r1 = render_report(dump)
+    r2 = render_report(memory_from_jsonl(text))
+    assert r1 == r2
+    assert "tests.mem.dump" in r1 and "leak gate: FLAT" in r1
+
+
+def test_from_jsonl_rejects_bad_dumps():
+    with pytest.raises(ValueError, match="empty"):
+        memory_from_jsonl("")
+    with pytest.raises(ValueError, match="header"):
+        memory_from_jsonl('{"not": "a header"}')
+    with pytest.raises(ValueError, match="version"):
+        memory_from_jsonl('{"memory_ledger": 99}')
+
+
+def test_enable_resolves_budget_and_disable_detaches():
+    led = memory.enable()
+    try:
+        assert memory.current() is led
+        # Budget resolved from MemTotal where /proc exists.
+        assert led.budget_bytes == memory.read_meminfo_total()
+    finally:
+        assert memory.disable() is led
+        assert memory.current() is None
+
+
+# -- solver tier: serving-path attribution ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    from distilp_tpu.profiler.api import profile_model
+
+    return profile_model(
+        "tests/configs/llama31_8b_4bit.json", batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+
+
+@pytest.fixture()
+def fleet():
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    return make_synthetic_fleet(4, seed=11)
+
+
+def make_scheduler(fleet, model, **kw):
+    from distilp_tpu.sched import Scheduler
+
+    kw.setdefault("mip_gap", GAP)
+    kw.setdefault("kv_bits", "4bit")
+    kw.setdefault("backend", "jax")
+    kw.setdefault("k_candidates", KS)
+    return Scheduler(fleet, model, **kw)
+
+
+def _drift_events(fleet, n, seed=5):
+    from distilp_tpu.sched.sim import generate_trace
+
+    return generate_trace("drift", n, seed=seed, base_fleet=fleet)
+
+
+def test_ledger_off_path_is_byte_identical(fleet, model):
+    """The additive-only pin: the same trace replayed with and without a
+    live memory ledger produces IDENTICAL non-mem counters, and the mem
+    counters/hists exist ONLY on the ledgered run."""
+    events = _drift_events(fleet, 6)
+    assert memory.current() is None
+    sched_off = make_scheduler(fleet, model)
+    for ev in events:
+        sched_off.handle(ev)
+    off = dict(sched_off.metrics.counters)
+    sched_off.close()
+    assert "mem_samples" not in off
+    assert "mem_live_mb" not in sched_off.metrics.hists
+
+    from distilp_tpu.utils import make_synthetic_fleet
+
+    led = memory.enable(MemoryLedger(sample_min_interval_s=0.0))
+    try:
+        sched_on = make_scheduler(make_synthetic_fleet(4, seed=11), model)
+        for ev in events:
+            sched_on.handle(ev)
+        on = dict(sched_on.metrics.counters)
+        sched_on.close()
+    finally:
+        memory.disable()
+    assert on.pop("mem_samples", 0) > 0
+    assert off == on  # every pre-existing counter untouched
+
+
+def test_tick_attribution_spans_flight_timeline(fleet, model):
+    from distilp_tpu.obs.flight import FlightRecorder
+    from distilp_tpu.obs.trace import Tracer
+
+    led = memory.enable(MemoryLedger(sample_min_interval_s=0.0))
+    try:
+        tracer = Tracer(capacity=256)
+        flight = FlightRecorder()
+        sched = make_scheduler(fleet, model, tracer=tracer, flight=flight)
+        for ev in _drift_events(fleet, 3):
+            sched.handle(ev)
+        c = sched.metrics.counters
+        assert c["mem_samples"] > 0
+        assert sched.metrics.hists["mem_live_mb"].count == c["mem_samples"]
+        recs = [r for r in flight.snapshot("default") if "mem" in r]
+        assert recs, "no flight record carries the mem watermark"
+        assert all(r["mem"]["live_bytes"] > 0 for r in recs)
+        # Solved ticks carry the watermark on the sched.solve span.
+        solve_spans = [
+            s for s in tracer.spans()
+            if s["name"] == "sched.solve" and "mem_live_bytes" in s["attrs"]
+        ]
+        assert solve_spans
+        # The per-entry static model rode the first Python-side dispatch.
+        assert "solver._solve_packed" in led.analyses
+        rec = led.analyses["solver._solve_packed"]
+        assert rec["memory"] is not None
+        assert rec["memory"]["temp_bytes"] > 0
+        assert rec["flops"] and rec["flops"] > 0
+        # Timeline series carry the watermark gauges while enabled.
+        sample = sched.timeline_sample()
+        assert sample["mem.live_bytes"] > 0
+        assert sample["mem.rss_bytes"] > 0
+        sched.close()
+    finally:
+        memory.disable()
+
+
+def test_structural_tick_repins_leak_baseline(fleet, model):
+    from distilp_tpu.sched.events import DeviceLeave
+
+    led = memory.enable(MemoryLedger(sample_min_interval_s=0.0))
+    try:
+        sched = make_scheduler(fleet, model)
+        for ev in _drift_events(fleet, 3):
+            sched.handle(ev)
+        led.mark_warm()
+        # A structural event (identity change) legitimately re-allocates;
+        # the scheduler re-pins the baseline so it reads as provisioning.
+        sched.handle(DeviceLeave(name=fleet[3].name))
+        led.sample(force=True)
+        rep = led.leak_report()
+        assert rep is not None and rep["flat"], rep
+        sched.close()
+    finally:
+        memory.disable()
+
+
+def test_gateway_mem_pressure_degrades_on_low_headroom():
+    """The degrade-on-low-headroom admission hint: headroom below the
+    floor marks ingest under pressure (counted as mem_pressure); no
+    ledger, or headroom above the floor, never does — degrade on
+    EVIDENCE, never on absence."""
+    from distilp_tpu.gateway import Gateway
+
+    gw = Gateway(
+        n_workers=1,
+        scheduler_factory=lambda d, m: None,
+        mem_degrade_headroom_bytes=float(1 << 50),
+    )
+    try:
+        assert gw._admission  # the knob alone engages the admission path
+        assert gw._mem_pressure() is False  # no ledger: no verdict
+        led = memory.enable(MemoryLedger(budget_bytes=1 << 40))
+        try:
+            if led.headroom_bytes() is None:
+                pytest.skip("no readable RSS on this platform")
+            # Floor of 1 PiB vs a 1 TiB budget: always under pressure.
+            assert gw._mem_pressure() is True
+            assert gw.metrics.counters["mem_pressure"] == 1
+            # A generous floor clears it.
+            gw.mem_degrade_headroom_bytes = 1.0
+            assert gw._mem_pressure() is False
+        finally:
+            memory.disable()
+        gw.mem_degrade_headroom_bytes = None
+        assert gw._mem_pressure() is False
+    finally:
+        gw.close()
+
+
+@pytest.mark.parametrize("lp_backend", ["ipm", "pdhg"])
+def test_warm_serving_never_leaks_100_ticks(fleet, model, lp_backend):
+    """THE zero-leak regression pin: across >= 100 steady-state warm
+    drift ticks (speculation on — its bank donations and presolves
+    included), live-array bytes show ZERO net growth, on both LP
+    engines. This is the memory twin of the zero-recompile pin: a warm
+    tick that pins arrays compounds into an OOM at fleet scale, and
+    until now nothing would have caught it."""
+    events = _drift_events(fleet, 105, seed=7)
+    led = memory.enable(MemoryLedger())
+    try:
+        sched = make_scheduler(
+            fleet, model, speculative=True, lp_backend=lp_backend
+        )
+        for ev in events[:5]:  # cold + warm layouts + scenario batch
+            sched.handle(ev)
+        led.mark_warm()
+        for ev in events[5:]:
+            sched.handle(ev)
+        led.sample(force=True)
+        rep = led.leak_report()
+        assert rep is not None
+        assert rep["growth_bytes"] <= 0, (
+            f"warm serving grew live-array bytes under {lp_backend}: "
+            f"{rep['baseline_bytes']} -> {rep['last_bytes']} "
+            f"({rep['growth_bytes']:+d} B over {len(events) - 5} ticks)"
+        )
+        sched.close()
+    finally:
+        memory.disable()

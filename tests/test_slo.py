@@ -334,6 +334,39 @@ def test_build_signals_schema_trend_and_headroom():
     assert sig.slos[0].slo == "avail" and sig.slos[0].firing == []
     # Burn keys exist per configured window.
     assert set(sig.slos[0].burn) == {"10s"}
+    # No memory ledger live: the memory field is honestly absent (None),
+    # and the schema still validates (the additive-field contract).
+    assert sig.mem_headroom_bytes is None
+
+
+def test_build_signals_mem_headroom_rides_a_live_memory_ledger():
+    """PR 15's additive /signals field: with a memory ledger enabled the
+    payload carries mem_headroom_bytes = budget - RSS, schema-validated
+    at version 1 (old consumers unaffected, the federation tier gets the
+    scale-up-has-memory signal for free)."""
+    from distilp_tpu.obs import memory as obs_memory
+
+    tl = Timeline()
+    tl.record_many(0.0, {"c.gateway_events": 0.0})
+    tl.record_many(30.0, {"c.gateway_events": 300.0})
+    led = obs_memory.enable(
+        obs_memory.MemoryLedger(budget_bytes=1 << 40)
+    )
+    try:
+        sig = build_signals(tl, capacity_eps=25.0, now=30.0)
+        payload = SignalsPayload.model_validate(sig.model_dump())
+        assert payload.version == 1
+        rss = obs_memory.read_proc_status()["rss_bytes"]
+        if rss is None:
+            assert payload.mem_headroom_bytes is None
+        else:
+            assert payload.mem_headroom_bytes is not None
+            assert 0 < payload.mem_headroom_bytes < float(1 << 40)
+            assert payload.mem_headroom_bytes == pytest.approx(
+                led.headroom_bytes(), rel=0.05
+            )
+    finally:
+        obs_memory.disable()
 
 
 # -- the sampler -------------------------------------------------------------

@@ -90,6 +90,69 @@ def test_json_once_smoke_cpu_backend(monkeypatch, capsys, tmp_path):
     assert "probe #1" in out.err
 
 
+_MEM_PROBE_STDOUT = (
+    'DPERF_MEM {"devices": ['
+    '{"id": 0, "platform": "tpu", "kind": "TPU v5e", "memory_stats": '
+    '{"bytes_in_use": 1048576, "bytes_limit": 17179869184, '
+    '"peak_bytes_in_use": 2097152}}, '
+    '{"id": 1, "platform": "tpu", "kind": "TPU v5e", "memory_stats": '
+    '{"bytes_in_use": 524288, "bytes_limit": 17179869184, '
+    '"peak_bytes_in_use": 1048576}}]}\n'
+)
+
+
+def test_probe_device_memory_sums_hbm_stats(monkeypatch):
+    monkeypatch.setattr(tw, "_run", lambda cmd, t, env=None: (0, _MEM_PROBE_STDOUT, ""))
+    block = tw.probe_device_memory(5.0)
+    assert block is not None
+    assert len(block["devices"]) == 2
+    assert block["hbm_limit_bytes_total"] == 2 * 17179869184
+    assert block["hbm_in_use_bytes_total"] == 1048576 + 524288
+    assert block["hbm_peak_bytes_total"] == 2097152 + 1048576
+
+
+def test_probe_device_memory_absent_on_cpu_only(monkeypatch):
+    # The CPU backend's memory_stats() is None -> the child emits devices
+    # WITHOUT a memory_stats key -> the block is ABSENT, never zeroed.
+    cpu_out = 'DPERF_MEM {"devices": [{"id": 0, "platform": "cpu", "kind": "cpu"}]}\n'
+    monkeypatch.setattr(tw, "_run", lambda cmd, t, env=None: (0, cpu_out, ""))
+    assert tw.probe_device_memory(5.0) is None
+    # A wedged/failed probe child is also an absence, not a crash.
+    monkeypatch.setattr(tw, "_run", lambda cmd, t, env=None: (None, "", ""))
+    assert tw.probe_device_memory(5.0) is None
+    monkeypatch.setattr(tw, "_run", lambda cmd, t, env=None: (0, "DPERF_MEM not-json\n", ""))
+    assert tw.probe_device_memory(5.0) is None
+
+
+def test_json_cpu_probe_has_no_memory_block(monkeypatch, capsys, tmp_path):
+    _isolate_captures(monkeypatch, tmp_path)
+    cpu = "DPERF_PHASE interp\nDPERF_PROBE cpu 1\n"
+    monkeypatch.setattr(bench, "_run_probe_once", lambda t: (0, cpu, ""))
+    tw.main(["--once", "--json", "--probe-timeout", "1"])
+    payload = json.loads(capsys.readouterr().out)
+    # A cpu-only probe never opened a TPU window: the memory block must
+    # be absent (not zeroed) — same contract as the ledger's gauges.
+    assert "memory" not in payload
+
+
+def test_json_live_window_carries_memory_block(monkeypatch, capsys, tmp_path):
+    _isolate_captures(monkeypatch, tmp_path)
+    monkeypatch.setattr(
+        bench, "_run_probe_once", lambda t: (0, _LIVE_STDOUT, "")
+    )
+    monkeypatch.setattr(tw, "_run", lambda cmd, t, env=None: (0, _MEM_PROBE_STDOUT, ""))
+    # Captures are stubbed failures: the watcher must still report the
+    # HBM stats it grabbed while the window was open.
+    monkeypatch.setattr(tw, "_capture_bench", lambda t: False)
+    monkeypatch.setattr(tw, "_capture_fixtures", lambda t: False)
+    rc = tw.main(["--once", "--json", "--probe-timeout", "1"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert payload["memory"]["hbm_limit_bytes_total"] == 2 * 17179869184
+    assert len(payload["memory"]["devices"]) == 2
+    assert "tpu_error" not in payload  # a live window is not an error
+
+
 def test_json_wedged_emits_bench_shaped_tpu_error(monkeypatch, capsys, tmp_path):
     _isolate_captures(monkeypatch, tmp_path)
     partial = (

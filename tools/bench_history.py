@@ -62,6 +62,10 @@ HISTORY_KEYS = (
     "compile_warm_phase_count",
     "compile_cache_hit_rate",
     "compile_overhead_pct",
+    "memory_overhead_pct",
+    "memory_leak_bytes",
+    "mem_calibration_ratio_ipm",
+    "mem_calibration_ratio_pdhg",
 )
 
 

@@ -95,6 +95,73 @@ def probe_attempt(timeout_s: float, attempt: int = 0) -> tuple[str | None, dict]
     return platform, rec
 
 
+# Device-memory probe child: runs in the SAME wedge-contained subprocess
+# discipline as every other backend touch in this tool (this process must
+# never init a backend — a dead tunnel wedges it forever). Emits one
+# machine line with per-device memory_stats; devices that report none
+# (the CPU backend) are recorded without a memory_stats key.
+_MEM_PROBE_SRC = r"""
+import json
+import jax
+devs = []
+for d in jax.local_devices():
+    rec = {"id": d.id, "platform": d.platform,
+           "kind": getattr(d, "device_kind", None)}
+    stats = d.memory_stats()
+    if stats:
+        rec["memory_stats"] = {
+            k: int(v) for k, v in stats.items()
+            if isinstance(v, (int, float))
+        }
+    devs.append(rec)
+print("DPERF_MEM", json.dumps({"devices": devs}))
+"""
+
+
+def probe_device_memory(timeout_s: float) -> dict | None:
+    """Per-device HBM totals from ``jax.local_devices()`` memory stats,
+    via a contained child. None when no device reports memory stats —
+    the CPU backend's ``memory_stats()`` is None, so a cpu-only probe
+    yields an ABSENT memory block (absent, not zeroed: a fabricated
+    0-byte HBM row would read as an empty accelerator, which is a much
+    worse lie than no row)."""
+    rc, stdout, _stderr = _run(
+        [sys.executable, "-c", _MEM_PROBE_SRC], timeout_s
+    )
+    if rc != 0:
+        return None
+    line = next(
+        (ln for ln in stdout.splitlines() if ln.startswith("DPERF_MEM ")),
+        None,
+    )
+    if line is None:
+        return None
+    try:
+        got = json.loads(line[len("DPERF_MEM "):])
+    except json.JSONDecodeError:
+        return None
+    devices = [
+        d for d in got.get("devices", []) if d.get("memory_stats")
+    ]
+    if not devices:
+        return None
+    limit = sum(
+        d["memory_stats"].get("bytes_limit", 0) for d in devices
+    )
+    in_use = sum(
+        d["memory_stats"].get("bytes_in_use", 0) for d in devices
+    )
+    peak = sum(
+        d["memory_stats"].get("peak_bytes_in_use", 0) for d in devices
+    )
+    return {
+        "devices": devices,
+        "hbm_limit_bytes_total": limit or None,
+        "hbm_in_use_bytes_total": in_use or None,
+        "hbm_peak_bytes_total": peak or None,
+    }
+
+
 def _capture_bench(timeout_s: float) -> bool:
     """Run bench.py; persist the JSON line iff it ran on the TPU."""
     # Single attempt, no retries: the window is open NOW; if the tunnel
@@ -232,6 +299,11 @@ def main(argv=None) -> int:
     global _JSON_MODE
     _JSON_MODE = bool(args.json)
     attempts: list[dict] = []
+    # Per-device HBM stats, captured once per run on the first live
+    # window. A cpu-only run leaves this None and the --json payload's
+    # memory block ABSENT (not zeroed) — same contract as the memory
+    # ledger's watermark gauges.
+    mem_state: dict = {"memory": None}
 
     def _finish(rc: int, have_bench: bool, have_fixtures: bool) -> int:
         if args.json:
@@ -241,6 +313,8 @@ def main(argv=None) -> int:
                 "bench_captured": have_bench,
                 "fixtures_captured": have_fixtures,
             }
+            if mem_state["memory"] is not None:
+                payload["memory"] = mem_state["memory"]
             live = any(
                 a.get("platform") and not a["platform"].startswith("cpu")
                 for a in attempts
@@ -307,6 +381,16 @@ def main(argv=None) -> int:
                  f"{where}; sleeping {args.interval:.0f}s")
         else:
             _log(f"probe #{attempt}: LIVE backend platform={platform!r} — capturing")
+            if mem_state["memory"] is None:
+                # A live window is the one moment HBM stats exist to
+                # read; the probe is its own contained child, so a
+                # tunnel drop here costs one child, never the captures.
+                mem_state["memory"] = probe_device_memory(args.probe_timeout)
+                if mem_state["memory"] is not None:
+                    _log(
+                        "captured per-device HBM stats "
+                        f"({len(mem_state['memory']['devices'])} device(s))"
+                    )
             if not have_bench and _capture_bench(args.bench_timeout):
                 have_bench = _commit(
                     [str(BENCH_OUT.relative_to(REPO))],
