@@ -18,7 +18,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory smoke-combine
 	python -m pytest tests/ -q
 
 # `make bench` also appends the run's headline keys as one line of
@@ -263,6 +263,26 @@ smoke-slo: lint-strict
 		--max-queue-depth 2 --check --expect-sheds \
 		--slo tests/traces/slo_live_spec.json --settle-s 3 \
 		--expect-alert page --quiet
+
+# Combine smoke: the committed diurnal+burst capture replayed with
+# cross-shard batching ON (coalesce folds a shard's burst into one tick;
+# combine packs pending ticks from MANY shards into padded device
+# batches solved by one _solve_batched dispatch per bucket flush). The
+# contract (--expect-combined): combined batches actually served lanes,
+# ZERO ticks fell back to a per-shard solve, zero batched dispatches
+# raised, and — the committed-bucket-policy invariant — the measured
+# phase compiled NOTHING (warm_phase_events == 0: warm_combine traced
+# the whole reachable executable set, padded-M boundaries x quantized
+# lane counts x the root-warm signature flip, at the warm boundary).
+# Every served placement is structurally valid and nothing sheds.
+.PHONY: smoke-combine
+smoke-combine: lint-strict
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli overload \
+		--trace tests/traces/openloop_diurnal_burst.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 2 --k-candidates 8,10 --time-scale 0.001 \
+		--max-queue-depth 64 --coalesce --combine \
+		--check --expect-combined --expect-no-sheds --quiet
 
 # Compile-ledger smoke: the bundled 10-fleet gateway trace replayed with
 # the XLA compile ledger on (serve --compile-ledger-out). The contract:

@@ -1734,6 +1734,13 @@ def build_overload_parser() -> argparse.ArgumentParser:
         help="fold same-shard queued drift events into one solve",
     )
     p.add_argument(
+        "--combine", action="store_true",
+        help="batch pending ticks ACROSS shards into padded device "
+        "batches behind the coalescer (one _solve_batched dispatch per "
+        "bucket flush; README 'Cross-shard batched solving'); enables "
+        "the compile ledger so the zero-recompile gate is auditable",
+    )
+    p.add_argument(
         "--degrade-depth", type=int, default=None, metavar="N",
         help="queue depth past which speculative shards may serve a "
         "banked near-match (mode='spec_near'); pair with --speculate",
@@ -1763,6 +1770,13 @@ def build_overload_parser() -> argparse.ArgumentParser:
         "--expect-no-sheds", action="store_true",
         help="with --check: additionally fail if ANYTHING was shed (the "
         "coalesce smoke's contract: the flood folds instead of shedding)",
+    )
+    p.add_argument(
+        "--expect-combined", action="store_true",
+        help="with --check and --combine: fail unless combined batches "
+        "actually served lanes, nothing fell back to a per-shard solve, "
+        "and the measured phase compiled NOTHING (warm_phase_events == 0 "
+        "— the committed-bucket zero-recompile contract)",
     )
     p.add_argument(
         "--slo", default=None, metavar="SPEC.json",
@@ -1841,6 +1855,12 @@ def overload_main(argv=None) -> int:
         from ..obs import Timeline
 
         timeline = Timeline()
+    if args.combine:
+        # The zero-recompile gate needs the ambient ledger: run_openloop
+        # reads warm-phase compile events off compile_ledger.current().
+        from ..obs import compile_ledger as _compile_ledger
+
+        _compile_ledger.enable()
     # A recorder is always attached here: the --check reconciliation is
     # the point of the command, and sheds must be observable to audit.
     flight = FlightRecorder(capacity=max(256, 2 * len(items)))
@@ -1859,6 +1879,7 @@ def overload_main(argv=None) -> int:
         ),
         max_queue_depth=args.max_queue_depth,
         coalesce=args.coalesce,
+        combine=args.combine,
         degrade_depth=args.degrade_depth,
         flight=flight,
         slo_config=slo_config,
@@ -1907,6 +1928,37 @@ def overload_main(argv=None) -> int:
                 f"expected zero sheds but {report['shed']} event(s) were "
                 "shed (the flood should have folded, not overflowed)"
             )
+        if args.expect_combined:
+            comb = report.get("combine") or {}
+            if not comb.get("instances"):
+                problems.append(
+                    "expected combined batches but no lane was ever "
+                    "solved in one"
+                )
+            if comb.get("combine_fallback"):
+                problems.append(
+                    f"{comb['combine_fallback']} combined tick(s) fell "
+                    "back to a per-shard solve"
+                )
+            if comb.get("errors"):
+                problems.append(
+                    f"{comb['errors']} batched dispatch(es) raised"
+                )
+            warm_events = (report.get("compile") or {}).get(
+                "warm_phase_events"
+            )
+            if warm_events is None:
+                problems.append(
+                    "no warm-phase compile accounting in the report "
+                    "(compile ledger not enabled?)"
+                )
+            elif warm_events:
+                problems.append(
+                    f"{warm_events} compile event(s) in the measured "
+                    "phase — the committed bucket policy must make "
+                    "combined traffic compile NOTHING after warm_combine "
+                    f"(entries: {report['compile']['warm_phase_entries']})"
+                )
         if args.expect_alert:
             slo_rep = report.get("slo") or {}
             events = slo_rep.get("events", [])

@@ -1,0 +1,33 @@
+"""Cross-shard solve combiner: many shards' ticks, one vmapped dispatch.
+
+At millions-of-users event rates the per-shard solve is the wrong grain:
+a hundred fleets each paying a warm solve serially wastes exactly the
+thing the jax backend is best at — vmapped batching. This package sits in
+the gateway ingest path *behind* the coalescer: each shard's pending
+drift run is packed (``Scheduler.prepare_combine`` →
+``solver.batchlayout.pack_instance``) instead of solved, grouped into a
+shape bucket by its packed signature, and one ``_solve_batched`` dispatch
+per bucket prices every member at once. Results scatter back onto each
+shard's worker (``Scheduler.adopt_combine``), so warm state, the
+speculation bank, flight records and the published ``PlacementView`` are
+exactly what the per-shard path would have produced (mode/metrics aside).
+
+Two committed pieces:
+
+- ``BucketPolicy`` — the shape-bucket contract: a fixed ladder of padded
+  fleet sizes (mixed real M inside a bucket rides phantom padding — see
+  ``solver.batchlayout``), a lane cap sized against the ``ops.memmodel``
+  analytic padding budget, and the flush triggers (full bucket / max
+  wait). *Committed* means the boundaries never adapt to traffic: every
+  reachable batch shape is a finite, enumerable set, which is what keeps
+  the compile ledger's zero-recompile gate holding across bucket churn.
+
+- ``SolveCombiner`` — the flush thread: buckets pending tickets by
+  signature, dispatches ``solve_batch`` per bucket, and delivers each
+  lane back to its shard.
+"""
+
+from .policy import BucketPolicy
+from .combiner import CombineEntry, SolveCombiner
+
+__all__ = ["BucketPolicy", "SolveCombiner", "CombineEntry"]

@@ -66,6 +66,13 @@ _AGGREGATED_SHARD_COUNTERS = (
     "spec_near_hit",
     "spec_near_miss",
     "events_coalesced",
+    # Cross-shard combine path (distilp_tpu.combine): per-shard routing
+    # outcomes; the combiner's own batch counters live on the gateway
+    # metrics directly.
+    "combine_prepared",
+    "combine_local",
+    "combine_stale",
+    "combine_fallback",
     # Compile-ledger tick attribution (obs.compile_ledger): which shards'
     # ticks paid XLA compiles, aggregated for the serving-tier dashboard.
     "compiles",
@@ -141,6 +148,8 @@ class Gateway:
         coalesce: bool = False,
         degrade_depth: Optional[int] = None,
         mem_degrade_headroom_bytes: Optional[float] = None,
+        combine: bool = False,
+        combine_policy=None,
     ):
         # Library entry point that dispatches backend work (via the
         # schedulers it builds): arm the axon-wedge guard exactly like
@@ -198,12 +207,36 @@ class Gateway:
         #       gateway degrades to spec_near serving before the OOM
         #       killer degrades it to nothing.
         self.max_queue_depth = max_queue_depth
-        self.coalesce = coalesce
+        #   combine        — route coalesce batches through the cross-shard
+        #       solve combiner (distilp_tpu.combine): each shard's pending
+        #       drift run is PACKED instead of solved, bucketed by shape,
+        #       and one vmapped dispatch prices every bucket member at
+        #       once. Implies coalesce (the combiner consumes coalesce
+        #       batches). ``combine_policy`` is the committed BucketPolicy
+        #       (padding ladder, lane cap, flush deadline).
+        self.coalesce = coalesce or combine
         self.degrade_depth = degrade_depth
         self.mem_degrade_headroom_bytes = mem_degrade_headroom_bytes
+        self.combine = combine
+        self._combine_policy = None
+        self._combiner = None
+        # Shard keys with a combine ticket in flight: a shard's next
+        # coalesce batch PARKS (queues no closure) until its lane is
+        # adopted, so the worker never interleaves a newer solve between
+        # prepare and adopt. Guarded by _admission_lock.
+        self._combine_inflight: Dict[str, bool] = {}
+        if combine:
+            from ..combine import BucketPolicy, SolveCombiner
+
+            self._combine_policy = (
+                combine_policy if combine_policy is not None else BucketPolicy()
+            )
+            self._combiner = SolveCombiner(
+                self._combine_policy, metrics=self.metrics
+            )
         self._admission = bool(
             max_queue_depth is not None
-            or coalesce
+            or self.coalesce
             or degrade_depth is not None
             or mem_degrade_headroom_bytes is not None
         )
@@ -346,6 +379,8 @@ class Gateway:
         coalesce: bool = False,
         degrade_depth: Optional[int] = None,
         mem_degrade_headroom_bytes: Optional[float] = None,
+        combine: bool = False,
+        combine_policy=None,
     ) -> None:
         """Reconfigure the admission knobs (see ``__init__``).
 
@@ -355,22 +390,129 @@ class Gateway:
         policies. All-default arguments turn admission OFF — back to the
         byte-identical pre-admission ingest path.
         """
+        old_combiner = None
         with self._admission_lock:
-            if self._pending:
+            if self._pending or self._combine_inflight:
                 raise RuntimeError(
                     "cannot reconfigure admission with coalesce batches "
-                    "pending (the gateway is not quiescent)"
+                    "or combine tickets pending (the gateway is not "
+                    "quiescent)"
                 )
             self.max_queue_depth = max_queue_depth
-            self.coalesce = coalesce
+            self.coalesce = coalesce or combine
             self.degrade_depth = degrade_depth
             self.mem_degrade_headroom_bytes = mem_degrade_headroom_bytes
+            if combine != self.combine or combine_policy is not None:
+                old_combiner = self._combiner
+                self._combiner = None
+                self._combine_policy = None
+                self.combine = combine
+                if combine:
+                    from ..combine import BucketPolicy, SolveCombiner
+
+                    self._combine_policy = (
+                        combine_policy if combine_policy is not None
+                        else BucketPolicy()
+                    )
+                    self._combiner = SolveCombiner(
+                        self._combine_policy, metrics=self.metrics
+                    )
             self._admission = bool(
                 max_queue_depth is not None
-                or coalesce
+                or self.coalesce
                 or degrade_depth is not None
                 or mem_degrade_headroom_bytes is not None
             )
+        if old_combiner is not None:
+            # Outside the lock: stop() joins the flush thread, whose
+            # deliveries take worker queues — never while holding the
+            # admission lock an ingest path also needs.
+            old_combiner.stop()
+
+    def warm_combine(self, fleet_ids: Optional[Sequence[str]] = None) -> dict:
+        """Trace every combined executable the committed policy can reach.
+
+        For each registered combinable shard, packs its CURRENT fleet
+        state at the policy's padded size (through ``read_shard``, so the
+        pack observes a tick boundary), groups the packs by bucket
+        signature, and runs one throwaway ``solve_batch`` per committed
+        lane shape (``BucketPolicy.lane_shapes``) per signature. Results
+        are discarded — this exists purely to populate the jit cache so
+        the measured phase's compile ledger stays flat: with both shape
+        axes committed (padded M by ``pad_for``, lane count by
+        ``quantize_lanes``) the reachable executable set is exactly what
+        this method enumerates, which is the PR 14 zero-recompile gate's
+        warm contract for combined traffic. Call after the per-shard
+        warmup (packs reuse each shard's warm signature) and before the
+        measured phase. Also pre-positions every combinable shard's static
+        half in the per-lane device cache (``lane_static_to_device``) so
+        measured-phase flushes re-ship only dynamic bytes. Returns
+        ``{"buckets": ..., "shapes_traced": ..., "statics_primed": ...}``.
+        """
+        if self._combiner is None:
+            raise RuntimeError(
+                "warm_combine requires the combine admission path "
+                "(configure_admission(combine=True) first)"
+            )
+        from ..solver.batchlayout import lane_static_to_device, solve_batch
+
+        policy = self._combine_policy
+        ids = list(fleet_ids) if fleet_ids is not None else self.fleet_ids()
+        by_sig: Dict[tuple, tuple] = {}  # sig -> (fleet_id, instance)
+        primed = 0
+        for fid in ids:
+
+            def _pack(s, warm_override=None):
+                planner = s.pool.peek(s.fleet.key())
+                if planner is None:
+                    return None
+                devs = s.fleet.device_list()
+                return planner.prepare(
+                    devs, s.fleet.model, M_pad=policy.pad_for(len(devs)),
+                    warm_override=warm_override,
+                )
+
+            prep = self.read_shard(fid, _pack)
+            if prep is None:
+                continue  # MoE / non-jax / cold shard: not combinable
+            # Pre-position this shard's drift-invariant half on device NOW
+            # (before the openloop warm boundary): measured-phase flushes
+            # then assemble their static stacks from cache — no static
+            # re-uploads, and no live-array growth past the leak baseline.
+            _, uploaded = lane_static_to_device(prep.instance.static_np)
+            primed += 1 if uploaded else 0
+            by_sig.setdefault(prep.instance.signature, (fid, prep.instance))
+        shapes = 0
+        seen = set(by_sig)
+        for fid, inst in list(by_sig.values()):
+            best = None
+            for lanes in policy.lane_shapes(inst.M_pad):
+                decoded = solve_batch([inst], lane_pad=lanes)
+                best = decoded[0][1]
+                shapes += 1
+            # Round two: the STEADY-STATE signature. A shard's second and
+            # later combined ticks warm-seed from an adopted batched
+            # result, whose root-IPM iterates carry the padded family's
+            # shapes — that flips ``has_root_warm`` (and the dyn blob
+            # size) relative to the per-shard-seeded pack traced above,
+            # minting a fresh executable on the SECOND post-warmup tick
+            # if it is not traced here.
+            if best is None or best.ipm_state is None:
+                continue
+            prep2 = self.read_shard(
+                fid, lambda s, b=best: _pack(s, warm_override=b)
+            )
+            if prep2 is None or prep2.instance.signature in seen:
+                continue
+            seen.add(prep2.instance.signature)
+            for lanes in policy.lane_shapes(prep2.instance.M_pad):
+                solve_batch([prep2.instance], lane_pad=lanes)
+                shapes += 1
+        return {
+            "buckets": len(seen),
+            "shapes_traced": shapes,
+            "statics_primed": primed,
+        }
 
     def _mem_pressure(self) -> bool:
         """True when the memory-headroom floor is configured AND the live
@@ -504,6 +646,20 @@ class Gateway:
                 parent=parent, t_enq=t_enq, pressure=pressure, depth=depth,
             )
             with self._admission_lock:
+                batch = self._pending.get(key)
+                if batch is not None and batch.get("parked"):
+                    # A PARKED batch (combine ticket in flight) has no
+                    # queued closure to drain ahead of us, so popping it
+                    # would strand its waiters. Append the structural
+                    # event instead: order within the batch is arrival
+                    # order, and a mixed batch drains through the local
+                    # per-shard path (prepare_combine never sees it).
+                    box: dict = {}
+                    done = threading.Event()
+                    batch["events"].append(event)
+                    batch["waiters"].append((box, done, on_done))
+                    batch["pressure"] = batch["pressure"] or pressure
+                    return box, done
                 self._pending.pop(key, None)
                 try:
                     return worker.submit(
@@ -543,8 +699,18 @@ class Gateway:
                 "events": [event],
                 "waiters": [(box, done, on_done)],
                 "pressure": pressure,
+                "parked": False,
             }
             self._pending[key] = batch
+            if self.combine and self._combine_inflight.get(key):
+                # The shard's previous batch is mid-combine (prepare done,
+                # adopt pending): queueing a drain now would let the worker
+                # solve NEWER state before the older lane lands. Park the
+                # batch — it keeps absorbing joiners — and let the adopt
+                # closure submit the drain when the lane is redeemed.
+                batch["parked"] = True
+                batch["args"] = (parent, t_enq, depth)
+                return box, done
             closure = self._batch_closure(
                 fleet_id, key, worker, batch, parent, t_enq, depth
             )
@@ -593,6 +759,80 @@ class Gateway:
             )
             shared: dict = {}
             with self.tracer.attach(parent):
+                combiner = self._combiner
+                if combiner is not None and not any(
+                    getattr(ev, "kind", None) in STRUCTURAL_KINDS
+                    for ev in events
+                ):
+                    # Combine path: PACK this shard's tick instead of
+                    # solving it; the batched dispatch happens on the
+                    # combiner thread and the lane is redeemed by an
+                    # adopt closure queued back onto this worker. A
+                    # short-circuit view (spec hit, breaker, local
+                    # fallback) resolves the waiters right here.
+                    from ..combine import CombineEntry
+
+                    with self._admission_lock:
+                        if self._combine_inflight.get(key):
+                            # This closure was queued in the window
+                            # between the previous batch's detach and its
+                            # inflight mark — the ingest-side parking
+                            # check could not see the lane. Applying our
+                            # events now would advance the fleet past the
+                            # packed seq and turn that lane stale, so
+                            # RE-PARK instead: the adopt closure drains
+                            # us when the lane is redeemed.
+                            open_batch = self._pending.get(key)
+                            if open_batch is not None:
+                                # A newer batch opened behind us; our
+                                # events are OLDER — merge at the front
+                                # so per-fleet order is preserved.
+                                open_batch["events"][:0] = events
+                                open_batch["waiters"][:0] = waiters
+                                open_batch["pressure"] = (
+                                    open_batch["pressure"] or pressure
+                                )
+                            else:
+                                batch["events"] = list(events)
+                                batch["waiters"] = list(waiters)
+                                batch["pressure"] = pressure
+                                batch["parked"] = True
+                                batch["args"] = (parent, t_enq, depth)
+                                self._pending[key] = batch
+                            return
+
+                    ticket = None
+                    try:
+                        sched = worker.shards[key]
+                        m_pad = self._combine_policy.pad_for(
+                            len(sched.fleet.device_list())
+                        )
+                        ticket, view = sched.prepare_combine(
+                            events, pressure=pressure, M_pad=m_pad
+                        )
+                        if view is not None:
+                            shared["result"] = view
+                    except BaseException as e:
+                        self.metrics.inc("worker_exception")
+                        shared["exc"] = e
+                    finally:
+                        self._handled[fleet_id] = (
+                            self._handled.get(fleet_id, 0) + len(events)
+                        )
+                    if ticket is not None:
+                        with self._admission_lock:
+                            self._combine_inflight[key] = True
+                        combiner.submit(
+                            CombineEntry(
+                                ticket,
+                                self._combine_deliver(
+                                    fleet_id, key, worker, ticket, waiters
+                                ),
+                            )
+                        )
+                        return
+                    self._resolve_waiters(waiters, shared)
+                    return
                 try:
                     shared["result"] = worker.shards[key].handle_coalesced(
                         events, pressure=pressure
@@ -607,19 +847,86 @@ class Gateway:
                     self._handled[fleet_id] = (
                         self._handled.get(fleet_id, 0) + len(events)
                     )
-                    for w_box, w_done, w_on_done in waiters:
-                        w_box.update(shared)
-                        w_done.set()
-                        if w_on_done is not None:
-                            try:
-                                w_on_done(w_box)
-                            except Exception:
-                                # Same contract as ShardWorker._run: a
-                                # dead completion callback must not kill
-                                # the worker thread.
-                                self.metrics.inc("worker_callback_error")
+                    self._resolve_waiters(waiters, shared)
 
         return _do
+
+    def _resolve_waiters(self, waiters, shared: dict) -> None:
+        """Resolve a batch's waiters with one shared outcome (result or
+        exc); a dead completion callback must not kill the caller's
+        thread (same contract as ``ShardWorker._run``)."""
+        for w_box, w_done, w_on_done in waiters:
+            w_box.update(shared)
+            w_done.set()
+            if w_on_done is not None:
+                try:
+                    w_on_done(w_box)
+                except Exception:
+                    self.metrics.inc("worker_callback_error")
+
+    def _combine_deliver(self, fleet_id, key, worker, ticket, waiters):
+        """The combiner's per-lane delivery callback: queue the shard's
+        ``adopt_combine`` back onto its OWN worker (scatter), resolve the
+        batch's waiters with the adopted view, then un-park the batch
+        that accumulated behind the in-flight lane."""
+
+        def deliver(decoded, err) -> None:
+            def _adopt() -> None:
+                shared: dict = {}
+                try:
+                    shared["result"] = worker.shards[key].adopt_combine(
+                        ticket, decoded, error=err
+                    )
+                except BaseException as e:
+                    self.metrics.inc("worker_exception")
+                    shared["exc"] = e
+                finally:
+                    self._release_combine(fleet_id, key, worker)
+                    self._resolve_waiters(waiters, shared)
+
+            try:
+                worker.submit(_adopt)
+            except BaseException as e:
+                # Worker already stopping (shutdown race): the lane
+                # cannot be adopted; resolve the waiters with the error
+                # so nothing blocks forever.
+                self.metrics.inc("worker_exception")
+                with self._admission_lock:
+                    self._combine_inflight.pop(key, None)
+                self._resolve_waiters(
+                    waiters, {"exc": err if err is not None else e}
+                )
+
+        return deliver
+
+    def _release_combine(self, fleet_id, key, worker) -> None:
+        """Clear a shard's in-flight combine marker and submit the drain
+        of any batch that parked behind it (runs on the worker thread at
+        the end of the adopt closure)."""
+        parked_waiters = None
+        shed_shared = None
+        with self._admission_lock:
+            self._combine_inflight.pop(key, None)
+            batch = self._pending.get(key)
+            if batch is None or not batch.get("parked"):
+                return
+            batch["parked"] = False
+            parent, t_enq, depth = batch.pop("args")
+            closure = self._batch_closure(
+                fleet_id, key, worker, batch, parent, t_enq, depth
+            )
+            try:
+                worker.submit(closure, bound=self.max_queue_depth)
+            except WorkerQueueFull as e:  # dlint: disable=DLP017 accounted inside _shed (events_shed + per-fleet tally + flight record); the QueueFull is handed back to every parked waiter, not swallowed
+                del self._pending[key]
+                parked_waiters = list(batch["waiters"])
+                shed_shared = {
+                    "exc": self._shed(
+                        fleet_id, batch["events"][-1], worker, e.depth
+                    )
+                }
+        if parked_waiters is not None:
+            self._resolve_waiters(parked_waiters, shed_shared)
 
     def _shed(self, fleet_id: str, event, worker, depth: int) -> QueueFull:
         """Account one shed, then hand back the exception to raise.
@@ -967,6 +1274,11 @@ class Gateway:
             self.timeline,
             engine=self.slo_engine,
             capacity_eps=self.capacity_eps,
+            combine=(
+                self._combiner.snapshot()
+                if self._combiner is not None
+                else None
+            ),
         ).model_dump()
 
     def flight_snapshot(self, fleet_id: str) -> List[dict]:
@@ -1092,6 +1404,11 @@ class Gateway:
                 # A sampler that fails to stop must not leak workers; the
                 # failure is counted, teardown continues.
                 self.metrics.inc("timeline_sample_error")
+        if self._combiner is not None:
+            # Before the workers: the drain's deliveries queue adopt
+            # closures on still-running workers, and the workers' own
+            # graceful stop then drains those.
+            self._combiner.stop()
         for w in self.workers:
             w.stop()
 

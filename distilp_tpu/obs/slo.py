@@ -510,6 +510,24 @@ class SLOBurnSignal(BaseModel):
     firing: List[str]  # severities currently firing
 
 
+class CombineSignal(BaseModel):
+    """The cross-shard solve combiner's live state (distilp_tpu.combine):
+    lifetime batch counters plus bucket occupancy — the signal that says
+    whether combined dispatches are actually filling their buckets (a
+    padding_waste_mean near 1 or occupancy_mean near 1 means the bucket
+    policy is mis-sized for the traffic)."""
+
+    batches: int = 0
+    instances: int = 0
+    flush_full: int = 0
+    flush_deadline: int = 0
+    errors: int = 0
+    pending: int = 0
+    buckets: int = 0
+    occupancy_mean: Optional[float] = None
+    padding_waste_mean: Optional[float] = None
+
+
 class SignalsPayload(BaseModel):
     """The versioned autoscaling contract.
 
@@ -537,6 +555,9 @@ class SignalsPayload(BaseModel):
     # so version stays 1 — old consumers ignore it, the federation tier
     # scales on it the same way it scales on headroom_eps.
     mem_headroom_bytes: Optional[float] = None
+    # Cross-shard solve combiner state. Additive (None when the gateway
+    # runs per-shard), same versioning argument as mem_headroom_bytes.
+    combine: Optional[CombineSignal] = None
 
 
 def build_signals(
@@ -545,6 +566,7 @@ def build_signals(
     capacity_eps: Optional[float] = None,
     now: Optional[float] = None,
     rate_window_s: float = 30.0,
+    combine: Optional[dict] = None,
 ) -> SignalsPayload:
     """Assemble the ``/signals`` payload from a timeline (+ optional SLO
     engine and capacity estimate). Pure read — safe on any thread."""
@@ -635,6 +657,7 @@ def build_signals(
         max_sustainable_eps=capacity_eps,
         headroom_eps=headroom,
         mem_headroom_bytes=mem_headroom,
+        combine=CombineSignal(**combine) if combine is not None else None,
     )
 
 

@@ -134,6 +134,29 @@ METRIC_REGISTRY = {
     "events_coalesced": "Queued drift events folded into a newer tick's solve",
     "spec_near_hit": "Pressure ticks served a banked near-match (mode='spec_near')",
     "spec_near_miss": "Pressure ticks that found no banked near-match to serve",
+    # -- cross-shard solve combiner (distilp_tpu.combine) -----------------
+    "combine_prepared": "Ticks packed for a cross-shard batched solve",
+    "combine_local": "Combine-eligible ticks solved per-shard instead "
+    "(structural / MoE / probe / post-restore)",
+    "combine_stale": "Combined results discarded: the fleet advanced past "
+    "the packed seq before adoption",
+    "combine_fallback": "Combined ticks that re-solved per-shard "
+    "(uncertified lane or combiner dispatch failure)",
+    "combine_batches": "Batched solve dispatches executed by the combiner",
+    "combine_instances": "Shard instances solved inside combined batches",
+    "combine_flush_full": "Combiner flushes triggered by a full bucket",
+    "combine_flush_deadline": "Combiner flushes triggered by the max-wait deadline",
+    "combine_bucket_occupancy": "Instances per combined batch (histogram)",
+    "combine_padding_waste": "Phantom-device fraction of combined batches "
+    "(padded lanes' pad share, histogram)",
+    "combine_batch_ms": "Combined batch dispatch latency (pack to decode), ms",
+    "combine_static_hit": "Fraction of a combined batch's lanes whose static "
+    "half was already device-resident (histogram; 1.0 = zero static bytes "
+    "re-shipped)",
+    "combine_dispatch_error": "Batched solve dispatches that raised; every "
+    "lane fell back to a per-shard solve",
+    "drift_tick_combine": "Drift ticks served via a cross-shard batched "
+    "solve (mode='combine')",
     # -- snapshot / restore ----------------------------------------------
     "state_restored": "Scheduler warm-state restores (load_state)",
     "warm_resumes": "First post-restore ticks that rode warm (the proof)",

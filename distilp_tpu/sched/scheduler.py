@@ -79,6 +79,21 @@ class _DeadlineMiss(Exception):
     earlier abandoned solve is still occupying the worker)."""
 
 
+class CombineTicket(NamedTuple):
+    """A prepared-but-unsolved combined tick (``Scheduler.prepare_combine``
+    → the gateway's ``SolveCombiner`` → ``Scheduler.adopt_combine``). The
+    packed instance rides ``prep.instance``; ``seq`` pins the fleet state
+    the pack described, so adopt can detect (and discard) a result a
+    structural barrier raced past."""
+
+    key: tuple
+    planner: StreamingReplanner
+    prep: object  # solver.streaming.CombinePrep
+    seq: int
+    t0: float
+    event: object  # last event of the coalesced run, for flight records
+
+
 class _SolveWorker:
     """One DAEMON thread executing solve attempts for the deadline path.
 
@@ -627,6 +642,227 @@ class Scheduler:
                     self._flight_note(last, view, span)
                 self._span = NOOP_SPAN
 
+    # -- cross-shard combine path (distilp_tpu.combine) --------------------
+    #
+    # A combined tick splits handle_coalesced's one synchronous solve into
+    # prepare (apply events, pack this shard's instance) and adopt (redeem
+    # the shard's lane of the batched solve) so the gateway's SolveCombiner
+    # can execute many shards' solves as ONE vmapped dispatch in between.
+    # Everything around the solve — event validation/quarantine, the gate
+    # short-circuits, breaker accounting, publish, speculation refill — is
+    # the per-shard code, shared, not copied.
+
+    def prepare_combine(self, events: Sequence, pressure: bool = False,
+                        M_pad: Optional[int] = None):
+        """Apply a coalesced run of drift events and PACK the resulting
+        solve instead of executing it. Returns ``(ticket, view)`` — exactly
+        one is non-None. A view means the tick was fully served here: a
+        gate short-circuit (spec hit, breaker, quarantine) or a local
+        fallback solve for ticks the combiner cannot batch (structural,
+        MoE, half-open probe, first post-restore tick — counted
+        ``combine_local``). A ticket means the solve is deferred: hand
+        ``ticket.prep.instance`` to the combiner and redeem the lane with
+        ``adopt_combine``."""
+        events = list(events)
+        if not events:
+            raise ValueError("prepare_combine needs at least one event")
+        last = events[-1]
+        span = self.tracer.span(
+            "sched.tick",
+            attrs={
+                "kind": getattr(last, "kind", type(last).__name__),
+                "coalesced": len(events),
+                "combine": True,
+            },
+        )
+        with span:
+            self._span = span
+            self._tick_exc = {}
+            self._tick_conv = None
+            self._tick_compile = None
+            self._tick_mem = None
+            self._tick_structural = False
+            led = _compile_ledger.current()
+            tok = led.seq() if led is not None else 0
+            view: Optional[PlacementView] = None
+            ticket = None
+            try:
+                applied = 0
+                structural = False
+                for ev in events:
+                    reason = validate_event(ev)
+                    if reason is not None:
+                        self._quarantine_note(ev, reason)
+                        continue
+                    try:
+                        s = self.fleet.apply(ev)
+                    except (ValueError, TypeError) as e:
+                        self._quarantine_note(ev, f"{type(e).__name__}: {e}")
+                        continue
+                    self._absorbed(ev, s)
+                    if applied:
+                        self.metrics.inc("events_coalesced")
+                    applied += 1
+                    structural = structural or s
+                if not applied:
+                    if self._published is None:
+                        raise ValueError(
+                            "every coalesced event was quarantined before "
+                            "any placement was published; nothing safe to "
+                            "serve"
+                        )
+                    view = self.latest()
+                    return None, view
+                self._tick_structural = structural
+                gview, key, planner, probing = self._tick_gate(
+                    structural, pressure
+                )
+                if gview is not None:
+                    view = gview
+                    return None, view
+                # Ticks the combiner cannot batch solve locally, now:
+                # structural ticks re-shape the instance (and are barriers
+                # at the gateway anyway), the half-open breaker probe must
+                # prove recovery with a real solve it owns, and the first
+                # post-restore tick IS the warm-resume proof.
+                if structural or probing or self._restore_pending:
+                    self.metrics.inc("combine_local")
+                    view = self._tick_solve(structural, key, planner, probing)
+                    return None, view
+                t0 = time.perf_counter()
+                try:
+                    prep = planner.prepare(
+                        self.fleet.device_list(), self.fleet.model, M_pad=M_pad
+                    )
+                except (RuntimeError, ValueError, NotImplementedError) as e:
+                    self.metrics.inc("tick_failed")
+                    self.metrics.inc("tick_failed_drift")
+                    self._last_error = f"{type(e).__name__}: {e}"
+                    self._solve_failed(probing)
+                    if self._published is None:
+                        raise
+                    view = self.latest()
+                    return None, view
+                if prep is None:
+                    # MoE shard (load-factor fixed point / margin ladder
+                    # are iterative) or non-jax backend: per-shard path.
+                    self.metrics.inc("combine_local")
+                    view = self._tick_solve(structural, key, planner, probing)
+                    return None, view
+                self.metrics.inc("combine_prepared")
+                ticket = CombineTicket(
+                    key=key, planner=planner, prep=prep,
+                    seq=self.fleet.seq, t0=t0, event=last,
+                )
+                return ticket, None
+            finally:
+                if led is not None:
+                    self._note_compiles(led, tok, span)
+                mled = _memory.current()
+                if mled is not None:
+                    if self._tick_mem is None:
+                        self._note_memory(mled, span)
+                    if self._tick_structural:
+                        mled.note_structural()
+                span.set_attr(
+                    "mode",
+                    view.mode if view is not None
+                    else ("combine_pending" if ticket is not None else "error"),
+                )
+                if self._flight is not None and view is not None:
+                    self._flight_note(last, view, span)
+                self._span = NOOP_SPAN
+
+    def adopt_combine(self, ticket, decoded=None,
+                      error: Optional[BaseException] = None) -> PlacementView:
+        """Redeem one lane of a batched solve — the deferred second half of
+        a ``prepare_combine`` tick. ``decoded`` is this shard's
+        ``(per_k_results, best)`` from ``batchlayout.solve_batch``;
+        ``error`` (a combiner-level dispatch failure) falls back to a full
+        local tick, counted ``combine_fallback``. A ticket whose fleet has
+        advanced past the packed ``seq`` (a structural barrier raced in
+        between) is discarded as ``combine_stale`` — the newer published
+        view already covers this ticket's events."""
+        span = self.tracer.span(
+            "sched.tick",
+            attrs={"kind": "combine_adopt", "combine": True},
+        )
+        with span:
+            self._span = span
+            self._tick_exc = {}
+            self._tick_conv = None
+            self._tick_compile = None
+            self._tick_mem = None
+            self._tick_structural = False
+            led = _compile_ledger.current()
+            tok = led.seq() if led is not None else 0
+            view: Optional[PlacementView] = None
+            try:
+                if error is not None:
+                    self.metrics.inc("combine_fallback")
+                    self._span.add_event(
+                        "combine_fallback",
+                        error=f"{type(error).__name__}: {error}",
+                    )
+                    view = self._tick(structural=False)
+                    return view
+                if self.fleet.seq != ticket.seq:
+                    # The packed instance no longer describes the live
+                    # fleet; whoever advanced it published past us.
+                    self.metrics.inc("combine_stale")
+                    if self._published is not None:
+                        view = self.latest()
+                    else:
+                        view = self._tick(structural=False)
+                    return view
+                tick_tm: dict = {}
+                try:
+                    result = ticket.planner.adopt(
+                        ticket.prep, decoded, timings=tick_tm
+                    )
+                except (RuntimeError, ValueError, NotImplementedError) as e:
+                    self.metrics.inc("tick_failed")
+                    self.metrics.inc("tick_failed_drift")
+                    self._last_error = f"{type(e).__name__}: {e}"
+                    self._solve_failed(False)
+                    if self._published is None:
+                        raise
+                    view = self.latest()
+                    return view
+                self._on_clean_solve(False)
+                ms = (time.perf_counter() - ticket.t0) * 1e3
+                self.metrics.observe("event_to_placement", ms)
+                if "lp_backend" in tick_tm:
+                    self.metrics.inc(f"lp_backend_{tick_tm['lp_backend']}")
+                    self._last_lp_backend = tick_tm["lp_backend"]
+                if ticket.planner.last_tick_escalations:
+                    # An uncertified lane re-solved per-shard inside
+                    # adopt(): the combined path's certification rung.
+                    self.metrics.inc("solver_escalations")
+                    self.metrics.inc("combine_fallback")
+                self.metrics.observe("drift_tick", ms)
+                self.metrics.inc("drift_tick_combine")
+                view = self._publish(
+                    result, "combine", ticket.key, ticket.planner,
+                    ticket.prep.devs, ms,
+                )
+                if self.speculative and self.health == HEALTH_HEALTHY:
+                    self._spec_presolve(ticket.key, ticket.planner, result)
+                return view
+            finally:
+                if led is not None:
+                    self._note_compiles(led, tok, span)
+                mled = _memory.current()
+                if mled is not None:
+                    if self._tick_mem is None:
+                        self._note_memory(mled, span)
+                span.set_attr(
+                    "mode", view.mode if view is not None else "error"
+                )
+                if self._flight is not None:
+                    self._flight_note(ticket.event, view, span)
+                self._span = NOOP_SPAN
+
     def _handle(self, event, pressure: bool = False) -> PlacementView:
         reason = validate_event(event)
         if reason is not None:
@@ -719,6 +955,17 @@ class Scheduler:
         routing class, so the per-class counters keep summing to events).
         ``pressure`` widens a missed speculation probe to the bank's
         nearest certified match (degraded-mode serving under overload)."""
+        view, key, planner, probing = self._tick_gate(structural, pressure)
+        if view is not None:
+            return view
+        return self._tick_solve(structural, key, planner, probing)
+
+    def _tick_gate(self, structural, pressure: bool):
+        """The no-solve short-circuits of a tick, factored so the combine
+        path (``prepare_combine``) shares them verbatim with ``_tick``:
+        fleet quarantine, circuit breaker, speculation-bank probes, then
+        the planner-pool fetch. Returns ``(view, key, planner, probing)``
+        — a non-None view means the tick is already served."""
         # Second quarantine layer: a poisoned fleet state (however it got
         # here) must never reach build_coeffs. Cheap O(M) scalar scan.
         # Both short-circuits run BEFORE pool.get: a tick that will not
@@ -732,7 +979,7 @@ class Scheduler:
             self._note_fault()
             if self._published is None:
                 raise ValueError(f"fleet state is poisoned: {bad}")
-            return self._serve_stale("stale")
+            return self._serve_stale("stale"), None, None, False
         # Circuit breaker: while open, cooldown ticks serve degraded with
         # no solve at all; the tick after cooldown falls through as the
         # half-open probe.
@@ -742,7 +989,7 @@ class Scheduler:
                 self._breaker_cooldown_left -= 1
                 self.metrics.inc("breaker_short_circuit")
                 self._span.add_event("breaker_short_circuit")
-                return self._serve_stale("degraded")
+                return self._serve_stale("degraded"), None, None, False
             probing = True
             self.metrics.inc("breaker_half_open_probe")
             self._span.add_event("breaker_half_open_probe")
@@ -763,14 +1010,20 @@ class Scheduler:
         ):
             view = self._spec_probe(key, structural)
             if view is not None:
-                return view
+                return view, None, None, False
             if pressure:
                 # Behind under load: a certified placement from a NEARBY
                 # instance beats queueing this solve past its deadline.
                 view = self._spec_near_probe(key, structural)
                 if view is not None:
-                    return view
+                    return view, None, None, False
         planner, _hit = self.pool.get(key)
+        return None, key, planner, probing
+
+    def _tick_solve(self, structural, key, planner, probing) -> PlacementView:
+        """The solve-and-publish half of a tick (everything after
+        ``_tick_gate``), shared by ``_tick`` and the combine path's local
+        fallback."""
         devs = self.fleet.device_list()
         t0 = time.perf_counter()
         tick_tm: dict = {}

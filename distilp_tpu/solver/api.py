@@ -459,6 +459,65 @@ def halda_solve_async(
     return PendingHalda(pending, sets)
 
 
+def _scenarios_via_batchlayout(
+    built,
+    kWs,
+    mip_gap: float,
+    warm_ilps,
+    *,
+    max_rounds,
+    beam,
+    ipm_iters,
+    ipm_warm_iters,
+    node_cap,
+    lp_backend,
+    pdhg_iters,
+    pdhg_restart_tol,
+    timings,
+):
+    """Row-scale-crossing fallback for ``halda_solve_scenarios``: one
+    packed instance per scenario (each carries its own static half), one
+    ``solve_batch`` dispatch. Same ``(per_k_results, best)``-per-scenario
+    contract as ``solve_sweep_scenarios``."""
+    from .batchlayout import pack_instance, solve_batch
+
+    S = len(built)
+
+    def _mk(warms_l):
+        return [
+            pack_instance(
+                arrays, kWs, mip_gap=mip_gap, coeffs=coeffs,
+                warm=warms_l[i], ipm_iters=ipm_iters,
+                max_rounds=max_rounds, beam=beam, node_cap=node_cap,
+                ipm_warm_iters=ipm_warm_iters, lp_backend=lp_backend,
+                pdhg_iters=pdhg_iters, pdhg_restart_tol=pdhg_restart_tol,
+            )
+            for i, (_, _, coeffs, arrays) in enumerate(built)
+        ]
+
+    insts = _mk(warm_ilps if warm_ilps is not None else [None] * S)
+    if any(inst is None for inst in insts):
+        # No structurally feasible k — uniform across scenarios (they
+        # share the fleet size and k grid), same early-out shape as the
+        # shared-static path.
+        return [([None] * len(kWs), None) for _ in range(S)]
+    if len({inst.signature for inst in insts}) > 1:
+        # Warm hints engaged unevenly across lanes (a mis-shaped or
+        # partial seed): drop them everywhere — the same both-or-cold
+        # rule the shared-static path applies.
+        insts = _mk([None] * S)
+        if len({inst.signature for inst in insts}) > 1:
+            raise ValueError(
+                "scenarios do not share a packed shape family (fleet "
+                "size, k grid, or model shape differ across scenarios); "
+                "solve them as separate sweeps"
+            )
+    if timings is not None:
+        timings["scenario_fallback"] = 1.0
+        timings["lp_backend"] = insts[0].statics["lp_backend"]
+    return solve_batch(insts, timings=timings)
+
+
 def halda_solve_scenarios(
     scenarios: Sequence[Sequence[DeviceProfile]],
     model: ModelProfile,
@@ -488,9 +547,14 @@ def halda_solve_scenarios(
     upload + one dispatch + one fetch: on a tunneled TPU this prices S
     placements at roughly one placement's wire time (JAX backend only).
 
-    Scenarios that drift OUTSIDE the profile class (device speeds,
-    memory capacities, fleet size, model shape) change the static half
-    and raise ValueError — solve those independently.
+    Scenarios whose static halves diverge — out-of-class drift (device
+    speeds, memory capacities) or a t_comm/load excursion large enough
+    to cross a row-scaling threshold — fall back to the multi-instance
+    batch layout (``solver.batchlayout``): each scenario packs its OWN
+    static half and the batch still runs as one device dispatch, at the
+    cost of S static uploads instead of one. Only scenarios that do not
+    even share a packed shape family (different fleet size, k grid, or
+    model shape) raise ValueError — solve those independently.
 
     ``warms``/``load_factors_list``: optional per-scenario seeds and MoE
     load factors (one entry each per scenario). Warm hints engage only
@@ -522,27 +586,46 @@ def halda_solve_scenarios(
         for i, devs in enumerate(scenarios)
     ]
     Ks = built[0][0]
+    kWs = [(k, model.L // k) for k in Ks]
+    gap = mip_gap if mip_gap is not None else 1e-4
 
     warm_ilps: Optional[List[Optional[ILPResult]]] = None
     if warms is not None:
         warm_ilps = [_warm_to_ilp(w) for w in warms]
 
-    outs = solve_sweep_scenarios(
-        [arrays for _, _, _, arrays in built],
-        [(k, model.L // k) for k in Ks],
-        [coeffs for _, _, coeffs, _ in built],
-        mip_gap=mip_gap if mip_gap is not None else 1e-4,
-        warms=warm_ilps,
-        max_rounds=max_rounds,
-        beam=beam,
-        ipm_iters=ipm_iters,
-        ipm_warm_iters=ipm_warm_iters,
-        node_cap=node_cap,
-        timings=timings,
-        lp_backend=lp_backend,
-        pdhg_iters=pdhg_iters,
-        pdhg_restart_tol=pdhg_restart_tol,
-    )
+    try:
+        outs = solve_sweep_scenarios(
+            [arrays for _, _, _, arrays in built],
+            kWs,
+            [coeffs for _, _, coeffs, _ in built],
+            mip_gap=gap,
+            warms=warm_ilps,
+            max_rounds=max_rounds,
+            beam=beam,
+            ipm_iters=ipm_iters,
+            ipm_warm_iters=ipm_warm_iters,
+            node_cap=node_cap,
+            timings=timings,
+            lp_backend=lp_backend,
+            pdhg_iters=pdhg_iters,
+            pdhg_restart_tol=pdhg_restart_tol,
+        )
+    except ValueError:
+        # Static halves diverged — an excursion crossed a row-scale
+        # threshold, so the scenarios can no longer share ONE uploaded
+        # static blob. They still share a SIGNATURE (same fleet size,
+        # k grid, blob layout), which is all the multi-instance batch
+        # layout needs: pack each scenario with its OWN static half and
+        # solve them as one ``_solve_batched`` dispatch. Costs S static
+        # uploads instead of one; still one device dispatch, and the
+        # batch serves instead of raising.
+        outs = _scenarios_via_batchlayout(
+            built, kWs, gap, warm_ilps,
+            max_rounds=max_rounds, beam=beam, ipm_iters=ipm_iters,
+            ipm_warm_iters=ipm_warm_iters, node_cap=node_cap,
+            lp_backend=lp_backend, pdhg_iters=pdhg_iters,
+            pdhg_restart_tol=pdhg_restart_tol, timings=timings,
+        )
 
     results: List[HALDAResult] = []
     for i, (_, best) in enumerate(outs):

@@ -332,6 +332,9 @@ class RoundingData(NamedTuple):
     eb_ram: jax.Array  # (M,) MoE bytes per y-unit charged to the primary pool
     eb_vram: jax.Array  # (M,) MoE bytes per y-unit charged to discrete VRAM
     eb_metal: jax.Array  # (M,) MoE bytes per y-unit on the Metal wired row
+    w_active: jax.Array  # (M,) float 0/1 — 0 marks a phantom pad device
+    #                      whose w is pinned to [0,0] (batch layout padding);
+    #                      real devices keep the classic w >= 1 floor
     bprime: jax.Array  # scalar
     E: jax.Array  # scalar: routed experts per MoE layer (0 = dense)
 
@@ -365,6 +368,12 @@ def _rounding_arrays_np(coeffs: HaldaCoeffs, moe=None) -> dict:
         ),
         eb_metal=np.asarray(
             moe.eb_metal if moe is not None else np.zeros(M), np.float64
+        ),
+        w_active=np.asarray(
+            getattr(coeffs, "w_active", None)
+            if getattr(coeffs, "w_active", None) is not None
+            else np.ones(M),
+            np.float64,
         ),
         bprime=np.float64(coeffs.bprime),
         E=np.float64(moe.E if moe is not None else 0.0),
@@ -618,8 +627,13 @@ def _round_to_incumbent(
     n_frac = v[M : 2 * M]
 
     rem = w_frac - jnp.floor(w_frac)
-    w = jnp.clip(jnp.floor(w_frac), 1.0, Wf)
-    w = _int_redistribute(w, rem, 1.0, Wf, Wf, M)
+    # Per-device box: real devices keep the classic [1, W] floor/cap;
+    # phantom pad devices (w_active == 0, batch-layout padding) are pinned
+    # to [0, 0] so rounding can never place a layer on them.
+    w_lo = rd.w_active
+    w_hi = Wf * rd.w_active
+    w = jnp.clip(jnp.floor(w_frac), w_lo, w_hi)
+    w = _int_redistribute(w, rem, w_lo, w_hi, Wf, M)
     valid = w.sum() == Wf
 
     n = jnp.clip(jnp.round(n_frac), 0.0, w) * rd.has_gpu
@@ -1995,6 +2009,7 @@ _RD_VEC_FIELDS = (
     "eb_ram",
     "eb_vram",
     "eb_metal",
+    "w_active",
 )
 
 
@@ -2524,6 +2539,67 @@ def _solve_scenarios_packed(
 _solve_scenarios_packed = instrument(
     "solver._solve_scenarios_packed",
     jax.jit(_solve_scenarios_packed, static_argnames=_PACKED_STATIC_ARGS),
+    static_argnames=_PACKED_STATIC_ARGS,
+)
+
+
+def _solve_batched(
+    static_blobs: jax.Array,  # (B, static_len)
+    dyn_blobs: jax.Array,  # (B, dyn_len)
+    M: int,
+    n_k: int,
+    m: int,
+    nf: int,
+    cap: int,
+    ipm_iters: int = IPM_ITERS,
+    max_rounds: int = MAX_ROUNDS,
+    beam: Optional[int] = BEAM,
+    moe: bool = False,
+    has_warm: bool = False,
+    w_max: int = 0,
+    e_max: int = 0,
+    decomp_steps: int = 0,
+    has_duals: bool = False,
+    per_k: bool = False,
+    has_margin: bool = False,
+    ipm_warm_iters: Optional[int] = None,
+    has_root_warm: bool = False,
+    lp_backend: str = "ipm",
+    pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    diag: bool = False,
+) -> jax.Array:
+    """Cross-instance batch: N heterogeneous HALDA instances, ONE dispatch.
+
+    Where ``_solve_scenarios_packed`` vmaps over dynamic blobs of a single
+    instance family (one static half shared by every scenario), this entry
+    vmaps over BOTH halves — each batch lane carries its own static blob
+    (its own A matrix, boxes, row scaling, integer mask), so instances from
+    unrelated fleets solve side by side as long as their static-shape
+    signature (this function's static argnames plus the two blob lengths)
+    matches. Mixed device counts within a bucket ride phantom padding
+    (``solver.batchlayout``): every lane is a complete, exactly-priced MILP,
+    so per-lane certificates decode independently.
+    """
+    return jax.vmap(
+        lambda stat, dyn: _solve_packed_impl(
+            stat, dyn, M=M, n_k=n_k, m=m, nf=nf, cap=cap,
+            ipm_iters=ipm_iters, max_rounds=max_rounds, beam=beam, moe=moe,
+            has_warm=has_warm, w_max=w_max, e_max=e_max,
+            decomp_steps=decomp_steps, has_duals=has_duals, per_k=per_k,
+            has_margin=has_margin, ipm_warm_iters=ipm_warm_iters,
+            has_root_warm=has_root_warm, lp_backend=lp_backend,
+            pdhg_restart_tol=pdhg_restart_tol, diag=diag,
+        )
+    )(static_blobs, dyn_blobs)
+
+
+# Registered entry for the cross-shard combiner (distilp_tpu.combine): one
+# executable per bucket signature. Bucket boundaries come from a COMMITTED
+# policy (combine.BucketPolicy), so warm bucket traffic re-dispatches this
+# same executable — the PR 14 zero-recompile gate holds across it.
+_solve_batched = instrument(
+    "solver._solve_batched",
+    jax.jit(_solve_batched, static_argnames=_PACKED_STATIC_ARGS),
     static_argnames=_PACKED_STATIC_ARGS,
 )
 

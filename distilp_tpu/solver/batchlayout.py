@@ -1,0 +1,513 @@
+"""Multi-instance batch layout: N heterogeneous HALDA instances, one dispatch.
+
+``solve_sweep_jax`` packs ONE instance into a (static, dynamic) blob pair and
+dispatches ``_solve_packed``; ``solve_sweep_scenarios`` batches K futures of
+one fleet but requires every scenario to share scenario 0's static half (same
+A matrix, same row scaling — the PR 9 ``ValueError`` on row-scale-crossing
+excursions is exactly that constraint biting). This module factors the packing
+prelude out into a form the cross-shard combiner (``distilp_tpu.combine``) can
+batch: each instance keeps its OWN static half, and ``_solve_batched`` vmaps
+over both blob stacks, so unrelated fleets — different profiles, different
+row scaling, different warm state — solve side by side in one executable as
+long as their shape signature matches.
+
+Mixed device counts inside a bucket ride *phantom padding* (``pad_instance``):
+a dense instance with ``M_real`` devices is extended to the bucket's ``M_pad``
+with zero-cost phantom devices whose layer count is pinned to the ``[0, 0]``
+box (the assembly already pins out-of-set slack/t/n variables the same way).
+Every phantom coefficient is zero and every phantom capacity row is inactive,
+so the padded MILP's feasible set is the real MILP's feasible set × {0}^pad:
+objective values, certificates, and duals carry over EXACTLY — the pad buys
+shape uniformity, not an approximation. The rounding heuristic learns about
+phantoms through one new ``w_active`` vector in the dynamic blob (0 marks a
+phantom; the per-device rounding box becomes ``[w_active, W·w_active]``).
+
+Padding is dense-only by policy: MoE sweeps run the Lagrangian decomposition,
+whose per-device cell enumeration over ``w ∈ [1, w_max]`` has no zero-width
+notion of a device — MoE instances bucket by exact M instead (dense sweeps
+zero ``w_max``/``e_max``/``decomp_steps``, so the decomposition never runs
+over a phantom).
+
+Decode reuses ``collect_sweep`` verbatim — each batch lane is decoded as its
+own ``PendingSweep`` (same layout guards, same margin-anchor refresh), then
+``unpad_result`` slices the assignment vectors back to ``M_real`` (phantom
+entries are provably zero: their box is ``[0, 0]``).
+
+The wire-cost contract carries over per LANE: ``_pack_static``'s
+drift-invariant half ships once per shard and then lives on-device behind
+``lane_static_to_device``'s content-addressed cache; a flush assembles the
+batch's static stack from the cached device copies (``jnp.stack`` of
+device arrays — a device-side op, not a host re-upload), so a warm bucket
+re-ships only the per-tick dynamic blobs. The whole-stack cache in
+``backend_jax`` can't do this job: bucket membership and lane order change
+flush to flush, so the STACKED bytes almost never repeat even when every
+individual lane is cache-hot.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, replace
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .assemble import INACTIVE_RHS, MilpArrays, assemble
+from .coeffs import HaldaCoeffs
+from .result import ILPResult
+
+__all__ = [
+    "PackedInstance",
+    "clear_lane_static_cache",
+    "lane_static_to_device",
+    "pad_instance",
+    "pack_instance",
+    "solve_batch",
+    "unpad_result",
+]
+
+# Per-lane device cache for combined static halves, keyed by the packed
+# bytes (1-D float32, so the byte string pins shape and content alike).
+# Sized for a full gateway of combinable shards — ``warm_combine`` primes
+# one entry per shard BEFORE the openloop warm boundary, so the measured
+# phase neither uploads static bytes nor grows live-array bytes (the PR 15
+# leak gate sees a size-stable cache). Eviction at the cap is always
+# correct — the evicted shard just pays one re-upload on its next flush.
+_LANE_STATIC_CACHE: "OrderedDict[bytes, object]" = OrderedDict()
+_LANE_STATIC_CAP = 512
+_LANE_STATIC_LOCK = threading.Lock()
+
+
+def lane_static_to_device(vec: np.ndarray):
+    """(device array, uploaded-this-call) for ONE lane's static half.
+
+    The combine analogue of ``backend_jax._static_to_device``: content
+    addressed, LRU-bounded, and alive-checked (a torn-down backend's
+    buffers read as misses, never as dispatch errors). ``warm_combine``
+    calls this for every combinable shard so steady-state flushes find
+    every lane device-resident.
+    """
+    from .backend_jax import _entry_alive
+
+    import jax.numpy as jnp
+
+    key = vec.tobytes()
+    with _LANE_STATIC_LOCK:
+        dev = _LANE_STATIC_CACHE.get(key)
+        if dev is not None:
+            if _entry_alive(dev):
+                _LANE_STATIC_CACHE.move_to_end(key)
+                return dev, False
+            del _LANE_STATIC_CACHE[key]
+    dev = jnp.asarray(vec)
+    with _LANE_STATIC_LOCK:
+        _LANE_STATIC_CACHE[key] = dev
+        while len(_LANE_STATIC_CACHE) > _LANE_STATIC_CAP:
+            _LANE_STATIC_CACHE.popitem(last=False)
+    return dev, True
+
+
+def clear_lane_static_cache() -> None:
+    """Drop cached per-lane static device blobs (tests; device teardown)."""
+    with _LANE_STATIC_LOCK:
+        _LANE_STATIC_CACHE.clear()
+
+
+def _ext(vec: np.ndarray, pad: int, fill: float = 0.0) -> np.ndarray:
+    """``vec`` extended by ``pad`` trailing ``fill`` entries (dtype kept)."""
+    out = np.full(len(vec) + pad, fill, dtype=np.asarray(vec).dtype)
+    out[: len(vec)] = vec
+    return out
+
+
+def pad_instance(
+    coeffs: HaldaCoeffs, arrays: MilpArrays, M_pad: int
+) -> Tuple[HaldaCoeffs, MilpArrays]:
+    """Extend a dense instance to ``M_pad`` devices with zero-cost phantoms.
+
+    The phantom profile: no compute (``a``/``b_gpu``/``xi``/``t_comm`` zero,
+    so ``busy_const`` and ``obj_const`` are unchanged), no memory (every
+    capacity row inactive), no accelerator, no disk penalty. Post-assembly
+    the phantom boxes are pinned: ``w ∈ [0, 0]`` (dropping the global
+    ``w >= 1`` floor for phantoms only), the phantom's set-3 slack and its
+    ``z`` overflow to ``[0, 0]`` as well. The Σw equality then forces every
+    layer onto real devices, and each phantom's rows are identically slack —
+    the optimum, its certificate, and the per-k objectives are EXACTLY those
+    of the unpadded instance.
+
+    The returned coeffs carry ``w_active`` (1 real / 0 phantom), which
+    ``_rounding_arrays_np`` picks up and ships to the on-device rounding
+    heuristic via the dynamic blob.
+    """
+    M = coeffs.M
+    if M_pad < M:
+        raise ValueError(f"cannot pad M={M} down to {M_pad}")
+    if arrays.moe is not None:
+        raise ValueError(
+            "phantom padding is dense-only: the MoE Lagrangian decomposition "
+            "enumerates w in [1, w_max] per device and has no zero-width "
+            "device; bucket MoE instances by exact M instead"
+        )
+    pad = M_pad - M
+    if pad == 0:
+        return coeffs, arrays
+
+    false_pad = np.zeros(pad, dtype=bool)
+    coeffs_p = replace(
+        coeffs,
+        M=M_pad,
+        a=_ext(coeffs.a, pad),
+        b_gpu=_ext(coeffs.b_gpu, pad),
+        xi=_ext(coeffs.xi, pad),
+        t_comm=_ext(coeffs.t_comm, pad),
+        # A phantom never streams: its disk is "infinitely fast" so the
+        # prefetch row's bp/s_disk term vanishes instead of dividing by 0.
+        s_disk=_ext(coeffs.s_disk, pad, INACTIVE_RHS),
+        pen_m1=_ext(coeffs.pen_m1, pad),
+        pen_m2=_ext(coeffs.pen_m2, pad),
+        pen_m3=_ext(coeffs.pen_m3, pad),
+        pen_vram=_ext(coeffs.pen_vram, pad),
+        set_id=_ext(coeffs.set_id, pad, 3),
+        has_gpu=np.concatenate([coeffs.has_gpu, false_pad]),
+        ram_rhs=_ext(coeffs.ram_rhs, pad, INACTIVE_RHS),
+        ram_minus_n=np.concatenate([coeffs.ram_minus_n, false_pad]),
+        cuda_row=np.concatenate([coeffs.cuda_row, false_pad]),
+        cuda_rhs=_ext(coeffs.cuda_rhs, pad),
+        metal_row=np.concatenate([coeffs.metal_row, false_pad]),
+        metal_rhs=_ext(coeffs.metal_rhs, pad),
+    )
+    arrays_p = assemble(coeffs_p)
+    lay = arrays_p.layout
+    for i in range(M, M_pad):
+        arrays_p.lb[lay.w(i)] = 0.0  # drop the w >= 1 floor
+        arrays_p.ub_scale[lay.w(i)] = 0.0  # w <= 0: pinned box
+        arrays_p.ub_scale[lay.s3(i)] = 0.0  # no free zero-cost slack
+        arrays_p.ub_const[lay.z(i)] = 0.0  # no degenerate overflow column
+        arrays_p.ub_scale[lay.z(i)] = 0.0
+    coeffs_p.w_active = np.concatenate([np.ones(M), np.zeros(pad)])
+    return coeffs_p, arrays_p
+
+
+@dataclass
+class PackedInstance:
+    """One instance packed for a cross-shard batch: the two blobs, the jit
+    static-argument set, and everything decode needs to rebuild a
+    ``PendingSweep`` for its lane of the batched output."""
+
+    static_np: np.ndarray  # f32 drift-invariant half (per-lane in a batch)
+    dyn_np: np.ndarray  # f32 per-tick half (f64 certificate bits inside)
+    statics: dict  # _PACKED_STATIC_ARGS name -> value for _solve_batched
+    M_real: int
+    M_pad: int
+    feasible: List[Tuple[int, int]]
+    kWs: List[Tuple[int, int]]
+    mip_gap: float
+    nf: int
+    m: int
+    margin_ctx: Optional[tuple] = None
+    stats: Optional[dict] = None
+
+    @property
+    def signature(self) -> tuple:
+        """Bucket identity: instances solve in one ``_solve_batched``
+        executable iff their signatures are equal — the jit static args plus
+        the two blob lengths (together they pin the traced program and every
+        argument shape)."""
+        from .backend_jax import _PACKED_STATIC_ARGS
+
+        return tuple(self.statics[a] for a in _PACKED_STATIC_ARGS) + (
+            int(self.static_np.size),
+            int(self.dyn_np.size),
+        )
+
+
+def _pad_warm(warm: Optional[ILPResult], pad: int) -> Optional[ILPResult]:
+    """Zero-extend a warm hint's assignment vectors to the padded width.
+
+    Phantom entries get w = n = y = 0 — exactly the padded optimum's shape —
+    so the hint re-prices on-device to the same objective it had unpadded.
+    ``duals``/``ipm_state`` ride along untouched; their shape gates in
+    ``_warm_and_duals`` refuse them when the padded family's shapes differ
+    (a refusal costs pruning speed, never correctness).
+    """
+    if warm is None or pad == 0 or warm.w is None:
+        return warm
+    zeros = [0] * pad
+    return warm.model_copy(
+        update={
+            "w": list(warm.w) + zeros,
+            "n": list(warm.n) + zeros if warm.n is not None else None,
+            "y": list(warm.y) + zeros if warm.y is not None else None,
+        }
+    )
+
+
+def pack_instance(
+    arrays: MilpArrays,
+    kWs: Sequence[Tuple[int, int]],
+    mip_gap: float = 1e-4,
+    coeffs: Optional[HaldaCoeffs] = None,
+    warm: Optional[ILPResult] = None,
+    M_pad: Optional[int] = None,
+    ipm_iters: Optional[int] = None,
+    max_rounds: Optional[int] = None,
+    beam: Optional[int] = None,
+    node_cap: Optional[int] = None,
+    ipm_warm_iters: Optional[int] = None,
+    lp_backend: Optional[str] = None,
+    pdhg_iters: Optional[int] = None,
+    pdhg_restart_tol: Optional[float] = None,
+    margin_state: Optional[dict] = None,
+    per_k_optima: bool = False,
+    stats: Optional[dict] = None,
+) -> Optional[PackedInstance]:
+    """Pack one instance for batched solving — ``solve_sweep_jax``'s prelude
+    (feasibility filter, standard form, search-parameter resolution, warm and
+    dual preparation, blob packing) without the dispatch.
+
+    ``M_pad`` pads a dense instance to a bucket boundary (``pad_instance``).
+    Feasibility is judged against the REAL device count — a k with
+    ``W < M_real`` can't give every real device a layer, while phantoms take
+    none — and the padded family zeroes the decomposition statics exactly as
+    the per-shard dense path does.
+
+    Returns None when no k is structurally feasible (mirrors
+    ``solve_sweep_jax``'s ``(results, None)`` early-out).
+    """
+    from .backend_jax import (
+        DEFAULT_RESTART_TOL,
+        _pack_dynamic,
+        _pack_static,
+        _resolve_search_params,
+        _rounding_arrays_np,
+        _warm_and_duals,
+        build_standard_form,
+        margin_bounds_from_state,
+    )
+
+    if coeffs is None:
+        raise ValueError("pack_instance requires the HaldaCoeffs used for assembly")
+    M_real = arrays.layout.M
+    feasible = [(k, W) for (k, W) in kWs if W >= M_real]
+    if not feasible:
+        return None
+
+    M_pad = M_real if M_pad is None else int(M_pad)
+    if M_pad != M_real:
+        coeffs, arrays = pad_instance(coeffs, arrays, M_pad)
+        warm = _pad_warm(warm, M_pad - M_real)
+
+    sf = build_standard_form(arrays, coeffs, feasible)
+    n_k = len(sf.ks)
+    (
+        cap, beam, ipm_iters, ipm_warm_iters, max_rounds, engine,
+    ) = _resolve_search_params(
+        sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds,
+        per_k=per_k_optima, ipm_warm_iters=ipm_warm_iters,
+        lp_backend=lp_backend, pdhg_iters=pdhg_iters, M=M_pad,
+    )
+    restart_tol = (
+        DEFAULT_RESTART_TOL if pdhg_restart_tol is None else pdhg_restart_tol
+    )
+    warm_tuple, duals_tuple, root_warm_tuple = _warm_and_duals(
+        sf, arrays, warm, feasible
+    )
+    if sf.moe:
+        from .backend_jax import DECOMP_STEPS_COLD, DECOMP_STEPS_WARM
+
+        w_max = max(W for _, W in feasible)
+        e_max = int(arrays.moe.E)
+        decomp_steps = (
+            DECOMP_STEPS_WARM
+            if duals_tuple is not None and warm_tuple is not None
+            else DECOMP_STEPS_COLD
+        )
+    else:
+        w_max = e_max = decomp_steps = 0
+
+    rd_np = _rounding_arrays_np(coeffs, arrays.moe)
+    margin_np = None
+    if (
+        margin_state is not None
+        and sf.moe
+        and warm_tuple is not None
+        and duals_tuple is not None
+        and not per_k_optima
+    ):
+        margin_np = margin_bounds_from_state(margin_state, rd_np, sf, duals_tuple)
+    has_margin = margin_np is not None
+
+    static_np = _pack_static(sf)
+    dyn_np = _pack_dynamic(
+        sf, rd_np, mip_gap, warm_tuple, duals=duals_tuple, margin=margin_np,
+        root_warm=root_warm_tuple,
+    )
+    statics = dict(
+        M=M_pad,
+        n_k=n_k,
+        m=sf.A.shape[1],
+        nf=sf.A.shape[2],
+        cap=cap,
+        ipm_iters=ipm_iters,
+        max_rounds=max_rounds,
+        beam=beam,
+        moe=sf.moe,
+        has_warm=warm_tuple is not None,
+        w_max=w_max,
+        e_max=e_max,
+        decomp_steps=decomp_steps,
+        has_duals=duals_tuple is not None,
+        per_k=per_k_optima,
+        has_margin=has_margin,
+        ipm_warm_iters=ipm_warm_iters,
+        has_root_warm=root_warm_tuple is not None,
+        lp_backend=engine,
+        pdhg_restart_tol=restart_tol,
+        diag=False,
+    )
+    return PackedInstance(
+        static_np=static_np,
+        dyn_np=dyn_np,
+        statics=statics,
+        M_real=M_real,
+        M_pad=M_pad,
+        feasible=feasible,
+        kWs=list(kWs),
+        mip_gap=mip_gap,
+        nf=sf.A.shape[2],
+        m=sf.A.shape[1],
+        margin_ctx=(
+            (
+                margin_state, has_margin, rd_np,
+                np.asarray(sf.ks, np.float64),
+                np.asarray(sf.Ws, np.float64),
+            )
+            if margin_state is not None and sf.moe
+            else None
+        ),
+        stats=stats,
+    )
+
+
+def unpad_result(res: Optional[ILPResult], M_real: int) -> Optional[ILPResult]:
+    """Slice a padded lane's assignment vectors back to the real fleet.
+
+    Phantom entries are provably zero (their ``[0, 0]`` box), so the slice
+    discards nothing; ``duals`` (MoE — never padded) and ``ipm_state`` (the
+    padded family's root iterates, valid verbatim on the lane's next padded
+    solve and shape-refused by an unpadded one) pass through untouched.
+    """
+    if res is None or res.w is None or len(res.w) <= M_real:
+        return res
+    return res.model_copy(
+        update={
+            "w": list(res.w[:M_real]),
+            "n": list(res.n[:M_real]) if res.n is not None else None,
+            "y": list(res.y[:M_real]) if res.y is not None else None,
+        }
+    )
+
+
+def solve_batch(
+    instances: Sequence[PackedInstance],
+    timings: Optional[dict] = None,
+    lane_pad: Optional[int] = None,
+) -> List[Tuple[List[Optional[ILPResult]], Optional[ILPResult]]]:
+    """Solve N same-signature instances in ONE ``_solve_batched`` dispatch.
+
+    Returns ``solve_sweep_jax``'s ``(per_k_results, best)`` contract per
+    instance, in order, with padded lanes already sliced back to their real
+    fleet. Each lane decodes through ``collect_sweep`` — the same layout
+    guards, certificate math, and margin-anchor refresh as the per-shard
+    path, which is what makes a combined tick indistinguishable downstream.
+
+    ``lane_pad`` (>= N) pads the batch to a fixed lane COUNT by repeating
+    the last instance's blobs: the lane axis is a compile-shape dimension
+    of the vmapped executable, so quantizing it (``BucketPolicy.
+    quantize_lanes``) keeps the reachable executable set finite — the
+    zero-recompile contract. Duplicate lanes are solved but never decoded.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from .backend_jax import (
+        PendingSweep,
+        _solve_batched,
+        collect_sweep,
+    )
+
+    if not instances:
+        return []
+    sig0 = instances[0].signature
+    for i, inst in enumerate(instances[1:], 1):
+        if inst.signature != sig0:
+            raise ValueError(
+                f"solve_batch requires one bucket signature: instance {i} "
+                f"has {inst.signature}, instance 0 has {sig0} — group "
+                f"instances with combine.BucketPolicy first"
+            )
+
+    t0 = _time.perf_counter()
+    n_real = len(instances)
+    if lane_pad is not None and lane_pad < n_real:
+        raise ValueError(f"lane_pad {lane_pad} < batch size {n_real}")
+    n_lanes = lane_pad if lane_pad is not None else n_real
+    statics_np = [inst.static_np for inst in instances]
+    dyns_np = [inst.dyn_np for inst in instances]
+    if n_lanes > n_real:
+        statics_np += [statics_np[-1]] * (n_lanes - n_real)
+        dyns_np += [dyns_np[-1]] * (n_lanes - n_real)
+    dyn_stack = np.stack(dyns_np)
+    t1 = _time.perf_counter()
+    # Per-LANE content addressing: each shard's static half is fetched from
+    # (or installed into) the device cache individually, and the batch's
+    # static stack is assembled device-side — bucket membership churn costs
+    # zero static re-uploads as long as the lanes themselves are cache-hot.
+    lane_pairs = [lane_static_to_device(s) for s in statics_np]
+    static_dev = jnp.stack([dev for dev, _ in lane_pairs])
+    lane_uploads = sum(1 for _, up in lane_pairs if up)
+    dyn_dev = jnp.asarray(dyn_stack)
+    out_dev = _solve_batched(static_dev, dyn_dev, **instances[0].statics)
+    out_np = np.asarray(jax.device_get(out_dev))
+    t2 = _time.perf_counter()
+
+    decoded = []
+    for b, inst in enumerate(instances):
+        st = inst.statics
+        pending = PendingSweep(
+            out=out_np[b],
+            results=[None] * len(inst.kWs),
+            feasible=inst.feasible,
+            kWs=inst.kWs,
+            M=inst.M_pad,
+            n_k=st["n_k"],
+            moe=st["moe"],
+            w_max=st["w_max"],
+            mip_gap=inst.mip_gap,
+            debug=False,
+            per_k=st["per_k"],
+            margin_ctx=inst.margin_ctx,
+            nf=inst.nf,
+            m=inst.m,
+            stats=inst.stats,
+        )
+        results, best = collect_sweep(pending)
+        decoded.append(
+            (
+                [unpad_result(r, inst.M_real) for r in results],
+                unpad_result(best, inst.M_real),
+            )
+        )
+    if timings is not None:
+        timings["batch_size"] = n_real
+        timings["lanes"] = n_lanes
+        timings["pack_ms"] = (t1 - t0) * 1e3
+        timings["solve_ms"] = (t2 - t1) * 1e3
+        # Fraction of lanes served from the device cache (per-lane hit
+        # rate, not the per-shard path's 0/1 whole-blob verdict).
+        timings["static_hit"] = 1.0 - lane_uploads / n_lanes
+        timings["decode_ms"] = (_time.perf_counter() - t2) * 1e3
+    return decoded

@@ -92,6 +92,29 @@ def _decode_state(obj):
     return obj
 
 
+class CombinePrep:
+    """One prepared combined tick: the packed instance plus everything
+    ``adopt()`` needs to redeem the lane (sets for result decode, the warm
+    flag for mode accounting, fleet/model snapshots for the escalation
+    re-solve). Produced by ``StreamingReplanner.prepare``."""
+
+    __slots__ = (
+        "instance", "sets", "shape", "warm_used", "devs", "model",
+        "k_candidates",
+    )
+
+    def __init__(
+        self, instance, sets, shape, warm_used, devs, model, k_candidates
+    ):
+        self.instance = instance
+        self.sets = sets
+        self.shape = shape
+        self.warm_used = warm_used
+        self.devs = devs
+        self.model = model
+        self.k_candidates = k_candidates
+
+
 class StreamingReplanner:
     """Holds the previous placement and re-solves warm on every tick.
 
@@ -458,6 +481,135 @@ class StreamingReplanner:
             self._load_factors = None
         self.last = result
         self._last_shape = shape
+        return result
+
+    def prepare(
+        self,
+        devs: Sequence[DeviceProfile],
+        model: ModelProfile,
+        k_candidates: Optional[Sequence[int]] = None,
+        M_pad: Optional[int] = None,
+        warm_override=None,
+    ):
+        """Combined tick, pack half: this tick as a ``PackedInstance`` for a
+        cross-shard batched solve (``solver.batchlayout`` / the gateway's
+        ``combine`` path). Pair with ``adopt()``.
+
+        Returns None when the tick cannot ride a batch — MoE profiles (the
+        load-factor fixed point and the margin ladder are iterative multi-
+        solve loops; those shards stay on the per-shard path) or a non-jax
+        backend. Raises RuntimeError when no k is structurally feasible,
+        same as ``step()`` would.
+
+        Warm seeding is identical to ``step()``: the previous result when
+        the fleet shape matched, re-priced exactly on-device. ``M_pad``
+        extends the instance to a bucket boundary with phantom devices
+        (see ``batchlayout.pad_instance`` — exact, not approximate).
+        ``warm_override`` (an ``ILPResult``) substitutes the warm hint
+        without touching planner state — ``Gateway.warm_combine`` uses it
+        to trace the steady-state signature (root-warm iterates from a
+        prior BATCHED solve carry padded-family shapes, which flips
+        ``has_root_warm`` relative to a per-shard-seeded pack).
+        """
+        from .api import _build_instance, _warm_to_ilp
+        from .batchlayout import pack_instance
+        from .moe import model_has_moe_components
+
+        if self.backend != "jax":
+            return None
+        use_moe = (
+            model_has_moe_components(model) if self.moe is None else bool(self.moe)
+        )
+        if use_moe:
+            return None
+        shape = (len(devs), model.L, use_moe)
+        warm = self.last if shape == self._last_shape else None
+        if self.cold_start:
+            warm = None
+
+        Ks, sets, coeffs, arrays = _build_instance(
+            devs, model, k_candidates, self.kv_bits, False, None, 1
+        )
+        knobs = {
+            key: self.search.get(key)
+            for key in (
+                "ipm_iters", "max_rounds", "beam", "node_cap",
+                "ipm_warm_iters", "lp_backend", "pdhg_iters",
+                "pdhg_restart_tol",
+            )
+        }
+        inst = pack_instance(
+            arrays,
+            [(k, model.L // k) for k in Ks],
+            mip_gap=self.mip_gap,
+            coeffs=coeffs,
+            warm=(
+                warm_override if warm_override is not None
+                else _warm_to_ilp(warm)
+            ),
+            M_pad=M_pad,
+            **knobs,
+        )
+        if inst is None:
+            raise RuntimeError("No feasible MILP found for any k.")
+        # Snapshot fleet + model exactly like submit(): adopt()'s
+        # escalation re-solve must price THIS tick's profiles, not whatever
+        # they drifted to by the time the batch lands.
+        return CombinePrep(
+            instance=inst,
+            sets=sets,
+            shape=shape,
+            warm_used=warm is not None or warm_override is not None,
+            devs=[d.model_copy() for d in devs],
+            model=model.model_copy(),
+            k_candidates=list(k_candidates) if k_candidates else None,
+        )
+
+    def adopt(self, prep, decoded, timings: Optional[dict] = None) -> HALDAResult:
+        """Combined tick, redeem half: fold one lane of a batched solve back
+        into this replanner's warm state, exactly as if ``step()`` had
+        produced it.
+
+        ``decoded`` is this instance's ``(per_k_results, best)`` pair from
+        ``batchlayout.solve_batch``. An uncertified lane escalates
+        per-shard — a full ``halda_solve`` warm-seeded from the batch
+        incumbent, which runs the solver's own escalation ladder — so a
+        combined tick's certificate contract equals the per-shard path's.
+        """
+        from .api import _best_to_result, halda_solve
+
+        results, best = decoded
+        if best is None:
+            raise RuntimeError("No feasible MILP found for any k.")
+        result = _best_to_result(best, prep.sets)
+        escalations = 0
+        if not result.certified:
+            escalations = 1
+            result = halda_solve(
+                prep.devs,
+                prep.model,
+                k_candidates=prep.k_candidates,
+                mip_gap=self.mip_gap,
+                kv_bits=self.kv_bits,
+                backend="jax",
+                moe=False,
+                warm=result,
+                timings=timings,
+                **self.search,
+            )
+        self.last_tick_mode = "warm" if prep.warm_used else "cold"
+        self.last_tick_escalations = escalations
+        if self.metrics is not None:
+            self.metrics.record_tick(
+                mode=self.last_tick_mode,
+                certified=result.certified,
+                escalations=escalations,
+            )
+        self.last = result
+        self._last_shape = prep.shape
+        self.last_mapping = None
+        self._load_factors = None
+        self.last_tick_timings = dict(timings) if timings is not None else {}
         return result
 
     def reset(self) -> None:

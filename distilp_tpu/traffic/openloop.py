@@ -271,6 +271,8 @@ def run_openloop(
     scheduler_kwargs: Optional[dict] = None,
     max_queue_depth: Optional[int] = None,
     coalesce: bool = False,
+    combine: bool = False,
+    combine_policy=None,
     degrade_depth: Optional[int] = None,
     flight=None,
     tracer=None,
@@ -364,8 +366,28 @@ def run_openloop(
         gateway.configure_admission(
             max_queue_depth=max_queue_depth,
             coalesce=coalesce,
+            combine=combine,
+            combine_policy=combine_policy,
             degrade_depth=degrade_depth,
         )
+        combine_warm = None
+        if combine:
+            # Combined traffic has its own compile surface (one vmapped
+            # executable per committed bucket x lane shape): trace all of
+            # it BEFORE the warm boundary, or the flood pays it live.
+            combine_warm = gateway.warm_combine()
+        if _mled is not None:
+            # The admission flip IS openloop's warm boundary: everything
+            # before it (fleet registration, per-fleet warmup solves,
+            # combined-executable tracing) is allowed to allocate; live
+            # bytes must stay flat from here. Without this baseline the
+            # arm's mem block reports ``leak: null`` forever.
+            _mled.mark_warm()
+        # Warm-phase compile token: the compile block reports the arm's
+        # full delta AND the post-warm-boundary slice — the latter is the
+        # zero-recompile gate's number (warmup compiles are the contract;
+        # measured-phase compiles are the violation).
+        _led_warm_tok = _led.seq() if _led is not None else 0
         report = asyncio.run(
             execute_openloop(
                 gateway, items, time_scale=time_scale, timeline=timeline
@@ -392,14 +414,27 @@ def run_openloop(
                 "admission": {
                     "max_queue_depth": max_queue_depth,
                     "coalesce": coalesce,
+                    "combine": combine,
                     "degrade_depth": degrade_depth,
                 },
             }
         )
+        if combine:
+            report["combine"] = dict(
+                gateway._combiner.snapshot()
+                if gateway._combiner is not None else {}
+            )
+            report["combine"]["warmup"] = combine_warm
+            for ctr in (
+                "combine_prepared", "combine_local",
+                "combine_stale", "combine_fallback",
+            ):
+                report["combine"][ctr] = totals.get(ctr, 0)
         if flight is not None:
             report["shed_violations"] = shed_violations(gateway, flight)
         if _led is not None:
             arm_events = _led.events_since(_led_tok)
+            warm_events = _led.events_since(_led_warm_tok)
             report["compile"] = {
                 "events": len(arm_events),
                 "cache_hits": sum(
@@ -409,6 +444,21 @@ def run_openloop(
                     1 for e in arm_events if e.get("storm")
                 ),
                 "entries": sorted({e["entry"] for e in arm_events}),
+                "warm_phase_events": len(warm_events),
+                "warm_phase_entries": sorted(
+                    {e["entry"] for e in warm_events}
+                ),
+                # The combine zero-recompile gate's number: warm-phase
+                # compiles of the BUCKET executable specifically. A
+                # per-shard entry here (e.g. an uncertified lane's local
+                # fallback re-solving with escalated search parameters)
+                # is attributed under warm_phase_entries but is not a
+                # committed-bucket-policy violation.
+                "warm_phase_combine_events": sum(
+                    1
+                    for e in warm_events
+                    if "_solve_batched" in str(e.get("entry", ""))
+                ),
             }
         if _mled is not None:
             # Per-arm memory view (one forced end-of-arm sample — the

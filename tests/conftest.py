@@ -20,6 +20,17 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 REPO_ROOT = Path(__file__).resolve().parents[1]
+
+# The suite is XLA-compile dominated (every solver shape is a multi-second
+# trace on the 2-core CI box) and the tier-1 gate runs it under a hard wall
+# clock. Persist compiled executables across pytest processes so repeat runs
+# pay dispatch, not compilation. Subprocess tests (CLI, smoke daemon) inherit
+# the same cache through the environment. setdefault: an explicit cache dir
+# in the environment (or pointing at a tmpfs) wins.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", str(REPO_ROOT / ".cache" / "jax")
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 sys.path.insert(0, str(REPO_ROOT))
 
 from distilp_tpu.axon_guard import force_cpu_platform  # noqa: E402

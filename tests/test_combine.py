@@ -1,0 +1,487 @@
+"""Cross-shard solve combiner: batch-layout parity on the golden fixtures,
+mixed-M padded buckets with per-instance certificates, warm-state round
+trips through batched solves, the committed bucket policy, and the
+combiner flush thread."""
+
+import warnings
+
+import pytest
+
+pytest.importorskip("jax")
+
+from distilp_tpu.common import (  # noqa: E402
+    load_from_profile_folder,
+    load_model_profile,
+)
+from distilp_tpu.solver import halda_solve  # noqa: E402
+from distilp_tpu.utils import make_synthetic_fleet  # noqa: E402
+
+GOLDEN = [
+    ("hermes_70b", 40, 29.643569),
+    ("llama_3_70b/4bit", 8, 12.834690),
+    ("llama_3_70b/online", 2, 1.934942),
+    ("qwen3_32b/bf16", 16, 12.072837),
+]
+
+
+def _pack(devs, model, mip_gap, M_pad=None, warm=None, k_candidates=None):
+    """One fleet as a (PackedInstance, sets) pair — the test-side analogue
+    of ``StreamingReplanner.prepare`` without planner state."""
+    from distilp_tpu.solver.api import _build_instance
+    from distilp_tpu.solver.batchlayout import pack_instance
+
+    Ks, sets, coeffs, arrays = _build_instance(
+        devs, model, k_candidates, "4bit", False, None, 1
+    )
+    inst = pack_instance(
+        arrays,
+        [(k, model.L // k) for k in Ks],
+        mip_gap=mip_gap,
+        coeffs=coeffs,
+        warm=warm,
+        M_pad=M_pad,
+    )
+    return inst, sets
+
+
+class _Ticket:
+    """Minimal stand-in for a scheduler CombineTicket: the combiner only
+    dereferences ``ticket.prep.instance``."""
+
+    def __init__(self, inst):
+        self.prep = type("P", (), {"instance": inst})()
+
+
+@pytest.mark.parametrize("folder,k_star,obj", GOLDEN)
+def test_combined_bucket_matches_golden(profiles_dir, folder, k_star, obj):
+    """A golden fixture solved through the combine path — packed at its
+    committed bucket boundary (phantom-padded), solved via
+    ``_solve_batched``, decoded per-instance — must reproduce the golden
+    optimum with a closed certificate, exactly like the per-shard path."""
+    from distilp_tpu.combine import BucketPolicy
+    from distilp_tpu.solver.api import _best_to_result
+    from distilp_tpu.solver.batchlayout import solve_batch
+
+    devs, model = load_from_profile_folder(profiles_dir / folder)
+    policy = BucketPolicy()
+    inst, sets = _pack(devs, model, 1e-4, M_pad=policy.pad_for(len(devs)))
+    assert inst.M_pad >= inst.M_real == len(devs)
+
+    decoded = solve_batch([inst])
+    assert len(decoded) == 1
+    _, best = decoded[0]
+    result = _best_to_result(best, sets)
+    assert result.k == k_star
+    assert result.obj_value == pytest.approx(obj, rel=2e-4)
+    assert result.certified
+    assert len(result.w) == len(devs)
+    assert sum(result.w) * result.k == model.L
+
+
+@pytest.mark.slow
+def test_combined_matches_per_shard_north_star(profiles_dir):
+    """The 16-device north-star instance through a batched solve matches
+    the per-shard ``_solve_packed`` result within the certification band."""
+    from distilp_tpu.solver.api import _best_to_result
+    from distilp_tpu.solver.batchlayout import solve_batch
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(16, seed=123)
+    gap = 1e-3
+    ref = halda_solve(devs, model, mip_gap=gap, kv_bits="4bit", backend="jax")
+
+    inst, sets = _pack(devs, model, gap, M_pad=16)
+    result = _best_to_result(solve_batch([inst])[0][1], sets)
+    assert result.certified and result.gap is not None and result.gap <= gap
+    assert result.obj_value == pytest.approx(ref.obj_value, rel=2 * gap)
+    assert sum(result.w) * result.k == model.L
+
+
+@pytest.mark.slow
+def test_mixed_m_bucket_pads_and_certifies_each_lane(profiles_dir):
+    """Three fleets of different sizes share one padded bucket: one
+    ``solve_batch`` dispatch, and every lane decodes to its OWN fleet's
+    width with its OWN closed certificate matching its per-shard solve."""
+    from distilp_tpu.solver.batchlayout import solve_batch
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    gap = 1e-3
+    # A fixed k grid (every W >= the largest fleet) keeps the feasibility
+    # filter from shrinking n_k for the M=8 fleet — the gateway's shards
+    # share k_candidates the same way.
+    ks = [8, 10]
+    fleets = [make_synthetic_fleet(M, seed=s) for M, s in [(4, 4), (6, 7), (8, 8)]]
+    packed = [_pack(devs, model, gap, M_pad=8, k_candidates=ks) for devs in fleets]
+    insts = [inst for inst, _ in packed]
+    assert len({inst.signature for inst in insts}) == 1, (
+        "mixed-M fleets padded to one boundary must share a bucket"
+    )
+
+    tm = {}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        decoded = solve_batch(insts, timings=tm)
+    assert tm["batch_size"] == 3
+    for devs, (inst, _), (per_k, best) in zip(fleets, packed, decoded):
+        assert best is not None and best.certified
+        assert best.gap is not None and best.gap <= gap
+        assert len(best.w) == inst.M_real == len(devs)
+        assert sum(best.w) * best.k == model.L
+        # Per-instance certificate decode: the lane's per-k entries are
+        # its own sweep, not a batch-level aggregate.
+        assert len(per_k) == len(inst.kWs)
+        ref = halda_solve(
+            devs, model, mip_gap=gap, kv_bits="4bit", backend="jax",
+            k_candidates=ks,
+        )
+        assert best.obj_value == pytest.approx(ref.obj_value, rel=2 * gap)
+
+
+@pytest.mark.slow
+def test_lane_padding_duplicates_solve_identically(profiles_dir):
+    """``lane_pad`` (the combiner's committed lane quantization) repeats
+    the last instance to a fixed lane count without changing any real
+    lane's decode."""
+    from distilp_tpu.solver.batchlayout import solve_batch
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    gap = 1e-3
+    insts = [
+        _pack(make_synthetic_fleet(M, seed=s), model, gap, M_pad=8,
+              k_candidates=[8, 10])[0]
+        for M, s in [(4, 4), (6, 7), (8, 8)]
+    ]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        plain = solve_batch(insts)
+        padded = solve_batch(insts, lane_pad=4)
+    assert len(plain) == len(padded) == 3
+    for (_, a), (_, b) in zip(plain, padded):
+        assert a.obj_value == pytest.approx(b.obj_value, abs=0.0)
+        assert a.w == b.w and a.n == b.n and a.k == b.k
+    with pytest.raises(ValueError, match="lane_pad"):
+        solve_batch(insts, lane_pad=2)
+
+
+def test_lane_static_cache_survives_membership_churn(profiles_dir):
+    """The per-lane static device cache: a repeat flush — and a REORDERED
+    flush, which the whole-stack cache could never hit — re-ships zero
+    static bytes (``static_hit == 1.0``) and decodes identically. This is
+    the combine analogue of the per-shard warm-tick wire-cost contract:
+    bucket membership churn must not re-upload drift-invariant halves."""
+    from distilp_tpu.solver.batchlayout import (
+        clear_lane_static_cache,
+        solve_batch,
+    )
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    insts = [
+        _pack(make_synthetic_fleet(4, seed=s), model, 1e-3, M_pad=4,
+              k_candidates=[8, 10])[0]
+        for s in (1, 2, 3)
+    ]
+    clear_lane_static_cache()
+    tm1, tm2, tm3 = {}, {}, {}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        d1 = solve_batch(insts, timings=tm1, lane_pad=4)
+        d2 = solve_batch(insts, timings=tm2, lane_pad=4)
+        d3 = solve_batch(list(reversed(insts)), timings=tm3, lane_pad=4)
+    # First contact uploads the three distinct lanes; the duplicated pad
+    # lane (same bytes as lane 3) already hits within the same flush.
+    assert tm1["static_hit"] == pytest.approx(0.25)
+    assert tm2["static_hit"] == 1.0
+    assert tm3["static_hit"] == 1.0
+    for (_, a), (_, b), (_, c) in zip(d1, d2, reversed(d3)):
+        assert a.obj_value == pytest.approx(b.obj_value, abs=0.0)
+        assert a.obj_value == pytest.approx(c.obj_value, abs=0.0)
+        assert a.w == b.w == c.w
+    # Validation happens before any dispatch: a lane_pad below the batch
+    # size must raise, never silently truncate lanes.
+    with pytest.raises(ValueError, match="lane_pad"):
+        solve_batch(insts, lane_pad=2)
+
+
+@pytest.mark.slow
+def test_warm_roundtrip_through_batched_solve_bit_exact(profiles_dir):
+    """A replanner whose warm state came from an adopted BATCHED solve
+    dump/load round-trips bit-exactly, and the restored replanner's next
+    combined tick packs the identical instance."""
+    from distilp_tpu.solver.batchlayout import solve_batch
+    from distilp_tpu.solver.streaming import StreamingReplanner
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(5, seed=3)
+    planner = StreamingReplanner(mip_gap=1e-3, kv_bits="4bit", backend="jax")
+
+    # Tick 1 per-shard (the warmup path), tick 2 combined.
+    planner.step(devs, model)
+    devs[2].t_comm *= 1.05
+    prep = planner.prepare(devs, model, M_pad=8)
+    assert prep is not None and prep.warm_used
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        result = planner.adopt(prep, solve_batch([prep.instance])[0])
+    assert result.certified
+    assert planner.last is result
+
+    blob = planner.dump_warm_state()
+    restored = StreamingReplanner(mip_gap=1e-3, kv_bits="4bit", backend="jax")
+    restored.load_warm_state(blob)
+    assert restored.dump_warm_state() == blob  # bit-exact round trip
+
+    # Same fleet state in, same packed bytes out: the restored replanner's
+    # combined tick is indistinguishable from the uninterrupted one's.
+    import numpy as np
+
+    prep_a = planner.prepare(devs, model, M_pad=8)
+    prep_b = restored.prepare(devs, model, M_pad=8)
+    assert prep_a.instance.signature == prep_b.instance.signature
+    assert np.array_equal(prep_a.instance.static_np, prep_b.instance.static_np)
+    # equal_nan: unused dual/warm slots are NaN sentinels by design.
+    assert np.array_equal(
+        prep_a.instance.dyn_np, prep_b.instance.dyn_np, equal_nan=True
+    )
+
+
+def test_scheduler_prepare_adopt_publishes_combine_mode(profiles_dir):
+    """The scheduler halves of a combined tick: ``prepare_combine`` packs
+    a ticket (no view), ``adopt_combine`` publishes mode='combine' with
+    the same counters/flight side effects as a local tick, and a stale
+    ticket (fleet advanced past it) is discarded, not adopted."""
+    from distilp_tpu.sched.events import DeviceDegrade
+    from distilp_tpu.sched.scheduler import Scheduler
+    from distilp_tpu.solver.batchlayout import solve_batch
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(4, seed=4)
+    sched = Scheduler(
+        devs, model, mip_gap=1e-3, kv_bits="4bit", backend="jax",
+        speculative=False,
+    )
+    # Warm up per-shard first — the gateway does the same before flipping
+    # admission into combine mode.
+    sched.handle(DeviceDegrade(name=devs[0].name, t_comm_scale=1.01))
+
+    ev = DeviceDegrade(name=devs[0].name, t_comm_scale=1.02)
+    ticket, view = sched.prepare_combine([ev], M_pad=4)
+    assert view is None and ticket is not None
+    assert sched.metrics.counters.get("combine_prepared") == 1
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        decoded = solve_batch([ticket.prep.instance])[0]
+    out = sched.adopt_combine(ticket, decoded)
+    assert out.mode == "combine"
+    assert out.result.certified
+    assert sched.latest().mode == "combine"
+
+    # Stale ticket: the fleet moved on (another event applied) before the
+    # batch landed — the decoded lane must be discarded, never published.
+    ticket2, view2 = sched.prepare_combine(
+        [DeviceDegrade(name=devs[1].name, t_comm_scale=1.01)], M_pad=4
+    )
+    assert ticket2 is not None and view2 is None
+    sched.handle(DeviceDegrade(name=devs[2].name, t_comm_scale=1.01))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        decoded2 = solve_batch([ticket2.prep.instance])[0]
+    served = sched.adopt_combine(ticket2, decoded2)
+    assert sched.metrics.counters.get("combine_stale") == 1
+    # The served view is the newer local tick's publication, not the stale
+    # lane: its solve seq is past the ticket's.
+    assert served.mode != "combine"
+    assert served.seq > ticket2.seq
+
+
+def test_bucket_policy_contract():
+    """The committed policy: boundary snapping, lane caps under a memory
+    budget, power-of-two lane quantization, and validation."""
+    from distilp_tpu.combine import BucketPolicy
+    from distilp_tpu.ops.memmodel import peak_bytes
+
+    p = BucketPolicy()
+    assert p.pad_for(1) == 2
+    assert p.pad_for(5) == 8
+    assert p.pad_for(128) == 128
+    assert p.pad_for(200) == 200  # above the top boundary: exact M
+    with pytest.raises(ValueError):
+        p.pad_for(0)
+
+    # Lane quantization: powers of two, clamped to the cap.
+    assert p.quantize_lanes(1, 8) == 1
+    assert p.quantize_lanes(3, 8) == 4
+    assert p.quantize_lanes(5, 8) == 8
+    assert p.quantize_lanes(16, 8) == 16
+    assert p.lane_shapes(8) == (1, 2, 4, 8, 16)
+
+    # A memory budget prices lanes via the analytic model at the PADDED M.
+    budget = 3 * peak_bytes(16, "ipm")
+    tight = BucketPolicy(mem_budget_bytes=budget)
+    assert tight.lane_cap(16) == 3
+    assert tight.lane_cap(128) == 1  # never below one lane
+    assert tight.quantize_lanes(2, 16) == 2
+    assert tight.quantize_lanes(3, 16) == 3  # cap overrides the pow2 snap
+    assert tight.lane_shapes(16) == (1, 2, 3)
+
+    with pytest.raises(ValueError):
+        BucketPolicy(boundaries=(4, 2))
+    with pytest.raises(ValueError):
+        BucketPolicy(max_batch=0)
+    with pytest.raises(ValueError):
+        BucketPolicy(max_wait_ms=-1.0)
+
+
+def test_combiner_thread_semantics_with_stub_solver(profiles_dir, monkeypatch):
+    """Tier-1 half of the flush-thread contract — bucketing by signature,
+    exactly-once delivery, drain on stop, post-stop fail-fast — with the
+    batched solver stubbed out so no executable is minted. The slow twin
+    below runs the identical protocol through real solves; the combiner
+    itself never inspects decoded lanes, so the thread semantics are
+    fully exercised here."""
+    import threading
+
+    from distilp_tpu.combine import BucketPolicy, CombineEntry, SolveCombiner
+    from distilp_tpu.solver import batchlayout as bl
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    ks = [8, 10]
+    insts = [
+        _pack(make_synthetic_fleet(4, seed=4), model, 1e-3, M_pad=8,
+              k_candidates=ks)[0],
+        _pack(make_synthetic_fleet(6, seed=7), model, 1e-3, M_pad=8,
+              k_candidates=ks)[0],
+        _pack(make_synthetic_fleet(4, seed=4), model, 1e-3, M_pad=4,
+              k_candidates=ks)[0],
+    ]
+    assert insts[0].signature == insts[1].signature != insts[2].signature
+
+    calls = []
+
+    def _stub_solve_batch(batch, timings=None, lane_pad=None):
+        calls.append(len(batch))
+        assert len({i.signature for i in batch}) == 1, (
+            "a flush must never mix signatures"
+        )
+        if timings is not None:
+            timings.update(batch_size=len(batch), static_hit=1.0)
+        return [("stub", None) for _ in batch]
+
+    # _flush imports solve_batch from the module at call time, so the
+    # module attribute is the patch point.
+    monkeypatch.setattr(bl, "solve_batch", _stub_solve_batch)
+
+    got = {}
+    done = threading.Event()
+
+    def deliver(i):
+        def _d(decoded, err):
+            got[i] = (decoded, err)
+            if len(got) == 3:
+                done.set()
+        return _d
+
+    combiner = SolveCombiner(BucketPolicy(max_wait_ms=20.0))
+    try:
+        for i, inst in enumerate(insts):
+            combiner.submit(CombineEntry(_Ticket(inst), deliver(i)))
+        assert done.wait(timeout=60.0), f"undelivered: {set(got)}"
+    finally:
+        combiner.stop()
+
+    for i in range(3):
+        decoded, err = got[i]
+        assert err is None and decoded == ("stub", None)
+
+    snap = combiner.snapshot()
+    assert snap["instances"] == 3
+    assert snap["batches"] == len(calls) == 2  # one flush per signature
+    assert sorted(calls) == [1, 2]  # the shared-sig pair rode together
+    assert snap["pending"] == 0 and snap["errors"] == 0
+
+    # Post-stop submits deliver an error immediately instead of queueing.
+    late = {}
+    combiner.submit(
+        CombineEntry(_Ticket(insts[0]), lambda d, e: late.update(err=e))
+    )
+    assert isinstance(late.get("err"), RuntimeError)
+
+
+@pytest.mark.slow
+def test_combiner_buckets_by_signature_and_drains_on_stop(profiles_dir):
+    """The flush thread: same-signature lanes batch together, different
+    signatures never share a dispatch, every submitted lane is delivered
+    exactly once (stop() drains), and post-stop submits fail fast."""
+    import threading
+
+    from distilp_tpu.combine import BucketPolicy, CombineEntry, SolveCombiner
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    gap = 1e-3
+
+    # Two buckets: two fleets padded to 8 (shared sig), one at 4.
+    ks = [8, 10]
+    insts = [
+        _pack(make_synthetic_fleet(4, seed=4), model, gap, M_pad=8,
+              k_candidates=ks)[0],
+        _pack(make_synthetic_fleet(6, seed=7), model, gap, M_pad=8,
+              k_candidates=ks)[0],
+        _pack(make_synthetic_fleet(4, seed=4), model, gap, M_pad=4,
+              k_candidates=ks)[0],
+    ]
+    assert insts[0].signature == insts[1].signature != insts[2].signature
+
+    got = {}
+    done = threading.Event()
+
+    def deliver(i):
+        def _d(decoded, err):
+            got[i] = (decoded, err)
+            if len(got) == 3:
+                done.set()
+        return _d
+
+    combiner = SolveCombiner(BucketPolicy(max_wait_ms=50.0))
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for i, inst in enumerate(insts):
+                combiner.submit(CombineEntry(_Ticket(inst), deliver(i)))
+            assert done.wait(timeout=300.0), f"undelivered: {set(got)}"
+    finally:
+        combiner.stop()
+
+    for i, inst in enumerate(insts):
+        decoded, err = got[i]
+        assert err is None
+        _, best = decoded
+        assert best is not None and best.certified
+        assert len(best.w) == inst.M_real
+
+    snap = combiner.snapshot()
+    assert snap["instances"] == 3
+    assert snap["batches"] == 2  # one per signature
+    assert snap["pending"] == 0 and snap["errors"] == 0
+
+    # Post-stop submits deliver an error immediately instead of queueing.
+    late = {}
+    combiner.submit(
+        CombineEntry(_Ticket(insts[0]), lambda d, e: late.update(err=e))
+    )
+    assert isinstance(late.get("err"), RuntimeError)

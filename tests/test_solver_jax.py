@@ -368,13 +368,33 @@ def test_scenario_batched_solves_match_individual(profiles_dir):
         assert sum(res.w) * res.k == model.L
 
     # Drift outside the profile class: scale a device's CPU table (changes
-    # alpha -> the A matrix -> the static half).
+    # alpha -> the A matrix -> the static half). The shared-static fast
+    # path can't take it, but the multi-instance batch layout fallback
+    # packs each scenario's own static half and still serves the batch in
+    # one dispatch — each lane matching its individual solve.
     bad = [d.model_copy(deep=True) for d in scenarios[0]]
     for q in bad[0].scpu:
         bad[0].scpu[q] = {col: v * 2.0 for col, v in bad[0].scpu[q].items()}
-    with pytest.raises(ValueError, match="static half"):
+    tm2 = {}
+    hetero = halda_solve_scenarios(
+        [scenarios[0], bad], model, kv_bits="4bit", mip_gap=gap, timings=tm2
+    )
+    assert tm2.get("scenario_fallback") == 1.0
+    assert len(hetero) == 2
+    for devs, res in zip([scenarios[0], bad], hetero):
+        assert res.certified
+        solo = halda_solve(
+            devs, model, kv_bits="4bit", mip_gap=gap, backend="jax"
+        )
+        tol = 2 * gap * abs(solo.obj_value) + 1e-9
+        assert abs(res.obj_value - solo.obj_value) <= tol
+
+    # Scenarios that don't even share a shape family (different fleet
+    # SIZE) stay rejected: no batch layout can carry them in one dispatch.
+    with pytest.raises(ValueError, match="shape family"):
         halda_solve_scenarios(
-            [scenarios[0], bad], model, kv_bits="4bit", mip_gap=gap
+            [scenarios[0], make_synthetic_fleet(6, seed=31)],
+            model, kv_bits="4bit", mip_gap=gap,
         )
 
 

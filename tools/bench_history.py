@@ -47,6 +47,12 @@ HISTORY_KEYS = (
     "scheduler_p99_ms",
     "gateway_events_per_sec_100f_4w",
     "gateway_scaling_100f_4w",
+    "combine_events_per_sec_100f",
+    "combine_vs_per_shard_100f",
+    "combine_p99_ms_100f",
+    "combine_warm_phase_compiles",
+    "combine_bucket_occupancy",
+    "combine_padding_waste",
     "overload_max_sustainable_eps",
     "overload_plateau_ratio",
     "spec_hit_rate",

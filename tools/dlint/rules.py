@@ -52,6 +52,10 @@ LAZY_JAX_PREFIXES = (
     # top-level jax import here would drag backend init into every
     # process that merely parses a snapshot or a multi-fleet trace.
     "distilp_tpu/gateway/",
+    # The combiner's policy/bucket plumbing is pure stdlib; the flush
+    # thread lazy-imports the batch layout at dispatch time, so building
+    # (or unit-testing) a BucketPolicy never pays backend init.
+    "distilp_tpu/combine/",
     # The observability layer is pure plumbing (spans, exporters, flight
     # rings): `solver spans` must convert a JSONL on a box with no
     # backend at all, and a top-level jax import here would leak into the
@@ -813,6 +817,9 @@ class SilentExceptInScheduler(Rule):
         # swallowed exception there hides exactly the contract breaks it
         # exists to surface.
         "distilp_tpu/traffic/",
+        # The combiner serves many shards from one dispatch: a swallowed
+        # flush/delivery failure would strand every lane in the batch.
+        "distilp_tpu/combine/",
     )
     # Attribute calls that count as recording through the metrics sink.
     # `_quarantine`/`_quarantine_note` are the scheduler's fault recorders
@@ -997,6 +1004,7 @@ class UnregisteredJitEntryPoint(Rule):
         "distilp_tpu/solver/",
         "distilp_tpu/ops/",
         "distilp_tpu/twin/",
+        "distilp_tpu/combine/",
     )
 
     @staticmethod
@@ -1140,6 +1148,7 @@ class UnregisteredMetricName(Rule):
         "distilp_tpu/gateway/",
         "distilp_tpu/obs/",
         "distilp_tpu/traffic/",
+        "distilp_tpu/combine/",
     )
 
     _registry_cache: Optional[Dict[str, str]] = None
