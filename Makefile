@@ -13,13 +13,46 @@ lint-strict:
 	python -m tools.dlint --strict
 	@if command -v ruff >/dev/null 2>&1; then ruff check .; fi
 
+# The whole-program concurrency pass alone (DLP030-034): guarded-by
+# discipline, blocking-under-lock, lock-order cycles, asyncio hazards and
+# thread-escapes, over the static lock/call model. Subset of lint-strict;
+# exists as the fast dev loop while editing locking code.
+.PHONY: lint-concurrency
+lint-concurrency:
+	python -m tools.dlint --strict --select DLP030,DLP031,DLP032,DLP033,DLP034
+
 .PHONY: format
 format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory smoke-combine
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory smoke-combine smoke-lockwatch
 	python -m pytest tests/ -q
+
+# Lock-sanitizer smoke: the runtime half of DLP032's deadlock claim. The
+# overload COALESCE arm (saturating flood folded into batches) replays
+# with DLP_LOCKWATCH=1, so every make_lock() primitive records per-thread
+# acquisition ORDER; batch admission is the serving loop's one guaranteed
+# nesting (worker.submit's bound check runs inside the admission lock so
+# depth accounting and batch state move atomically), so the observed
+# graph is non-empty by construction. Then `dlint --check-lockwatch`
+# cross-validates: observed edges must be a subset of the static
+# acquisition graph (the model missed nothing that actually happens),
+# and zero cycle witnesses may have fired. This is what keeps the static
+# DLP032 graph honest — a refactor that nests locks in an order the
+# analyzer cannot see fails HERE, not in prod.
+.PHONY: smoke-lockwatch
+smoke-lockwatch: lint-strict
+	@D=$$(mktemp -d) && \
+	JAX_PLATFORMS=cpu DLP_LOCKWATCH=1 DLP_LOCKWATCH_OUT=$$D/lockwatch.json \
+	python -m distilp_tpu.cli.solver_cli overload \
+		--trace tests/traces/openloop_diurnal_burst.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 2 --k-candidates 8,10 --time-scale 0.001 \
+		--max-queue-depth 64 --coalesce --check --expect-coalesced \
+		--expect-no-sheds --quiet && \
+	python -m tools.dlint --check-lockwatch $$D/lockwatch.json; \
+	rc=$$?; rm -rf $$D; exit $$rc
 
 # `make bench` also appends the run's headline keys as one line of
 # BENCH_HISTORY.jsonl (committed format, see tools/bench_history.py) so

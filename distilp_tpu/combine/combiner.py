@@ -21,6 +21,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from .policy import BucketPolicy
+from ..utils.lockwatch import make_lock
 
 
 class CombineEntry:
@@ -43,13 +44,13 @@ class SolveCombiner:
     def __init__(self, policy: Optional[BucketPolicy] = None, metrics=None):
         self.policy = policy if policy is not None else BucketPolicy()
         self.metrics = metrics
-        self._cv = threading.Condition()
+        self._cv = make_lock("combiner.buckets", kind="condition")
         # signature -> [(entry, enqueue_monotonic), ...] in arrival order.
-        self._buckets: Dict[tuple, List[tuple]] = {}
-        self._stopping = False
-        self._stopped = False
-        # Lifetime stats for /signals (guarded by the same condition lock).
-        self._stats = {
+        self._buckets: Dict[tuple, List[tuple]] = {}  # guarded-by: self._cv
+        self._stopping = False  # guarded-by: self._cv
+        self._stopped = False  # guarded-by: self._cv
+        # Lifetime stats for /signals, guarded by the same condition lock.
+        self._stats = {  # guarded-by: self._cv
             "batches": 0,
             "instances": 0,
             "flush_full": 0,

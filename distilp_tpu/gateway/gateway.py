@@ -26,7 +26,10 @@ from __future__ import annotations
 import asyncio
 import threading
 import time
-from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+if TYPE_CHECKING:
+    from ..obs.flight import FlightRecorder
 
 from ..common import DeviceProfile, ModelProfile
 from ..obs.trace import NOOP_TRACER
@@ -38,6 +41,7 @@ from ..sched.metrics import (
     SchedulerMetrics,
 )
 from ..sched.scheduler import PlacementView, Scheduler
+from ..utils.lockwatch import make_lock
 from .router import ConsistentHashRouter, shard_key
 from .snapshot import GatewaySnapshot, ShardSnapshot
 from .worker import ShardWorker, WorkerQueueFull
@@ -143,7 +147,7 @@ class Gateway:
         scheduler_factory: Optional[Callable] = None,
         metrics: Optional[SchedulerMetrics] = None,
         tracer=None,
-        flight=None,
+        flight: Optional["FlightRecorder"] = None,
         max_queue_depth: Optional[int] = None,
         coalesce: bool = False,
         degrade_depth: Optional[int] = None,
@@ -223,8 +227,8 @@ class Gateway:
         # Shard keys with a combine ticket in flight: a shard's next
         # coalesce batch PARKS (queues no closure) until its lane is
         # adopted, so the worker never interleaves a newer solve between
-        # prepare and adopt. Guarded by _admission_lock.
-        self._combine_inflight: Dict[str, bool] = {}
+        # prepare and adopt.
+        self._combine_inflight: Dict[str, bool] = {}  # guarded-by: self._admission_lock
         if combine:
             from ..combine import BucketPolicy, SolveCombiner
 
@@ -243,15 +247,15 @@ class Gateway:
         # Pending coalesce batches: shard key -> the batch dict its queued
         # drain closure will consume. Guarded by one lock (ingest may come
         # from the asyncio loop AND sync callers on other threads).
-        self._admission_lock = threading.Lock()
-        self._pending: Dict[str, dict] = {}
+        self._admission_lock = make_lock("gateway.admission")
+        self._pending: Dict[str, dict] = {}  # guarded-by: self._admission_lock
         # Per-fleet shed counters + monotone per-fleet shed index: the
         # record-by-record reconciliation key (each shed flight record
         # carries its index, so counter vs records can be audited even
         # after the bounded ring overflowed). Own lock — _shed runs inside
         # _submit_coalesced's admission-lock hold, so it cannot share it.
-        self._shed_lock = threading.Lock()
-        self._shed_counts: Dict[str, int] = {}
+        self._shed_lock = make_lock("gateway.shed")
+        self._shed_counts: Dict[str, int] = {}  # guarded-by: self._shed_lock
         # EWMA of event->placement ms, the Retry-After estimate's input.
         self._serve_ewma_ms: Optional[float] = None
         # Attached background observers (timeline samplers, prom
@@ -589,7 +593,7 @@ class Gateway:
         return _do
 
     def _submit_tick(
-        self, fleet_id: str, key: str, worker, event, parent, t_enq,
+        self, fleet_id: str, key: str, worker: ShardWorker, event, parent, t_enq,
         on_done=None,
     ):
         """Route one event through the admission gate onto its worker.
@@ -679,7 +683,7 @@ class Gateway:
             raise self._shed(fleet_id, event, worker, e.depth) from None
 
     def _submit_coalesced(
-        self, fleet_id, key, worker, event, parent, t_enq,
+        self, fleet_id, key, worker: ShardWorker, event, parent, t_enq,
         pressure, depth, on_done,
     ):
         box: dict = {}
@@ -864,7 +868,7 @@ class Gateway:
                 except Exception:
                     self.metrics.inc("worker_callback_error")
 
-    def _combine_deliver(self, fleet_id, key, worker, ticket, waiters):
+    def _combine_deliver(self, fleet_id, key, worker: ShardWorker, ticket, waiters):
         """The combiner's per-lane delivery callback: queue the shard's
         ``adopt_combine`` back onto its OWN worker (scatter), resolve the
         batch's waiters with the adopted view, then un-park the batch
@@ -899,7 +903,7 @@ class Gateway:
 
         return deliver
 
-    def _release_combine(self, fleet_id, key, worker) -> None:
+    def _release_combine(self, fleet_id, key, worker: ShardWorker) -> None:
         """Clear a shard's in-flight combine marker and submit the drain
         of any batch that parked behind it (runs on the worker thread at
         the end of the adopt closure)."""
@@ -928,7 +932,7 @@ class Gateway:
         if parked_waiters is not None:
             self._resolve_waiters(parked_waiters, shed_shared)
 
-    def _shed(self, fleet_id: str, event, worker, depth: int) -> QueueFull:
+    def _shed(self, fleet_id: str, event, worker: ShardWorker, depth: int) -> QueueFull:
         """Account one shed, then hand back the exception to raise.
 
         Every shed is (1) counted — ``events_shed`` plus the per-fleet

@@ -24,6 +24,7 @@ from typing import Callable, Dict, Optional
 
 from ..sched.metrics import SchedulerMetrics
 from ..sched.scheduler import Scheduler
+from ..utils.lockwatch import make_lock
 
 
 class WorkerQueueFull(Exception):
@@ -55,12 +56,12 @@ class ShardWorker:
         # worker is quiescent (e.g. the serve CLI's sequential replay).
         self.shards: Dict[str, Scheduler] = {}
         self._q: "queue.Queue" = queue.Queue()
-        self._stopped = False
+        self._stopped = False  # guarded-by: self._submit_lock
         # Serializes submit()'s stopped-check-then-put against stop()'s
         # sentinel put: without it a submitter that passed the check could
         # enqueue AFTER the stop sentinel — the item would never run and
         # its waiter would hang forever instead of getting the RuntimeError.
-        self._submit_lock = threading.Lock()
+        self._submit_lock = make_lock("worker.submit")
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"gw-worker-{worker_id}"
         )

@@ -59,6 +59,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..utils.lockwatch import make_lock
+
 __all__ = [
     "CompileLedger",
     "InstrumentedJit",
@@ -103,7 +105,7 @@ _REGISTRY: Dict[str, dict] = {}
 
 _tls = threading.local()
 _LEDGER: Optional["CompileLedger"] = None
-_LEDGER_LOCK = threading.Lock()
+_LEDGER_LOCK = make_lock("compile_ledger.global")
 # None = not probed yet; True/False = jax.monitoring listeners installed.
 _MONITORING_OK: Optional[bool] = None
 # Registry ride-along (obs.memory): one callable invoked per dispatch of
@@ -225,7 +227,7 @@ class CompileLedger:
         # True = no jax.monitoring; the wrappers synthesize compile events
         # from first-seen signatures (set by enable(), or by tests).
         self.fallback = False
-        self._lock = threading.RLock()
+        self._lock = make_lock("compile_ledger.entries", kind="rlock")
         self._t0 = time.monotonic()
         self.events: "deque[dict]" = deque(maxlen=capacity)
         self._seq = 0  # total compile events ever (ring may have evicted)

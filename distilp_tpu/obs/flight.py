@@ -28,11 +28,12 @@ from __future__ import annotations
 
 import json
 import re
-import threading
 import time
 from collections import deque
 from pathlib import Path
 from typing import Dict, List, Optional
+
+from ..utils.lockwatch import make_lock
 
 __all__ = ["FlightRecorder"]
 
@@ -47,10 +48,11 @@ class FlightRecorder:
             raise ValueError("flight recorder capacity must be >= 1")
         self.capacity = capacity
         self.dump_dir = Path(dump_dir) if dump_dir is not None else None
-        self._rings: Dict[str, deque] = {}
-        self._lock = threading.Lock()
-        self._dump_seq = 0
-        self.dumps: List[Path] = []  # post-mortems written, oldest first
+        self._rings: Dict[str, deque] = {}  # guarded-by: self._lock
+        self._lock = make_lock("flight.ring")
+        self._dump_seq = 0  # guarded-by: self._lock
+        # Post-mortems written, oldest first.
+        self.dumps: List[Path] = []  # guarded-by: self._lock
 
     def record(self, key: str, rec: dict) -> None:
         """Append one tick record to ``key``'s ring (oldest falls off)."""

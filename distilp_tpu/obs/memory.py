@@ -56,6 +56,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from . import compile_ledger as _cl
+from ..utils.lockwatch import make_lock
 
 __all__ = [
     "MemoryLedger",
@@ -84,7 +85,7 @@ MEM_ANALYSIS_FIELDS = (
 
 _tls = threading.local()
 _LEDGER: Optional["MemoryLedger"] = None
-_LEDGER_LOCK = threading.Lock()
+_LEDGER_LOCK = make_lock("memory_ledger.global")
 # Cached jax.core.trace_state_clean (probed once): an inner-trace
 # dispatch sees tracer arguments, which cannot be AOT-lowered.
 _TRACE_STATE = None
@@ -216,7 +217,7 @@ class MemoryLedger:
         # an unthrottled per-tick walk would blow the <=5% overhead gate,
         # so sample() returns the cached record inside this window.
         self.sample_min_interval_s = sample_min_interval_s
-        self._lock = threading.RLock()
+        self._lock = make_lock("memory_ledger.entries", kind="rlock")
         self._t0 = time.monotonic()
         # entry -> analysis record (claimed at first Python-side
         # dispatch): {"memory": {...}|None, "flops": float|None,
@@ -554,10 +555,12 @@ def enable(ledger: Optional[MemoryLedger] = None, **kwargs) -> MemoryLedger:
     disable/enable cycles and is dormant (one module-global read) while
     no ledger is current. A budget left None resolves to MemTotal."""
     global _LEDGER
+    led = ledger if ledger is not None else MemoryLedger(**kwargs)
+    if led.budget_bytes is None:
+        # /proc read stays OUTSIDE the lock: enable() is rare, but the
+        # ledger lock is on the dispatch path and must never wait on I/O.
+        led.budget_bytes = read_meminfo_total()
     with _LEDGER_LOCK:
-        led = ledger if ledger is not None else MemoryLedger(**kwargs)
-        if led.budget_bytes is None:
-            led.budget_bytes = read_meminfo_total()
         _cl.set_dispatch_hook(_on_dispatch)
         _LEDGER = led
         return led

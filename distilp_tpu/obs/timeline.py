@@ -40,6 +40,8 @@ from collections import deque
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils.lockwatch import make_lock
+
 __all__ = [
     "Timeline",
     "TimelineSampler",
@@ -64,8 +66,8 @@ class Timeline:
             # answer rate() is a misconfiguration, not a small buffer.
             raise ValueError("timeline capacity must be >= 2")
         self.capacity = capacity
-        self._series: Dict[str, deque] = {}
-        self._lock = threading.Lock()
+        self._series: Dict[str, deque] = {}  # guarded-by: self._lock
+        self._lock = make_lock("timeline.series")
 
     # -- the write side ----------------------------------------------------
 

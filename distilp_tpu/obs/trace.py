@@ -40,6 +40,8 @@ from collections import deque
 from pathlib import Path
 from typing import Any, Dict, List, NamedTuple, Optional
 
+from ..utils.lockwatch import make_lock
+
 __all__ = [
     "Span",
     "SpanContext",
@@ -210,8 +212,8 @@ class Tracer:
     def __init__(self, capacity: int = 8192, writer=None):
         if capacity < 1:
             raise ValueError("tracer ring capacity must be >= 1")
-        self._ring: "deque[dict]" = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._ring: "deque[dict]" = deque(maxlen=capacity)  # guarded-by: self._lock
+        self._lock = make_lock("trace.ring")
         self._writer = writer
         self._local = threading.local()
         self.dropped = 0  # writer failures (serving outranks span loss)
@@ -297,7 +299,7 @@ class Tracer:
             self._ring.append(rec)
             if self._writer is not None:
                 try:
-                    self._writer.write(rec)
+                    self._writer.write(rec)  # dlint: disable=DLP031 file order must match ring order; the writer is line-buffered JSONL and a span record is tiny
                 except OSError:  # dlint: disable=DLP017 accounted in self.dropped; the tracer has no metrics sink and span loss must never fail a tick
                     self.dropped += 1
 

@@ -22,9 +22,10 @@ yet appended).
 
 from __future__ import annotations
 
-import threading
 from collections import defaultdict, deque
 from typing import Dict, List
+
+from ..utils.lockwatch import make_lock
 
 TICK_MODES = ("cold", "warm", "margin")
 
@@ -269,13 +270,13 @@ class LatencyHist:
     def __init__(self, cap: int = 100_000):
         # deque(maxlen=...) keeps the recent-window trim O(1) per record;
         # the snapshot (rare) pays the sort.
-        self._vals: "deque[float]" = deque(maxlen=cap)
-        self.count = 0
-        self.total = 0.0
+        self._vals: "deque[float]" = deque(maxlen=cap)  # guarded-by: self._lock
+        self.count = 0  # guarded-by: self._lock
+        self.total = 0.0  # guarded-by: self._lock
         # record() is a three-field update; a snapshot between the count
         # bump and the append would see count != len(values) and report a
         # torn (count, mean, quantile) triple. One lock covers both.
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.hist")
 
     def record(self, ms: float) -> None:
         with self._lock:
@@ -310,12 +311,12 @@ class SchedulerMetrics:
     """Counters + histograms for one scheduler (or one replanner)."""
 
     def __init__(self):
-        self.counters: Dict[str, int] = defaultdict(int)
-        self.hists: Dict[str, LatencyHist] = {}
+        self.counters: Dict[str, int] = defaultdict(int)  # guarded-by: self._lock
+        self.hists: Dict[str, LatencyHist] = {}  # guarded-by: self._lock
         # Guards the counter dict and hist-map mutation; each hist guards
         # its own buffer (record/snapshot above), so observe() holds this
         # lock only for the get-or-create, never across the record.
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.counters")
 
     # -- generic sinks ----------------------------------------------------
 

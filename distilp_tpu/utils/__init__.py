@@ -1,5 +1,18 @@
-"""Utilities: synthetic fleets, logging/timing helpers."""
+"""Utilities: synthetic fleets, lock instrumentation, logging/timing helpers."""
 
-from .synthetic import make_synthetic_fleet, stretch_model_for_fleet
+__all__ = ["make_synthetic_fleet", "stretch_model_for_fleet", "make_lock"]
 
-__all__ = ["make_synthetic_fleet", "stretch_model_for_fleet"]
+
+def __getattr__(name):
+    # PEP 562 lazy exports: synthetic pulls in numpy, and the gateway's
+    # `from ..utils.lockwatch import make_lock` must not pay for it (the
+    # serving path imports this package long before any fleet synthesis).
+    if name in ("make_synthetic_fleet", "stretch_model_for_fleet"):
+        from . import synthetic
+
+        return getattr(synthetic, name)
+    if name == "make_lock":
+        from .lockwatch import make_lock
+
+        return make_lock
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

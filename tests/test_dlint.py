@@ -42,6 +42,19 @@ def test_registry_has_all_rule_codes():
     for code, rule in RULES.items():
         assert rule.code == code
         assert rule.name and rule.rationale
+    # The whole-program concurrency family lives in its own registry
+    # (rules take a ProjectContext, not a FileContext) but shares the
+    # code namespace: no overlap, and importing the project module is
+    # enough to populate it (the CLI validates --select against both).
+    from tools.dlint.project import PROJECT_RULES
+
+    assert {"DLP030", "DLP031", "DLP032", "DLP033", "DLP034"} <= set(
+        PROJECT_RULES
+    )
+    assert not set(RULES) & set(PROJECT_RULES)
+    for code, rule in PROJECT_RULES.items():
+        assert rule.code == code
+        assert rule.name and rule.rationale
 
 
 def test_syntax_error_reported_as_dlp000():
@@ -1773,3 +1786,646 @@ def test_combine_real_modules_are_currently_clean():
             assert findings_for(code, mod, src) == [], (mod, code)
     src = Path("distilp_tpu/solver/backend_jax.py").read_text()
     assert 'instrument(\n    "solver._solve_batched"' in src
+
+
+# --------------------------------------------------------------------------
+# finding columns (PR 17 satellite: path:line:col rendering)
+
+
+def test_finding_renders_with_and_without_column():
+    from tools.dlint import Finding
+
+    with_col = Finding("a.py", 3, "DLP012", "msg", col=5, end_col=9)
+    assert with_col.render() == "a.py:3:5: DLP012 msg"
+    without = Finding("a.py", 3, "DLP012", "msg")
+    assert without.render() == "a.py:3: DLP012 msg"
+
+
+def test_finding_at_converts_ast_offsets_to_one_based():
+    import ast
+
+    from tools.dlint.core import finding_at
+
+    node = ast.parse("if a:\n    x = 1").body[0].body[0]  # col_offset 4
+    f = finding_at("a.py", node, "DLP012", "msg")
+    assert (f.line, f.col) == (2, 5)
+    assert f.end_col is not None and f.end_col > f.col
+
+
+def test_unused_import_points_at_the_exact_alias():
+    # Multi-name imports: each finding's column lands on ITS name, not
+    # column 1 of the statement.
+    out = lint_source(
+        "distilp_tpu/x.py", "import os, sys\n", select=["DLP001"]
+    )
+    assert [(f.line, f.col) for f in out] == [(1, 8), (1, 12)]
+    assert out[0].render() == (
+        "distilp_tpu/x.py:1:8: DLP001 `os` imported but unused (F401)"
+    )
+
+
+def test_columns_do_not_affect_baseline_matching():
+    # Baseline entries key on (path, code) only: adding or refining
+    # column info must never invalidate a committed baseline.
+    from tools.dlint import Finding
+
+    bl = Baseline(entries=[BaselineEntry("a.py", "DLP012", 1, "ok")])
+    new, old, stale = bl.partition(
+        [Finding("a.py", 3, "DLP012", "msg", col=7, end_col=12)]
+    )
+    assert new == [] and len(old) == 1 and stale == []
+
+
+# --------------------------------------------------------------------------
+# suppression edge cases (PR 17 satellite)
+
+
+def test_disable_all_with_unrelated_disable_file_interplay():
+    # `disable=all` silences every code on its line; a `disable-file` of a
+    # DIFFERENT code elsewhere must not widen or narrow that: other lines
+    # keep their findings.
+    out = findings_for("DLP012", "distilp_tpu/x.py", """\
+        # dlint: disable-file=DLP014
+
+        def f(x):
+            assert x  # dlint: disable=all
+
+        def g(x):
+            assert x
+        """)
+    assert len(out) == 1
+    assert out[0].line == 7
+
+
+def test_disable_file_with_trailing_prose_still_suppresses():
+    out = findings_for("DLP012", "distilp_tpu/x.py", """\
+        # dlint: disable-file=DLP012 invariant layout, see module docstring
+
+        def f(x):
+            assert x
+        """)
+    assert out == []
+
+
+def test_disable_list_with_prose_suppresses_exactly_the_listed_codes():
+    # The code list must stop at the first non-identifier: prose after the
+    # list is a justification, not more codes.
+    src = """\
+        def f(x):
+            assert x  # dlint: disable=DLP012,DLP014 checked by caller
+        """
+    assert findings_for("DLP012", "distilp_tpu/x.py", src) == []
+    src_other = """\
+        def f(x):
+            assert x  # dlint: disable=DLP014 checked by caller
+        """
+    assert len(findings_for("DLP012", "distilp_tpu/x.py", src_other)) == 1
+
+
+def test_all_stale_baseline_fails_strict_and_reports_every_entry():
+    # A baseline whose every entry went stale (the findings were fixed)
+    # passes a lax run but fails --strict, reporting ALL entries, not
+    # just the first.
+    from tools.dlint.core import RunResult
+
+    bl = Baseline(
+        entries=[
+            BaselineEntry("distilp_tpu/a.py", "DLP012", 2, "old"),
+            BaselineEntry("distilp_tpu/b.py", "DLP014", 1, "older"),
+        ]
+    )
+    new, old, stale = bl.partition([])
+    assert new == [] and old == [] and len(stale) == 2
+    result = RunResult(
+        findings_new=new,
+        findings_baselined=old,
+        stale_entries=stale,
+        unjustified_entries=bl.unjustified(),
+        n_files=1,
+    )
+    assert not result.failed(strict=False)
+    assert result.failed(strict=True)
+
+
+# --------------------------------------------------------------------------
+# the whole-program concurrency family (DLP030-034)
+
+
+def proj_findings(code, sources):
+    """Run one project rule over in-memory modules keyed by relpath."""
+    from tools.dlint.project import project_lint_sources
+
+    return project_lint_sources(
+        {rel: textwrap.dedent(src) for rel, src in sources.items()},
+        select=[code],
+    )
+
+
+def test_dlp030_guarded_attr_access_without_lock_flagged():
+    out = proj_findings("DLP030", {
+        "distilp_tpu/gwx/box.py": """\
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}  # guarded-by: self._lock
+
+                def good(self):
+                    with self._lock:
+                        self._items["a"] = 1
+
+                def bad(self):
+                    return self._items.get("a")
+            """,
+    })
+    assert len(out) == 1
+    assert "Box.bad" in out[0].message and "_items" in out[0].message
+
+
+def test_dlp030_module_global_guard_and_init_exemption():
+    out = proj_findings("DLP030", {
+        "distilp_tpu/gwx/glob.py": """\
+            import threading
+
+            _LOCK = threading.Lock()
+            _CACHE = {}  # guarded-by: _LOCK
+
+
+            def good():
+                with _LOCK:
+                    _CACHE["k"] = 1
+
+
+            def bad():
+                _CACHE["k"] = 1
+            """,
+        "distilp_tpu/gwx/init_ok.py": """\
+            import threading
+
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = {}  # guarded-by: self._lock
+                    self._state["seed"] = 1
+            """,
+    })
+    assert len(out) == 1
+    assert "`_CACHE`" in out[0].message and "bad" in out[0].message
+
+
+def test_dlp030_infers_missing_annotation_from_locked_writes():
+    # No annotation anywhere: written under the lock in one method, bare
+    # in another -> the bare write is flagged as a seed for the contract.
+    out = proj_findings("DLP030", {
+        "distilp_tpu/gwx/seed.py": """\
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def locked_write(self):
+                    with self._lock:
+                        self._items["a"] = 1
+
+                def bare_write(self):
+                    self._items["b"] = 2
+            """,
+    })
+    assert len(out) == 1
+    assert "guarded-by" in out[0].message
+
+
+def test_dlp030_helper_called_only_under_lock_is_clean():
+    # The combiner idiom: a private helper that mutates guarded state is
+    # legal when EVERY resolved call site already holds the lock — the
+    # entry-held pass propagates the held set into the helper.
+    out = proj_findings("DLP030", {
+        "distilp_tpu/gwx/helper.py": """\
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}  # guarded-by: self._lock
+
+                def flush(self):
+                    with self._lock:
+                        self._drain()
+
+                def also_flush(self):
+                    with self._lock:
+                        self._drain()
+
+                def _drain(self):
+                    self._items.clear()
+            """,
+    })
+    assert out == []
+
+
+def test_dlp031_blocking_under_lock_direct_and_interprocedural():
+    out = proj_findings("DLP031", {
+        "distilp_tpu/gwx/blk.py": """\
+            import threading
+            import time
+
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad_direct(self):
+                    with self._lock:
+                        time.sleep(0.1)
+
+                def _helper(self):
+                    time.sleep(0.1)
+
+                def bad_via_call(self):
+                    with self._lock:
+                        self._helper()
+
+                def ok(self):
+                    time.sleep(0.1)
+                    with self._lock:
+                        pass
+            """,
+    })
+    assert len(out) == 2
+    assert all("while holding" in f.message for f in out)
+    assert any("_helper" in f.message for f in out)
+
+
+def test_dlp031_condition_wait_on_innermost_lock_exempt():
+    # Condition.wait RELEASES the lock it waits on; only waiting on a
+    # condition while holding a DIFFERENT lock convoys that outer lock.
+    out = proj_findings("DLP031", {
+        "distilp_tpu/gwx/cv.py": """\
+            import threading
+
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._outer = threading.Lock()
+
+                def ok_wait(self):
+                    with self._cv:
+                        self._cv.wait()
+
+                def bad_wait(self):
+                    with self._outer:
+                        with self._cv:
+                            self._cv.wait()
+            """,
+    })
+    assert len(out) == 1
+    assert "releases" in out[0].message
+    assert "_outer" in out[0].message  # the convoyed lock, not the cv
+    assert out[0].line > 11  # the bad_wait site, not ok_wait
+
+
+def test_dlp032_opposite_order_cycle_reported_with_witness_sites():
+    out = proj_findings("DLP032", {
+        "distilp_tpu/gwx/order.py": """\
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+
+            def one():
+                with A:
+                    with B:
+                        pass
+
+
+            def two():
+                with B:
+                    with A:
+                        pass
+            """,
+    })
+    assert len(out) == 1
+    assert "lock-order cycle" in out[0].message
+    # Both directions of the cycle are named, with file:line witnesses.
+    assert "gwx.order.A" in out[0].message
+    assert "gwx.order.B" in out[0].message
+    assert "distilp_tpu/gwx/order.py:" in out[0].message
+
+
+def test_dlp032_consistent_order_is_clean():
+    out = proj_findings("DLP032", {
+        "distilp_tpu/gwx/order_ok.py": """\
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+
+            def one():
+                with A:
+                    with B:
+                        pass
+
+
+            def two():
+                with A:
+                    with B:
+                        pass
+            """,
+    })
+    assert out == []
+
+
+def test_dlp032_direct_reacquire_flagged_unless_rlock():
+    src = """\
+        import threading
+
+        %s
+
+
+        def f():
+            with L:
+                with L:
+                    pass
+        """
+    bad = proj_findings(
+        "DLP032",
+        {"distilp_tpu/gwx/re.py": src % "L = threading.Lock()"},
+    )
+    assert len(bad) == 1 and "already held" in bad[0].message
+    ok = proj_findings(
+        "DLP032",
+        {"distilp_tpu/gwx/re.py": src % "L = threading.RLock()"},
+    )
+    assert ok == []
+
+
+def test_dlp033_sync_lock_and_blocking_in_async_def():
+    out = proj_findings("DLP033", {
+        "distilp_tpu/sched/aio.py": """\
+            import threading
+            import time
+
+            _LOCK = threading.Lock()
+
+
+            async def bad_lock():
+                with _LOCK:
+                    return 1
+
+
+            async def bad_block():
+                time.sleep(0.1)
+
+
+            def sync_ok():
+                with _LOCK:
+                    time.sleep(0.1)  # dlint: disable=DLP031 fixture
+            """,
+    })
+    assert len(out) == 2
+    assert any("blocks the event loop" in f.message for f in out)
+    assert any("stalls" in f.message for f in out)
+
+
+def test_dlp033_thread_local_read_after_await():
+    out = proj_findings("DLP033", {
+        "distilp_tpu/sched/tls.py": """\
+            import threading
+
+            _TLS = threading.local()
+
+
+            async def bad(other):
+                await other()
+                return _TLS.value
+
+
+            async def ok(other):
+                v = _TLS.value
+                await other()
+                return v
+            """,
+    })
+    assert len(out) == 1
+    assert "contextvars" in out[0].message
+    assert "bad" in out[0].message
+
+
+def test_dlp034_mutable_local_shared_with_thread_flagged():
+    out = proj_findings("DLP034", {
+        "distilp_tpu/gwx/esc.py": """\
+            import threading
+
+
+            def work(d):
+                d["w"] = 1
+
+
+            def bad_passed():
+                shared = {}
+                t = threading.Thread(target=work, args=(shared,))
+                t.start()
+                shared["k"] = 1
+                return t
+
+
+            def bad_captured():
+                shared = {}
+
+                def task():
+                    shared["w"] = 1
+
+                threading.Thread(target=task).start()
+                return shared["k"]
+            """,
+    })
+    assert len(out) == 2
+    assert any("passed to" in f.message for f in out)
+    assert any("captured by" in f.message for f in out)
+
+
+def test_dlp034_ownership_transfer_and_locked_rendezvous_are_clean():
+    out = proj_findings("DLP034", {
+        "distilp_tpu/gwx/esc_ok.py": """\
+            import threading
+
+            _LOCK = threading.Lock()
+
+
+            def work(d):
+                d["w"] = 1
+
+
+            def ok_handoff():
+                payload = {}
+                payload["k"] = 1
+                threading.Thread(target=work, args=(payload,)).start()
+
+
+            def ok_rendezvous():
+                shared = {}
+                threading.Thread(target=work, args=(shared,)).start()
+                with _LOCK:
+                    shared["k"] = 1
+            """,
+    })
+    assert out == []
+
+
+def test_dlp034_asyncio_task_sharing_is_not_an_escape():
+    # create_task runs the coroutine on the SPAWNER's thread; container
+    # sharing with it interleaves only at awaits (DLP033's territory).
+    out = proj_findings("DLP034", {
+        "distilp_tpu/gwx/aio_ok.py": """\
+            import asyncio
+
+
+            async def consume(d):
+                d["c"] = 1
+
+
+            async def ok():
+                shared = {}
+                asyncio.create_task(consume(shared))
+                shared["k"] = 1
+            """,
+    })
+    assert out == []
+
+
+def test_dlp034_unguarded_mutable_global_passed_to_thread():
+    src = """\
+        import threading
+
+        %s
+        _BUF = []%s
+
+
+        def work(b):
+            b.append(1)
+
+
+        def spawn():
+            threading.Thread(target=work, args=(_BUF,)).start()
+        """
+    bad = proj_findings(
+        "DLP034",
+        {"distilp_tpu/gwx/gesc.py": src % ("", "")},
+    )
+    assert len(bad) == 1 and "mutable module global" in bad[0].message
+    ok = proj_findings(
+        "DLP034",
+        {
+            "distilp_tpu/gwx/gesc.py": src
+            % ("_BUF_LOCK = threading.Lock()", "  # guarded-by: _BUF_LOCK")
+        },
+    )
+    assert ok == []
+
+
+def test_project_rule_findings_honor_suppression_comments():
+    out = proj_findings("DLP030", {
+        "distilp_tpu/gwx/supp.py": """\
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}  # guarded-by: self._lock
+
+                def bad(self):
+                    return self._items.get("a")  # dlint: disable=DLP030 snapshot read, staleness is fine here
+            """,
+    })
+    assert out == []
+
+
+# --------------------------------------------------------------------------
+# --changed plumbing and the static/runtime lock-graph contract
+
+
+def test_changed_files_returns_list_in_a_git_repo():
+    from tools.dlint.__main__ import changed_files
+
+    out = changed_files()
+    assert out is not None
+    assert all(str(p).endswith(".py") for p in out)
+
+
+def test_empty_path_subset_runs_project_pass_only():
+    # `--changed` with a clean tree: per-file rules see NO files (an
+    # explicit empty subset must not fall back to the full walk), but
+    # the whole-program pass still runs — cross-file findings caused by
+    # a committed edit still surface.
+    result = run(paths=[], baseline=Baseline(), with_project=True)
+    assert result.n_files == -1
+    for f in result.findings_new:
+        assert f.code.startswith("DLP03"), f.render()
+
+
+def test_static_lock_graph_covers_the_gateway_protocol():
+    # The ground truth the runtime sanitizer validates against: batch
+    # admission nests the worker submit lock (and the shed path nests
+    # the flight ring / shed window / counters) under the admission
+    # lock. If this shrinks, smoke-lockwatch's subset check goes blind.
+    from tools.dlint.__main__ import _static_graph
+
+    g = _static_graph()
+    edges = {(e["from"], e["to"]) for e in g["edges"]}
+    assert ("gateway.admission", "worker.submit") in edges
+    assert ("gateway.admission", "flight.ring") in edges
+    nodes = set(g["nodes"])
+    assert {"gateway.admission", "worker.submit", "combiner.buckets"} <= nodes
+
+
+def test_check_lockwatch_subset_empty_and_witness_verdicts(tmp_path, capsys):
+    import json as _json
+
+    from tools.dlint.__main__ import check_lockwatch
+
+    p = tmp_path / "lw.json"
+
+    def verdict(blob):
+        p.write_text(_json.dumps(blob))
+        rc = check_lockwatch(p)
+        return rc, capsys.readouterr().out
+
+    ok_rc, ok_out = verdict({
+        "edges": [
+            {"from": "gateway.admission", "to": "worker.submit", "count": 3}
+        ],
+        "witnesses": [],
+    })
+    assert ok_rc == 0 and "lockwatch ok" in ok_out
+
+    rev_rc, rev_out = verdict({
+        "edges": [
+            {"from": "worker.submit", "to": "gateway.admission", "count": 1}
+        ],
+        "witnesses": [],
+    })
+    assert rev_rc == 1 and "missing from the static graph" in rev_out
+
+    empty_rc, empty_out = verdict({"edges": [], "witnesses": []})
+    assert empty_rc == 1 and "EMPTY" in empty_out
+
+    wit_rc, wit_out = verdict({
+        "edges": [
+            {"from": "gateway.admission", "to": "worker.submit", "count": 1}
+        ],
+        "witnesses": [
+            {"cycle": ["a", "b", "a"], "thread": "T1", "edge": ["b", "a"]}
+        ],
+    })
+    assert wit_rc == 1 and "cycle witness" in wit_out

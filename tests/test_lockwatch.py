@@ -1,0 +1,177 @@
+"""Unit tests for the runtime lock sanitizer (distilp_tpu/utils/lockwatch).
+
+The sanitizer is the dynamic half of dlint's DLP032: `make_lock` hands out
+plain threading primitives in production and instrumented wrappers under
+DLP_LOCKWATCH=1, recording per-thread acquisition order into a process-wide
+observed graph that `python -m tools.dlint --check-lockwatch` validates
+against the static one. These tests pin the wrapper mechanics; the
+end-to-end static/observed comparison is `make smoke-lockwatch` and the
+check_lockwatch tests in test_dlint.py.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from distilp_tpu.utils import lockwatch
+
+
+@pytest.fixture()
+def watching(monkeypatch):
+    """Sanitizer on, graph clean before AND after (the observed graph is
+    process-global; leaking edges between tests would corrupt verdicts)."""
+    monkeypatch.setenv("DLP_LOCKWATCH", "1")
+    lockwatch.reset()
+    yield
+    lockwatch.reset()
+
+
+def test_disabled_factory_returns_plain_primitives(monkeypatch):
+    monkeypatch.delenv("DLP_LOCKWATCH", raising=False)
+    assert not lockwatch.enabled()
+    lock = lockwatch.make_lock("t.plain")
+    assert type(lock) is type(threading.Lock())
+    cv = lockwatch.make_lock("t.cv", kind="condition")
+    assert isinstance(cv, threading.Condition)
+    # RLock's concrete type varies by implementation; behaviorally it must
+    # be reentrant.
+    rl = lockwatch.make_lock("t.rl", kind="rlock")
+    with rl:
+        with rl:
+            pass
+
+
+def test_nesting_records_acquisition_order_edges(watching):
+    a = lockwatch.make_lock("t.a")
+    b = lockwatch.make_lock("t.b")
+    assert isinstance(a, lockwatch.WatchedLock)
+    with a:
+        with b:
+            pass
+    rep = lockwatch.report()
+    assert rep["enabled"]
+    assert {"t.a", "t.b"} <= set(rep["locks"])
+    assert [(e["from"], e["to"]) for e in rep["edges"]] == [("t.a", "t.b")]
+    assert rep["witnesses"] == []
+
+
+def test_opposite_order_produces_cycle_witness(watching, monkeypatch, tmp_path):
+    # Witness dumps go through the flight recorder; point them at a temp
+    # dir so the test leaves no droppings.
+    monkeypatch.setenv("DLP_LOCKWATCH_DIR", str(tmp_path))
+    a = lockwatch.make_lock("t.a")
+    b = lockwatch.make_lock("t.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # closes t.a -> t.b -> t.a
+            pass
+    rep = lockwatch.report()
+    assert len(rep["witnesses"]) == 1
+    w = rep["witnesses"][0]
+    assert w["kind"] == "lock-order-cycle"
+    assert w["edge"] == ["t.b", "t.a"]
+    assert w["cycle"] == ["t.b", "t.a", "t.b"]
+    assert w["held"] == ["t.b"]
+
+
+def test_same_name_reacquire_records_no_self_edge(watching):
+    # Names are type-granular: two instances sharing one name must not
+    # manufacture a name -> name self-edge (the static graph has none).
+    a1 = lockwatch.make_lock("t.same")
+    a2 = lockwatch.make_lock("t.same")
+    with a1:
+        with a2:
+            pass
+    assert lockwatch.report()["edges"] == []
+
+
+def test_condition_wait_releases_its_own_held_entry(watching):
+    # During cv.wait the lock is RELEASED: a nested acquisition by the
+    # wait's wakeup path must not look like cv -> other ordering. The
+    # held stack must also survive the pop/re-push (timeout path).
+    cv = lockwatch.make_lock("t.cv", kind="condition")
+    other = lockwatch.make_lock("t.other")
+    with cv:
+        cv.wait(timeout=0.01)
+        with other:
+            pass
+    rep = lockwatch.report()
+    assert ("t.cv", "t.other") in {
+        (e["from"], e["to"]) for e in rep["edges"]
+    }
+    assert rep["witnesses"] == []
+    # Stack is clean: a fresh acquisition records no residual edges.
+    lockwatch.reset()
+    with other:
+        pass
+    assert lockwatch.report()["edges"] == []
+
+
+def test_wait_for_predicate_round_trips_the_held_stack(watching):
+    cv = lockwatch.make_lock("t.cv", kind="condition")
+    hits = []
+    with cv:
+        cv.wait_for(lambda: hits.append(1) or True, timeout=0.01)
+    assert hits
+    lockwatch.reset()
+    a = lockwatch.make_lock("t.a")
+    with a:
+        pass
+    assert lockwatch.report()["edges"] == []
+
+
+def test_cross_thread_orders_share_one_observed_graph(watching):
+    a = lockwatch.make_lock("t.a")
+    b = lockwatch.make_lock("t.b")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=forward)
+    t.start()
+    t.join()
+    with b:
+        with a:  # the ABBA half, from the main thread
+            pass
+    rep = lockwatch.report()
+    edges = {(e["from"], e["to"]) for e in rep["edges"]}
+    assert edges == {("t.a", "t.b"), ("t.b", "t.a")}
+    assert len(rep["witnesses"]) == 1
+
+
+def test_reset_clears_graph_and_report_is_json_serializable(watching):
+    a = lockwatch.make_lock("t.a")
+    with a:
+        pass
+    assert lockwatch.report()["locks"]
+    json.dumps(lockwatch.report())  # must survive DLP_LOCKWATCH_OUT
+    lockwatch.reset()
+    rep = lockwatch.report()
+    assert rep["locks"] == [] and rep["edges"] == [] and rep["witnesses"] == []
+
+
+def test_exit_report_written_only_when_out_and_enabled(
+    watching, monkeypatch, tmp_path
+):
+    out = tmp_path / "lw.json"
+    monkeypatch.setenv("DLP_LOCKWATCH_OUT", str(out))
+    a = lockwatch.make_lock("t.a")
+    b = lockwatch.make_lock("t.b")
+    with a:
+        with b:
+            pass
+    lockwatch._write_report_at_exit()
+    rep = json.loads(out.read_text())
+    assert [(e["from"], e["to"]) for e in rep["edges"]] == [("t.a", "t.b")]
+    # Disabled (or OUT unset): never writes.
+    out.unlink()
+    monkeypatch.delenv("DLP_LOCKWATCH")
+    lockwatch._write_report_at_exit()
+    assert not out.exists()

@@ -2,22 +2,35 @@
 
     python -m tools.dlint [paths ...]        # baseline-aware gate
     python -m tools.dlint --strict           # + baseline hygiene (CI)
+    python -m tools.dlint --changed          # per-file rules on the git
+                                             # diff only (project pass
+                                             # still runs whole-program)
     python -m tools.dlint --list-rules       # rule codes + rationale
     python -m tools.dlint --select DLP012    # run a subset
     python -m tools.dlint --write-baseline   # grandfather current findings
+    python -m tools.dlint --lock-graph       # dump the static DLP032
+                                             # acquisition graph as JSON
+    python -m tools.dlint --check-lockwatch OUT.json
+                                             # validate a DLP_LOCKWATCH
+                                             # runtime report against it
 
 Exit status: 0 clean, 1 findings (or, under --strict, stale/unjustified
-baseline entries), 2 usage errors.
+baseline entries; or a failed lockwatch check), 2 usage errors.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
 import textwrap
 from pathlib import Path
+from typing import List, Optional
 
-from .core import DEFAULT_BASELINE, RULES, Baseline, BaselineEntry, run
+from .core import DEFAULT_BASELINE, REPO, RULES, Baseline, BaselineEntry, run
+
+LOCK_GRAPH_ALLOW = Path(__file__).resolve().parent / "lock_graph_allow.json"
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -32,6 +45,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="also fail on stale or unjustified baseline entries",
     )
     p.add_argument(
+        "--changed",
+        action="store_true",
+        help="lint only files touched per git (diff vs HEAD + untracked); "
+        "the whole-program pass still runs over the full library tree. "
+        "Falls back to a full scan outside a git repo.",
+    )
+    p.add_argument(
         "--baseline",
         default=str(DEFAULT_BASELINE),
         help="baseline JSON path (default: tools/dlint/baseline.json)",
@@ -44,10 +64,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--select",
         default=None,
-        help="comma-separated rule codes to run (default: all)",
+        help="comma-separated rule codes to run (default: all; DLP03x "
+        "codes select the whole-program pass)",
     )
     p.add_argument(
         "--list-rules", action="store_true", help="print rules and exit"
+    )
+    p.add_argument(
+        "--lock-graph",
+        action="store_true",
+        help="print the static lock-acquisition graph (DLP032's model) "
+        "as JSON and exit",
+    )
+    p.add_argument(
+        "--check-lockwatch",
+        metavar="REPORT",
+        default=None,
+        help="validate a DLP_LOCKWATCH_OUT runtime report: observed "
+        "acquisition edges must be non-empty and a subset of the static "
+        "graph (plus tools/dlint/lock_graph_allow.json), with zero "
+        "cycle witnesses",
     )
     p.add_argument(
         "--write-baseline",
@@ -60,31 +96,149 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def changed_files(root: Path = REPO) -> Optional[List[Path]]:
+    """Python files touched per git: diff vs HEAD plus untracked. None
+    when git is unavailable (caller falls back to the full scan)."""
+    out: List[Path] = []
+    try:
+        for args in (
+            ["git", "diff", "--name-only", "HEAD"],
+            ["git", "ls-files", "--others", "--exclude-standard"],
+        ):
+            res = subprocess.run(
+                args, cwd=root, capture_output=True, text=True, timeout=30
+            )
+            if res.returncode != 0:
+                return None
+            out.extend(
+                root / line
+                for line in res.stdout.splitlines()
+                if line.endswith(".py")
+            )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    # Deleted-but-not-committed files still appear in the diff.
+    return sorted({p for p in out if p.exists()})
+
+
+def _static_graph() -> dict:
+    from .core import build_contexts, iter_py_files
+    from .project import ProjectContext
+
+    files = [
+        p
+        for p in iter_py_files(REPO)
+        if p.resolve().relative_to(REPO).as_posix().startswith("distilp_tpu/")
+    ]
+    return ProjectContext.build(build_contexts(files)).lock_graph()
+
+
+def check_lockwatch(report_path: Path) -> int:
+    """The runtime half of DLP032's contract (see utils/lockwatch.py):
+    the observed graph must be non-empty (the smoke actually exercised
+    lock nesting), every observed edge must be one the static analyzer
+    predicted (or an allowlisted, justified exception), and no cycle
+    witness may have fired."""
+    try:
+        observed = json.loads(report_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read lockwatch report {report_path}: {e}",
+              file=sys.stderr)
+        return 2
+    static = _static_graph()
+    static_edges = {(e["from"], e["to"]) for e in static["edges"]}
+    allowed = set()
+    if LOCK_GRAPH_ALLOW.exists():
+        blob = json.loads(LOCK_GRAPH_ALLOW.read_text())
+        allowed = {(e["from"], e["to"]) for e in blob.get("edges", [])}
+
+    failures = []
+    obs_edges = [(e["from"], e["to"]) for e in observed.get("edges", [])]
+    if not obs_edges:
+        failures.append(
+            "observed acquisition graph is EMPTY — the run under "
+            "DLP_LOCKWATCH=1 never nested two locks, so it validated "
+            "nothing (wrong smoke arm?)"
+        )
+    unexplained = [
+        e for e in obs_edges if e not in static_edges and e not in allowed
+    ]
+    for a, b in unexplained:
+        failures.append(
+            f"observed edge {a} -> {b} is missing from the static graph "
+            "(dlint's call-graph model did not predict this nesting: fix "
+            "the model or allowlist it with a justification)"
+        )
+    witnesses = observed.get("witnesses", [])
+    for w in witnesses:
+        failures.append(
+            f"lock-order cycle witness: {' -> '.join(w.get('cycle', []))} "
+            f"on thread {w.get('thread')}"
+        )
+    for line in failures:
+        print(f"lockwatch: {line}")
+    if not failures:
+        print(
+            f"lockwatch ok: {len(obs_edges)} observed edge(s), all in the "
+            f"static graph ({len(static_edges)} edges), 0 witnesses"
+        )
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from .project import PROJECT_RULES  # registers DLP03x
 
     if args.list_rules:
         for code in sorted(RULES):
             rule = RULES[code]
             print(f"{code} {rule.name}")
             print(textwrap.indent(textwrap.fill(rule.rationale, 74), "    "))
+        for code in sorted(PROJECT_RULES):
+            rule = PROJECT_RULES[code]
+            print(f"{code} {rule.name} [whole-program]")
+            print(textwrap.indent(textwrap.fill(rule.rationale, 74), "    "))
         return 0
+
+    if args.lock_graph:
+        json.dump(_static_graph(), sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+
+    if args.check_lockwatch:
+        return check_lockwatch(Path(args.check_lockwatch))
 
     select = None
     if args.select:
         select = [c.strip().upper() for c in args.select.split(",") if c.strip()]
-        unknown = [c for c in select if c not in RULES]
+        unknown = [
+            c for c in select if c not in RULES and c not in PROJECT_RULES
+        ]
         if unknown:
             print(f"error: unknown rule code(s): {', '.join(unknown)}",
                   file=sys.stderr)
             return 2
 
-    paths = [Path(p) for p in args.paths] or None
+    paths: Optional[List[Path]] = [Path(p) for p in args.paths] or None
     if paths:
         for p in paths:
             if not p.exists():
                 print(f"error: no such path: {p}", file=sys.stderr)
                 return 2
+
+    with_project = None
+    if args.changed:
+        if paths:
+            print("error: --changed cannot be combined with explicit paths",
+                  file=sys.stderr)
+            return 2
+        changed = changed_files()
+        if changed is None:
+            # Not a git repo (or git broke): full scan is the safe answer.
+            paths = None
+        else:
+            paths = changed  # may be [] — then only the project pass runs
+            with_project = True
 
     baseline_path = Path(args.baseline)
     baseline = (
@@ -101,18 +255,21 @@ def main(argv=None) -> int:
         )
         return 2
 
-    if args.write_baseline and (paths or select):
+    if args.write_baseline and (paths is not None or select or args.changed):
         # A subset run sees only a subset of findings; rewriting the
         # baseline from it would silently drop every entry outside the
         # subset (and its human-written reason).
         print(
             "error: --write-baseline requires a whole-repo, all-rules run "
-            "(no paths, no --select)",
+            "(no paths, no --select, no --changed)",
             file=sys.stderr,
         )
         return 2
 
-    result = run(paths=paths, baseline=baseline, select=select)
+    result = run(
+        paths=paths, baseline=baseline, select=select,
+        with_project=with_project,
+    )
 
     if args.write_baseline:
         entries = {}
@@ -157,9 +314,12 @@ def main(argv=None) -> int:
     if not args.quiet:
         n_new = len(result.findings_new)
         n_old = len(result.findings_baselined)
+        n_rules = len(RULES) + len(PROJECT_RULES)
         scope = (
             f"{result.n_files} files" if result.n_files >= 0 else "given paths"
         )
+        if args.changed and paths is not None:
+            scope = f"{len(paths)} changed file(s) + project pass"
         if failed:
             print(
                 f"dlint: {n_new} finding(s)"
@@ -175,7 +335,7 @@ def main(argv=None) -> int:
             )
         else:
             print(
-                f"dlint clean ({scope}, {len(RULES)} rules"
+                f"dlint clean ({scope}, {n_rules} rules"
                 + (f", {n_old} baselined finding(s)" if n_old else "")
                 + ")"
             )

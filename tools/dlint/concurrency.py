@@ -1,0 +1,507 @@
+"""DLP03x: the concurrency rule family, checked whole-program.
+
+These rules consume the :class:`~tools.dlint.project.ProjectContext`
+model — symbol tables, the name-resolution call graph, the thread-entry
+set and the static lock-acquisition graph — rather than a single file's
+tree. They are the machine-checked form of the locking contracts the
+gateway/scheduler/obs stack documents with ``# guarded-by:`` comments,
+and the static half of the runtime lock sanitizer
+(``distilp_tpu/utils/lockwatch.py``): DLP032's acquisition graph is the
+reference the sanitizer's *observed* graph is validated against.
+
+| code   | contract                                                    |
+|--------|-------------------------------------------------------------|
+| DLP030 | guarded-by discipline: annotated state only under its lock  |
+| DLP031 | no blocking call (I/O, sleep, device sync) inside a lock    |
+| DLP032 | the static lock-acquisition graph is acyclic                |
+| DLP033 | asyncio hazards: sync locks / blocking / TLS across await   |
+| DLP034 | mutable state must not escape into a thread unguarded       |
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, finding_at
+from .project import (
+    ClassInfo,
+    FunctionInfo,
+    ModuleInfo,
+    ProjectContext,
+    ProjectRule,
+    register_project,
+)
+
+
+def _guard_lock_id(
+    pc: ProjectContext, mod: ModuleInfo, ci: Optional[ClassInfo], guard: str
+) -> Optional[str]:
+    """Resolve annotation text (``self._lock`` or ``_MODULE_LOCK``) to a
+    lock node id."""
+    if guard.startswith("self.") and ci is not None:
+        rec = pc._lookup_attr(ci, guard[len("self."):])
+        return rec.lock_id if rec is not None else None
+    g = mod.globals.get(guard)
+    return g.lock_id if g is not None else None
+
+
+def _class_functions(
+    pc: ProjectContext, ci: ClassInfo
+) -> Iterator[FunctionInfo]:
+    """All functions whose ``self`` is an instance of ``ci``: methods and
+    every closure nested inside them (closures are how this codebase
+    ships work to other threads, so they are NOT exempt)."""
+    for fn in pc.functions.values():
+        if fn.klass is ci:
+            yield fn
+
+
+def _is_dunder_init(fn: FunctionInfo) -> bool:
+    # Only __init__'s own body is single-threaded by construction; a
+    # closure defined inside __init__ may run anywhere, so it keeps the
+    # obligation (fn.parent is not None for closures).
+    return fn.node.name == "__init__" and fn.parent is None
+
+
+@register_project
+class GuardedByDiscipline(ProjectRule):
+    code = "DLP030"
+    name = "guarded-by-discipline"
+    rationale = (
+        "A `# guarded-by: self._lock` annotation is a contract, not a "
+        "comment: every read or write of the annotated attribute outside "
+        "a region holding that lock is a data race the moment any thread "
+        "entry reaches the method. The rule also SEEDS the annotations: "
+        "an attribute written under a lock in one method and bare in "
+        "another is flagged so the contract gets written down (or the "
+        "bare write gets its guard). __init__ bodies are exempt — no "
+        "other thread can hold a reference during construction."
+    )
+
+    def check(self, pc: ProjectContext) -> Iterator[Finding]:
+        for mod in pc.modules.values():
+            yield from self._check_module_globals(pc, mod)
+            for ci in mod.classes.values():
+                yield from self._check_class(pc, mod, ci)
+
+    def _check_module_globals(self, pc, mod) -> Iterator[Finding]:
+        guarded = {
+            g.name: _guard_lock_id(pc, mod, None, g.guarded_by)
+            for g in mod.globals.values()
+            if g.guarded_by and not g.lock_id
+        }
+        guarded = {k: v for k, v in guarded.items() if v}
+        if not guarded:
+            return
+        for fn in pc.functions.values():
+            if fn.modname != mod.modname or fn.analysis is None:
+                continue
+            seen: Set[Tuple[int, str]] = set()
+            entry_held = pc.entry_held.get(fn.qname, ())
+            for name, _kind, held, node in fn.analysis.global_names:
+                lock = guarded.get(name)
+                if lock is None or lock in held or lock in entry_held:
+                    continue
+                key = (node.lineno, name)
+                if key not in seen:
+                    seen.add(key)
+                    yield finding_at(
+                        mod.relpath, node, self.code,
+                        f"`{name}` is `# guarded-by:` `{lock}` but accessed "
+                        f"without it in `{fn.node.name}`",
+                    )
+
+    def _check_class(self, pc, mod, ci) -> Iterator[Finding]:
+        guards: Dict[str, str] = {}
+        for attr in ci.attrs.values():
+            if attr.guarded_by and not attr.lock_id:
+                lock = _guard_lock_id(pc, mod, ci, attr.guarded_by)
+                if lock:
+                    guards[attr.name] = lock
+        # Enforcement: annotated attributes, everywhere but __init__.
+        writes_by_attr: Dict[str, List[Tuple[FunctionInfo, Tuple[str, ...], ast.AST]]] = {}
+        for fn in _class_functions(pc, ci):
+            if fn.analysis is None:
+                continue
+            init = _is_dunder_init(fn)
+            seen: Set[Tuple[int, str]] = set()
+            entry_held = pc.entry_held.get(fn.qname, ())
+            for attr, kind, held, node in fn.analysis.self_attr:
+                eff_held = tuple(held) + entry_held
+                if kind == "store" and not init:
+                    writes_by_attr.setdefault(attr, []).append(
+                        (fn, eff_held, node)
+                    )
+                lock = guards.get(attr)
+                if lock is None or init or lock in eff_held:
+                    continue
+                key = (node.lineno, attr)
+                if key not in seen:
+                    seen.add(key)
+                    yield finding_at(
+                        mod.relpath, node, self.code,
+                        f"`self.{attr}` is `# guarded-by:` `{lock}` but "
+                        f"accessed without it in `{ci.name}.{fn.node.name}`",
+                    )
+        # Inference seed: written under a lock in one method, bare in
+        # another -> the bare write is either a race or a missing
+        # annotation; surface it so the contract gets written down.
+        for attr, writes in sorted(writes_by_attr.items()):
+            if attr in guards or (
+                ci.attrs.get(attr) and ci.attrs[attr].lock_id
+            ):
+                continue
+            locked = [(f, h, n) for f, h, n in writes if h]
+            bare = [(f, h, n) for f, h, n in writes if not h]
+            if not locked or not bare:
+                continue
+            lock_names = sorted({h[-1] for _, h, _ in locked})
+            for fn, _h, node in bare:
+                if any(lf.qname != fn.qname for lf, _, _ in locked):
+                    yield finding_at(
+                        mod.relpath, node, self.code,
+                        f"`self.{attr}` is written under `{lock_names[0]}` in "
+                        f"another method but bare here — guard the write or "
+                        f"annotate the attribute with `# guarded-by:`",
+                    )
+                    break  # one finding per (attr, function) is enough
+
+
+@register_project
+class BlockingUnderLock(ProjectRule):
+    code = "DLP031"
+    name = "blocking-under-lock"
+    rationale = (
+        "A lock held across `time.sleep`, file/socket I/O, a blocking "
+        "`queue.get`, or a device sync (`block_until_ready`) convoys "
+        "every thread that needs the lock behind the slowest external "
+        "wait — the gateway's admission lock serializes ALL fleets, so "
+        "one blocking call under it is a cross-tenant stall. Checked "
+        "interprocedurally one call level deep: calling a function that "
+        "blocks is blocking. `cond.wait()` on the innermost held lock is "
+        "exempt (Condition.wait releases it)."
+    )
+
+    def check(self, pc: ProjectContext) -> Iterator[Finding]:
+        for fn in pc.functions.values():
+            a = fn.analysis
+            if a is None:
+                continue
+            seen: Set[int] = set()
+            for node, desc, held in a.blocking:
+                if held and node.lineno not in seen:
+                    seen.add(node.lineno)
+                    yield finding_at(
+                        fn.relpath, node, self.code,
+                        f"{desc} while holding `{held[-1]}`",
+                    )
+            for call, held in a.calls:
+                if not held:
+                    continue
+                for callee in pc.call_targets.get(id(call), []):
+                    if callee == fn.qname:
+                        continue
+                    blocks = pc.blocks_direct.get(callee)
+                    if blocks and call.lineno not in seen:
+                        seen.add(call.lineno)
+                        short = callee.split(".", 1)[-1]
+                        yield finding_at(
+                            fn.relpath, call, self.code,
+                            f"call to `{short}` while holding "
+                            f"`{held[-1]}` — it does {blocks[0][1]} at line "
+                            f"{blocks[0][0]}",
+                        )
+                        break
+
+
+@register_project
+class LockOrderCycles(ProjectRule):
+    code = "DLP032"
+    name = "lock-order-cycle"
+    rationale = (
+        "Two threads taking the same pair of locks in opposite orders is "
+        "a deadlock waiting for the right interleaving. The static "
+        "acquisition graph (lock B acquired — lexically or through a "
+        "call — while A is held) must stay acyclic; any strongly "
+        "connected component is a potential deadlock, reported with one "
+        "witness site per edge. The runtime sanitizer "
+        "(DLP_LOCKWATCH=1) validates this same graph against observed "
+        "executions."
+    )
+
+    def check(self, pc: ProjectContext) -> Iterator[Finding]:
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in pc.lock_edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        for scc in _tarjan(adj):
+            if len(scc) < 2:
+                continue
+            yield self._cycle_finding(pc, sorted(scc))
+        # Direct re-acquire of a non-reentrant lock: lexically nested
+        # acquisition of the same lock identity. (Type-granular, so two
+        # distinct instances of one class CAN nest legitimately — the
+        # message says so; suppress with a justification where intended.)
+        for fn in pc.functions.values():
+            a = fn.analysis
+            if a is None:
+                continue
+            for lock, held, node, _via_with in a.acquisitions:
+                if lock in held and pc.lock_kinds.get(lock) != "rlock":
+                    yield finding_at(
+                        fn.relpath, node, self.code,
+                        f"`{lock}` acquired while already held — "
+                        f"self-deadlock if both are the same instance "
+                        f"(use an RLock or restructure)",
+                    )
+
+    def _cycle_finding(self, pc: ProjectContext, scc: List[str]) -> Finding:
+        # Walk the SCC to present one concrete cycle with witness sites.
+        members = set(scc)
+        adj = {
+            n: {b for (x, b) in pc.lock_edges if x == n and b in members}
+            for n in scc
+        }
+        cycle = _cycle_path(adj, scc[0])
+        hops = []
+        for a, b in zip(cycle, cycle[1:]):
+            sites = pc.lock_edges.get((a, b), [("?", 0, "?")])
+            rel, line, how = sites[0]
+            hops.append(f"{a} -> {b} ({how} at {rel}:{line})")
+        first = pc.lock_edges.get((cycle[0], cycle[1]), [("?", 0, "?")])[0]
+        return Finding(
+            first[0], first[1], self.code,
+            "lock-order cycle: " + "; ".join(hops),
+        )
+
+
+@register_project
+class AsyncioHazards(ProjectRule):
+    code = "DLP033"
+    name = "asyncio-hazards"
+    rationale = (
+        "Inside `async def`, a synchronous `threading` lock acquire "
+        "freezes the whole event loop if contended (no other coroutine "
+        "can run to release it), a blocking call stalls every fleet's "
+        "traffic at once, and thread-local state read after an `await` "
+        "may belong to a different task entirely — the loop migrates "
+        "coroutines across its internal machinery, and thread-locals "
+        "key on threads, not tasks (use contextvars). The blocking-call "
+        "half defers to DLP018 where that per-file rule already covers "
+        "the tree (gateway/obs/traffic)."
+    )
+
+    # Kept in sync with DLP018._PATH_PREFIXES: one finding per hazard.
+    _DLP018_PREFIXES = (
+        "distilp_tpu/gateway/",
+        "distilp_tpu/obs/",
+        "distilp_tpu/traffic/",
+    )
+
+    def check(self, pc: ProjectContext) -> Iterator[Finding]:
+        for fn in pc.functions.values():
+            a = fn.analysis
+            if not fn.is_async or a is None:
+                continue
+            mod = pc.modules[fn.modname]
+            for lock, _held, node, _via_with in a.acquisitions:
+                yield finding_at(
+                    fn.relpath, node, self.code,
+                    f"synchronous lock `{lock}` acquired inside "
+                    f"`async def {fn.node.name}` — blocks the event loop "
+                    f"if contended (take it in an executor, or use "
+                    f"asyncio primitives)",
+                )
+            if not fn.relpath.startswith(self._DLP018_PREFIXES):
+                for node, desc, _held in a.blocking:
+                    yield finding_at(
+                        fn.relpath, node, self.code,
+                        f"{desc} inside `async def {fn.node.name}` stalls "
+                        f"the event loop — run it in an executor",
+                    )
+            first_await = min(a.awaits) if a.awaits else None
+            if first_await is None:
+                continue
+            seen: Set[int] = set()
+            for name, _kind, _held, node in a.global_names:
+                g = mod.globals.get(name)
+                if (
+                    g is not None
+                    and g.thread_local
+                    and node.lineno > first_await
+                    and node.lineno not in seen
+                ):
+                    seen.add(node.lineno)
+                    yield finding_at(
+                        fn.relpath, node, self.code,
+                        f"thread-local `{name}` read after `await` in "
+                        f"`async def {fn.node.name}` — the value keys on "
+                        f"the thread, not the task (use contextvars)",
+                    )
+
+
+@register_project
+class ThreadEscape(ProjectRule):
+    code = "DLP034"
+    name = "thread-escape"
+    rationale = (
+        "Handing a thread target a mutable container the spawner keeps "
+        "using is the unsynchronized-sharing pattern behind PR 8's "
+        "cross-thread mis-parenting bug: two threads, one dict, no lock. "
+        "Flagged when a spawn site (Thread/Timer/submit/run_in_executor) "
+        "passes or closure-captures a mutable local that the spawner "
+        "touches again after the spawn with no lock held, or a mutable "
+        "module global with no `# guarded-by:` annotation. Hand-off "
+        "objects the spawner never touches again are fine — that is the "
+        "ownership-transfer idiom the worker queue is built on."
+    )
+
+    def check(self, pc: ProjectContext) -> Iterator[Finding]:
+        for site in pc.entry_sites:
+            if site.kind == "task":
+                # asyncio tasks run on the SPAWNER's thread; coroutines
+                # interleave only at awaits, so sharing a container with
+                # one is not a data race (DLP033 owns the async hazards).
+                continue
+            fn = site.func
+            a = fn.analysis
+            if a is None:
+                continue
+            mod = pc.modules[fn.modname]
+            flagged: Set[str] = set()
+            # Payload arguments passed by name.
+            for expr in site.data_args:
+                if not isinstance(expr, ast.Name):
+                    continue
+                yield from self._check_name(
+                    pc, mod, fn, site, expr.id, "passed to", flagged
+                )
+            # Closure captures of nested-def targets.
+            for tq in site.targets:
+                nested = pc.functions.get(tq)
+                if (
+                    nested is None
+                    or nested.parent is not fn
+                    or nested.analysis is None
+                ):
+                    continue
+                captured = {
+                    name
+                    for name, _ln, _held in nested.analysis.local_uses
+                    if name in a.local_mutables
+                }
+                for name in sorted(captured):
+                    yield from self._check_name(
+                        pc, mod, fn, site, name,
+                        f"captured by `{nested.node.name}` handed to",
+                        flagged,
+                    )
+
+    def _check_name(
+        self, pc, mod, fn, site, name: str, how: str, flagged: Set[str]
+    ) -> Iterator[Finding]:
+        if name in flagged:
+            return
+        a = fn.analysis
+        g = mod.globals.get(name)
+        if g is not None and g.mutable_literal and not g.guarded_by:
+            flagged.add(name)
+            yield finding_at(
+                mod.relpath, site.call, self.code,
+                f"mutable module global `{name}` {how} a {site.kind} "
+                f"target with no `# guarded-by:` annotation",
+            )
+            return
+        if name not in a.local_mutables:
+            return
+        # Shared only if the spawner touches it again, unsynchronized,
+        # after the spawn. (Post-spawn uses under a lock are the
+        # synchronized-rendezvous idiom and stay quiet.)
+        spawn_line = site.call.lineno
+        for use_name, lineno, held in a.local_uses:
+            if use_name == name and lineno > spawn_line and not held:
+                flagged.add(name)
+                yield finding_at(
+                    mod.relpath, site.call, self.code,
+                    f"mutable local `{name}` {how} a {site.kind} target "
+                    f"and used again at line {lineno} with no lock held",
+                )
+                return
+
+
+# --------------------------------------------------------------------------
+# graph helpers
+
+
+def _tarjan(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Strongly connected components, iterative (no recursion limit)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work: List[Tuple[str, Iterator[str]]] = [(root, iter(sorted(adj[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+def _cycle_path(adj: Dict[str, Set[str]], start: str) -> List[str]:
+    """A concrete cycle through ``start`` inside one SCC, as
+    ``[start, ..., start]``."""
+    path = [start]
+    seen = {start}
+    node = start
+    while True:
+        nxt = None
+        for cand in sorted(adj.get(node, ())):
+            if cand == start and len(path) > 1:
+                path.append(start)
+                return path
+            if cand not in seen:
+                nxt = cand
+                break
+        if nxt is None:
+            # Dead end inside the SCC walk; fall back to closing directly.
+            path.append(start)
+            return path
+        seen.add(nxt)
+        path.append(nxt)
+        node = nxt

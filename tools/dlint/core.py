@@ -47,15 +47,40 @@ _DISABLE_FILE_RE = re.compile(rf"#\s*dlint:\s*disable-file=({_CODES})")
 
 @dataclass(frozen=True)
 class Finding:
-    """One lint finding, printed as ``path:line: CODE message``."""
+    """One lint finding, printed as ``path:line[:col]: CODE message``.
+
+    ``col``/``end_col`` are 1-based and present only where the AST node
+    provided offsets — editor integrations jump to the exact span.
+    Baseline matching stays on ``(path, code)`` only, so adding or
+    refining columns never invalidates a committed baseline entry.
+    """
 
     path: str  # repo-relative, forward slashes
     line: int
     code: str
     message: str
+    col: Optional[int] = None
+    end_col: Optional[int] = None
 
     def render(self) -> str:
+        if self.col is not None:
+            return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
         return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def finding_at(relpath: str, node: ast.AST, code: str, message: str) -> Finding:
+    """Finding anchored at ``node``, carrying its column span when the
+    node has one (ast gives 0-based ``col_offset``; editors are 1-based)."""
+    col = getattr(node, "col_offset", None)
+    end = getattr(node, "end_col_offset", None)
+    return Finding(
+        relpath,
+        getattr(node, "lineno", 0),
+        code,
+        message,
+        col=col + 1 if col is not None else None,
+        end_col=end + 1 if end is not None else None,
+    )
 
 
 @dataclass
@@ -276,13 +301,14 @@ def iter_py_files(root: Path = REPO) -> Iterator[Path]:
             yield p
 
 
-def lint_source(
-    relpath: str,
-    src: str,
-    select: Optional[Iterable[str]] = None,
+def _sort_key(f: Finding):
+    return (f.path, f.line, f.col or 0, f.code)
+
+
+def lint_context(
+    ctx: FileContext, select: Optional[Iterable[str]] = None
 ) -> List[Finding]:
-    """Run (selected) rules over one in-memory file. The fixture-test API."""
-    ctx = FileContext.from_source(relpath, src)
+    """Run (selected) per-file rules over one already-parsed context."""
     findings: List[Finding] = []
     if ctx.syntax_error is not None:
         e = ctx.syntax_error
@@ -297,15 +323,26 @@ def lint_source(
             raise KeyError(f"unknown rule code {code!r}")
         findings.extend(rule.check(ctx))
     findings = [f for f in findings if not is_suppressed(ctx, f)]
-    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    findings.sort(key=_sort_key)
     return findings
+
+
+def lint_source(
+    relpath: str,
+    src: str,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run (selected) rules over one in-memory file. The fixture-test API."""
+    return lint_context(FileContext.from_source(relpath, src), select=select)
 
 
 def resolve_files(
     paths: Optional[List[Path]] = None, root: Path = REPO
 ) -> List[Path]:
     files: List[Path] = []
-    if paths:
+    # `paths=[]` is an explicit empty subset (e.g. --changed with a clean
+    # tree) and must NOT fall back to the full walk — only None does.
+    if paths is not None:
         for p in paths:
             if p.is_dir():
                 files.extend(iter_py_files(p))
@@ -323,6 +360,23 @@ def _relpath(f: Path, root: Path) -> str:
         # Out-of-tree path (explicit argument or symlink): rules keyed on
         # repo-relative prefixes simply won't match; lint it as-is.
         return f.as_posix()
+
+
+def build_contexts(
+    files: List[Path], root: Path = REPO
+) -> Dict[str, FileContext]:
+    """Parse each file ONCE into a context keyed by repo-relative path.
+
+    The single shared parse is the whole-program pass's cost contract:
+    per-file rules and project rules both read these contexts, so adding
+    the project pass must not re-parse the tree a second time.
+    """
+    out: Dict[str, FileContext] = {}
+    for f in files:
+        rel = _relpath(f, root)
+        if rel not in out:
+            out[rel] = FileContext.from_source(rel, f.read_text())
+    return out
 
 
 def lint_files(
@@ -362,18 +416,82 @@ class RunResult:
         return False
 
 
+def _split_select(select: Optional[Iterable[str]]):
+    """Partition a --select list into (per-file codes, project codes).
+
+    Imports the project registry lazily: core must stay importable on its
+    own (the fixture tests), and project.py imports core.
+    """
+    from .project import PROJECT_RULES
+
+    if select is None:
+        return None, None
+    per_file = [c for c in select if c in RULES]
+    project = [c for c in select if c in PROJECT_RULES]
+    return per_file, project
+
+
 def run(
     paths: Optional[List[Path]] = None,
     baseline: Optional[Baseline] = None,
     select: Optional[Iterable[str]] = None,
     root: Path = REPO,
+    with_project: Optional[bool] = None,
 ) -> RunResult:
+    """The gate: per-file rules over the requested files, plus the
+    whole-program pass.
+
+    ``with_project``: None = run the project pass exactly when this is a
+    whole-repo run (or when --select names a DLP03x code); True forces it
+    (the --changed dev loop: per-file rules on the touched files only,
+    the whole-program pass once over everything — cross-file findings
+    caused by a local edit surface wherever they land); False skips it.
+    Project findings are whole-program facts and are never filtered to
+    the path subset.
+    """
+    from .project import PROJECT_RULES, run_project
+
     if baseline is None:
         baseline = Baseline()
+    select_file, select_project = _split_select(select)
     files = resolve_files(paths, root)
-    findings = lint_files(files, select=select, root=root)
+    contexts = build_contexts(files, root=root)
+    findings: List[Finding] = []
+    for rel in sorted(contexts):
+        sel = select_file if select is not None else None
+        if select is not None and not sel:
+            break  # select named only project codes: no per-file pass
+        findings.extend(lint_context(contexts[rel], select=sel))
+
+    run_proj = with_project
+    if run_proj is None:
+        run_proj = paths is None or bool(select_project)
+    if select is not None and not select_project:
+        run_proj = False
+    if run_proj:
+        # The project pass reads the WHOLE library tree; reuse the parses
+        # we already have and fill in whatever the path subset left out.
+        proj_files = [
+            p
+            for p in iter_py_files(root)
+            if _relpath(p, root).startswith("distilp_tpu/")
+        ]
+        missing = [
+            p for p in proj_files if _relpath(p, root) not in contexts
+        ]
+        contexts.update(build_contexts(missing, root=root))
+        proj_contexts = {
+            rel: c
+            for rel, c in contexts.items()
+            if rel.startswith("distilp_tpu/")
+        }
+        findings.extend(
+            run_project(proj_contexts, select=select_project or None)
+        )
+    findings.sort(key=_sort_key)
+
     new, old, stale = baseline.partition(findings)
-    if paths or select:
+    if paths is not None or select:
         # Staleness is only meaningful against a whole-repo, all-rules
         # scan: a subset run never sees the findings that keep entries for
         # other files/rules alive, and must not tell the user to trim them.
@@ -383,7 +501,7 @@ def run(
         findings_baselined=old,
         stale_entries=stale,
         unjustified_entries=baseline.unjustified(),
-        n_files=len(files) if not paths else -1,
+        n_files=len(files) if paths is None else -1,
     )
 
 

@@ -1,0 +1,1185 @@
+"""dlint project pass: whole-program facts for the DLP03x family.
+
+The per-file rules in ``rules.py`` see one tree at a time; none of them
+can see a lock acquired in ``gateway.py`` protecting state mutated from a
+worker thread spawned in ``worker.py``. This module builds the shared
+whole-program model the concurrency rules consume, from the SAME
+``FileContext`` parses the per-file pass already paid for (the single
+parse is the cost contract: the project pass must not double dlint's wall
+time).
+
+What gets built, in order:
+
+1. **Symbol tables** — one :class:`ModuleInfo` per ``distilp_tpu/``
+   module: imports resolved to dotted targets (relative forms included),
+   top-level functions, classes with their methods AND every nested
+   ``def`` (closures are how work crosses threads here), module globals.
+2. **Attribute tables** — per class, every ``self.X = ...`` assignment:
+   whether it creates a lock (``threading.Lock/RLock/Condition`` or the
+   runtime sanitizer's ``make_lock``), its ``# guarded-by:`` annotation,
+   whether the value is a mutable container literal, and the attribute's
+   class type when it is statically evident (``self.x = ClassName(...)``
+   or an annotated constructor parameter).
+3. **A name-resolution call graph** — calls resolved lexically through
+   imports, ``self``, annotated parameters, locally-constructed types and
+   captured enclosing-scope names; when the receiver's type is unknown,
+   a method name defined by exactly ONE project class still resolves
+   (the conservative duck-typing fallback — ambiguous names resolve to
+   every candidate so the static graph over- rather than
+   under-approximates what runtime lock tracking can observe).
+4. **The thread-entry set** — targets of ``threading.Thread``/``Timer``,
+   anything function-valued handed to a ``.submit(...)`` or
+   ``run_in_executor``, ``run`` methods of ``threading.Thread``
+   subclasses, and every ``async def`` (the event loop is its own
+   execution context, concurrent with every worker).
+5. **The static lock-acquisition graph** — nodes are lock identities
+   (``make_lock``'s literal name when present, else
+   ``module.Class.attr``), edges are "B acquired while A held", found
+   both lexically (nested ``with``) and interprocedurally (a call under
+   a held lock contributes every lock the callee may transitively
+   acquire). ``lock_graph()`` exports it; ``--check-lockwatch``
+   cross-validates the runtime sanitizer's observed graph against it.
+
+Lock identity is TYPE-granular (every instance of ``LatencyHist`` shares
+one ``metrics.hist`` node): standard for lock-order analysis, and exactly
+the granularity the runtime sanitizer records, so the two graphs compare
+edge for edge.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import FileContext, Finding, is_suppressed
+
+# ``# guarded-by: self._lock`` / ``# guarded-by: _MODULE_LOCK`` — the
+# annotation grammar. Anything after the expression (prose) is ignored.
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+_LOCK_FACTORIES = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+
+# Receiver-method names too generic for the unique-class fallback: a
+# ``.get()`` must never resolve to some project class's ``get`` just
+# because only one class defines it — dicts and queues spell it too.
+_FALLBACK_DENYLIST = {
+    "get", "put", "pop", "append", "add", "update", "items", "keys",
+    "values", "join", "wait", "notify", "notify_all", "acquire",
+    "release", "set", "clear", "copy", "read", "write", "open",
+}
+_FALLBACK_MAX_CANDIDATES = 4
+
+_MUTABLE_LITERALS = (
+    ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp,
+)
+
+# Method calls that mutate their receiver in place — classified as stores
+# for guarded-by inference (``self.X.append(v)`` races like ``self.X = v``).
+_MUTATOR_METHODS = {
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "remove", "discard", "clear", "setdefault", "appendleft",
+}
+
+
+def modname_of(relpath: str) -> str:
+    return relpath[:-3].replace("/", ".") if relpath.endswith(".py") else relpath.replace("/", ".")
+
+
+def _short_mod(modname: str) -> str:
+    return modname[len("distilp_tpu."):] if modname.startswith("distilp_tpu.") else modname
+
+
+@dataclass
+class AttrRecord:
+    """One ``self.X`` attribute of a class, as the analyzer sees it."""
+
+    name: str
+    lineno: int = 0
+    lock_id: Optional[str] = None      # set when the attr IS a lock
+    lock_kind: Optional[str] = None    # lock | rlock | condition
+    guarded_by: Optional[str] = None   # annotation text, e.g. "self._lock"
+    mutable_literal: bool = False
+    type_qname: Optional[str] = None   # resolved class qname of the value
+
+
+@dataclass
+class GlobalRecord:
+    """One module-level binding: lock / mutable / threading.local."""
+
+    name: str
+    lineno: int = 0
+    lock_id: Optional[str] = None
+    lock_kind: Optional[str] = None
+    guarded_by: Optional[str] = None
+    mutable_literal: bool = False
+    thread_local: bool = False
+
+
+@dataclass
+class FunctionInfo:
+    qname: str
+    modname: str
+    relpath: str
+    node: ast.AST
+    is_async: bool = False
+    klass: Optional["ClassInfo"] = None
+    parent: Optional["FunctionInfo"] = None  # enclosing function, if nested
+    nested: Dict[str, "FunctionInfo"] = field(default_factory=dict)
+    local_types: Dict[str, str] = field(default_factory=dict)
+    analysis: Optional["FuncAnalysis"] = None
+
+
+@dataclass
+class ClassInfo:
+    qname: str
+    name: str
+    modname: str
+    relpath: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)  # resolved dotted names
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+    attrs: Dict[str, AttrRecord] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str
+    modname: str
+    ctx: FileContext
+    imports: Dict[str, str] = field(default_factory=dict)
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    globals: Dict[str, GlobalRecord] = field(default_factory=dict)
+
+
+@dataclass
+class EntrySite:
+    """One place a callable is handed to another execution context."""
+
+    call: ast.Call
+    func: FunctionInfo            # the function containing the site
+    targets: List[str]            # resolved entry qnames
+    target_exprs: List[ast.AST]   # the function-valued argument exprs
+    data_args: List[ast.AST]      # non-callable payload argument exprs
+    kind: str                     # thread | submit | executor | timer | task
+
+
+@dataclass
+class FuncAnalysis:
+    """Everything the concurrency rules need about one function body,
+    computed in ONE walk: lock acquisitions, calls, attribute/name
+    accesses and direct blocking calls, each with the lexically-held
+    lock stack at that point."""
+
+    acquisitions: List[Tuple[str, Tuple[str, ...], ast.AST, bool]] = field(
+        default_factory=list
+    )  # (lock_id, held-before, node, via_with)
+    calls: List[Tuple[ast.Call, Tuple[str, ...]]] = field(default_factory=list)
+    self_attr: List[Tuple[str, str, Tuple[str, ...], ast.AST]] = field(
+        default_factory=list
+    )  # (attr, "load"|"store", held, node)
+    global_names: List[Tuple[str, str, Tuple[str, ...], ast.AST]] = field(
+        default_factory=list
+    )
+    local_stores: Dict[str, ast.AST] = field(default_factory=dict)
+    local_mutables: Dict[str, int] = field(default_factory=dict)  # name -> assign line
+    local_uses: List[Tuple[str, int, Tuple[str, ...]]] = field(
+        default_factory=list
+    )  # (name, lineno, held)
+    blocking: List[Tuple[ast.AST, str, Tuple[str, ...]]] = field(
+        default_factory=list
+    )
+    awaits: List[int] = field(default_factory=list)
+    direct_locks: Set[str] = field(default_factory=set)
+
+
+class ProjectContext:
+    """The whole-program model. Build once per run with :meth:`build`."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_relpath: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.class_by_name: Dict[str, List[ClassInfo]] = {}
+        self.methods_by_name: Dict[str, List[FunctionInfo]] = {}
+        self.callees: Dict[str, Set[str]] = {}
+        self.call_targets: Dict[int, List[str]] = {}  # id(Call) -> qnames
+        self.entry_sites: List[EntrySite] = []
+        self.thread_entries: Set[str] = set()
+        self.thread_reachable: Set[str] = set()
+        self.acquires_star: Dict[str, Set[str]] = {}
+        self.blocks_direct: Dict[str, List[Tuple[int, str]]] = {}
+        # (a, b) -> [(relpath, line, description)]
+        self.lock_edges: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+        self.lock_kinds: Dict[str, str] = {}
+        self.lock_sites: Dict[str, Tuple[str, int]] = {}
+        self.entry_held: Dict[str, Tuple[str, ...]] = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, contexts: Dict[str, FileContext]) -> "ProjectContext":
+        pc = cls()
+        for relpath in sorted(contexts):
+            ctx = contexts[relpath]
+            if ctx.syntax_error is not None:
+                continue  # DLP000 already reported it
+            pc._collect_module(ctx)
+        pc._index()
+        for mod in pc.modules.values():
+            for fn in _iter_functions(mod):
+                pc._resolve_function(mod, fn)
+        pc._find_entries()
+        pc._fixpoint_acquires()
+        pc._build_lock_graph()
+        pc._entry_held_pass()
+        pc._reach()
+        return pc
+
+    # -- pass 1: symbols ---------------------------------------------------
+
+    def _collect_module(self, ctx: FileContext) -> None:
+        modname = modname_of(ctx.relpath)
+        mod = ModuleInfo(relpath=ctx.relpath, modname=modname, ctx=ctx)
+        pkg_parts = tuple(ctx.relpath.split("/")[:-1])
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    head = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    base = ".".join(head + tuple(base.split("."))) if base else ".".join(head)
+                for a in node.names:
+                    if a.name != "*":
+                        mod.imports[a.asname or a.name] = f"{base}.{a.name}"
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = self._make_function(mod, stmt, None, None, f"{modname}.{stmt.name}")
+                mod.functions[stmt.name] = fn
+            elif isinstance(stmt, ast.ClassDef):
+                self._collect_class(mod, stmt)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                self._collect_global(mod, stmt)
+        self.modules[modname] = mod
+        self.by_relpath[ctx.relpath] = mod
+
+    def _make_function(
+        self,
+        mod: ModuleInfo,
+        node,
+        klass: Optional[ClassInfo],
+        parent: Optional[FunctionInfo],
+        qname: str,
+    ) -> FunctionInfo:
+        fn = FunctionInfo(
+            qname=qname,
+            modname=mod.modname,
+            relpath=mod.relpath,
+            node=node,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            klass=klass,
+            parent=parent,
+        )
+        self.functions[qname] = fn
+        # Register nested defs (closures are the repo's unit of
+        # cross-thread work), one level of qname per nesting.
+        for sub in ast.walk(node):
+            if sub is node:
+                continue
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                owner = _innermost_owner(node, sub)
+                if owner is node and sub.name not in fn.nested:
+                    fn.nested[sub.name] = self._make_function(
+                        mod, sub, klass, fn, f"{qname}.<locals>.{sub.name}"
+                    )
+        return fn
+
+    def _collect_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        qname = f"{mod.modname}.{node.name}"
+        ci = ClassInfo(
+            qname=qname,
+            name=node.name,
+            modname=mod.modname,
+            relpath=mod.relpath,
+            node=node,
+        )
+        for b in node.bases:
+            dotted = _dotted(b)
+            if dotted:
+                ci.bases.append(_resolve_dotted(mod, dotted))
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[stmt.name] = self._make_function(
+                    mod, stmt, ci, None, f"{qname}.{stmt.name}"
+                )
+        # Attribute table: every `self.X = ...` in every method.
+        for m in ci.methods.values():
+            for sub in ast.walk(m.node):
+                targets: List[ast.expr] = []
+                value = None
+                annotation = None
+                if isinstance(sub, ast.Assign):
+                    targets, value = sub.targets, sub.value
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    targets, value, annotation = [sub.target], sub.value, sub.annotation
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        self._note_attr(
+                            mod, ci, m, t.attr, value, sub.lineno, annotation
+                        )
+        mod.classes[node.name] = ci
+        self.classes[qname] = ci
+
+    def _note_attr(
+        self, mod, ci, method, name, value, lineno, annotation
+    ) -> None:
+        rec = ci.attrs.get(name)
+        if rec is None:
+            rec = ci.attrs[name] = AttrRecord(name=name, lineno=lineno)
+        guard = _guard_comment(mod.ctx, lineno)
+        if guard and not rec.guarded_by:
+            rec.guarded_by = guard
+        kind, lock_name = _lock_factory(value)
+        if kind and rec.lock_id is None:
+            rec.lock_kind = kind
+            rec.lock_id = lock_name or f"{_short_mod(mod.modname)}.{ci.name}.{name}"
+        if isinstance(value, _MUTABLE_LITERALS) or (
+            isinstance(value, ast.Call)
+            and _dotted(value.func).split(".")[-1] in ("dict", "list", "set", "defaultdict", "deque")
+        ):
+            rec.mutable_literal = True
+        if rec.type_qname is None:
+            rec.type_qname = self._value_type(mod, method, value, annotation)
+
+    def _value_type(self, mod, method, value, annotation) -> Optional[str]:
+        """Class qname of an assigned value, when statically evident."""
+        ann_t = _annotation_class(mod, annotation)
+        if ann_t:
+            return ann_t
+        if isinstance(value, ast.IfExp):
+            # `x if x is not None else Default()`: either arm may name
+            # the type (both arms agreeing is the common idiom).
+            return self._value_type(
+                mod, method, value.body, None
+            ) or self._value_type(mod, method, value.orelse, None)
+        if isinstance(value, ast.Call):
+            dotted = _dotted(value.func)
+            if dotted:
+                resolved = _resolve_dotted(mod, dotted)
+                if resolved in self.classes or resolved.split(".")[-1][:1].isupper():
+                    return resolved
+        if isinstance(value, ast.Name):
+            # `self.x = param` where the constructor annotates param.
+            args = getattr(method.node, "args", None)
+            if args is not None:
+                for a in list(args.args) + list(args.kwonlyargs):
+                    if a.arg == value.id and a.annotation is not None:
+                        return _annotation_class(mod, a.annotation)
+        return None
+
+    def _collect_global(self, mod: ModuleInfo, stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        else:
+            targets, value = [stmt.target], stmt.value
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            rec = mod.globals.get(t.id)
+            if rec is None:
+                rec = mod.globals[t.id] = GlobalRecord(name=t.id, lineno=stmt.lineno)
+            rec.guarded_by = rec.guarded_by or _guard_comment(mod.ctx, stmt.lineno)
+            kind, lock_name = _lock_factory(value)
+            if kind:
+                rec.lock_kind = kind
+                rec.lock_id = lock_name or f"{_short_mod(mod.modname)}.{t.id}"
+            if isinstance(value, _MUTABLE_LITERALS):
+                rec.mutable_literal = True
+            if (
+                isinstance(value, ast.Call)
+                and _dotted(value.func).split(".")[-1] == "local"
+                and "threading" in _dotted(value.func)
+            ):
+                rec.thread_local = True
+
+    def _index(self) -> None:
+        for ci in self.classes.values():
+            self.class_by_name.setdefault(ci.name, []).append(ci)
+            for m in ci.methods.values():
+                self.methods_by_name.setdefault(
+                    m.node.name, []
+                ).append(m)
+        for lock_id, kind, site in self._iter_locks():
+            self.lock_kinds[lock_id] = kind
+            self.lock_sites.setdefault(lock_id, site)
+
+    def _iter_locks(self):
+        for mod in self.modules.values():
+            for g in mod.globals.values():
+                if g.lock_id:
+                    yield g.lock_id, g.lock_kind, (mod.relpath, g.lineno)
+            for ci in mod.classes.values():
+                for a in ci.attrs.values():
+                    if a.lock_id:
+                        yield a.lock_id, a.lock_kind, (mod.relpath, a.lineno)
+
+    # -- pass 2: per-function resolution -----------------------------------
+
+    def _resolve_function(self, mod: ModuleInfo, fn: FunctionInfo) -> None:
+        fn.local_types = dict(fn.parent.local_types) if fn.parent else {}
+        args = getattr(fn.node, "args", None)
+        if args is not None:
+            for a in list(args.args) + list(args.kwonlyargs) + list(
+                filter(None, [args.vararg, args.kwarg])
+            ):
+                if a.arg == "self" and fn.klass is not None:
+                    fn.local_types["self"] = fn.klass.qname
+                elif a.annotation is not None:
+                    t = _annotation_class(mod, a.annotation)
+                    if t:
+                        fn.local_types[a.arg] = t
+        for sub in _own_nodes(fn.node):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                t = self._value_type(mod, fn, sub.value, None)
+                if t:
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            fn.local_types[tgt.id] = t
+        analysis = FuncAnalysis()
+        fn.analysis = analysis
+        self._walk_body(mod, fn, list(_body_of(fn.node)), (), analysis)
+        self.callees[fn.qname] = {
+            q for call, _ in analysis.calls
+            for q in self.call_targets.get(id(call), [])
+        }
+        self.blocks_direct[fn.qname] = [
+            (node.lineno, desc) for node, desc, _ in analysis.blocking
+        ]
+
+    def _walk_body(self, mod, fn, stmts, held, analysis: FuncAnalysis) -> None:
+        for stmt in stmts:
+            self._walk_node(mod, fn, stmt, held, analysis)
+
+    def _walk_node(self, mod, fn, node, held, analysis: FuncAnalysis) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested scopes run elsewhere; analyzed on their own
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                self._walk_node(mod, fn, item.context_expr, held, analysis)
+                lock = self._lock_of_expr(mod, fn, item.context_expr)
+                if lock is not None:
+                    analysis.acquisitions.append(
+                        (lock, tuple(held) + tuple(acquired), item.context_expr, True)
+                    )
+                    analysis.direct_locks.add(lock)
+                    acquired.append(lock)
+            inner = tuple(held) + tuple(acquired)
+            self._walk_body(mod, fn, node.body, inner, analysis)
+            return
+        if isinstance(node, ast.Await):
+            analysis.awaits.append(node.lineno)
+        if isinstance(node, ast.Call):
+            self._note_call(mod, fn, node, held, analysis)
+            # `self.X.append(...)` / `self.X.update(...)` mutate X just as
+            # surely as `self.X[k] = v`: classify as stores so guarded-by
+            # inference sees dict/list mutations, not only rebinds.
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATOR_METHODS
+                and isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"
+            ):
+                analysis.self_attr.append(
+                    (f.value.attr, "store", tuple(held), node)
+                )
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            v = node.value
+            if (
+                isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "self"
+            ):
+                analysis.self_attr.append((v.attr, "store", tuple(held), node))
+            elif isinstance(v, ast.Name) and v.id in mod.globals:
+                analysis.global_names.append((v.id, "store", tuple(held), node))
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                kind = "store" if isinstance(node.ctx, (ast.Store, ast.Del)) else "load"
+                analysis.self_attr.append((node.attr, kind, tuple(held), node))
+        elif isinstance(node, ast.Name):
+            kind = "store" if isinstance(node.ctx, (ast.Store, ast.Del)) else "load"
+            if node.id in mod.globals:
+                analysis.global_names.append((node.id, kind, tuple(held), node))
+            analysis.local_uses.append((node.id, node.lineno, tuple(held)))
+            if isinstance(node.ctx, ast.Store):
+                analysis.local_stores.setdefault(node.id, node)
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, _MUTABLE_LITERALS
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    analysis.local_mutables.setdefault(t.id, node.lineno)
+        if isinstance(node, ast.AnnAssign) and isinstance(
+            node.value, _MUTABLE_LITERALS
+        ) and isinstance(node.target, ast.Name):
+            analysis.local_mutables.setdefault(node.target.id, node.lineno)
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(mod, fn, child, held, analysis)
+
+    def _note_call(self, mod, fn, node: ast.Call, held, analysis) -> None:
+        targets = self._resolve_call(mod, fn, node)
+        if targets:
+            self.call_targets[id(node)] = targets
+        analysis.calls.append((node, tuple(held)))
+        # `.acquire()` on a resolvable lock counts as an acquisition even
+        # outside a `with` (the manual-protocol form).
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+        ):
+            lock = self._lock_of_expr(mod, fn, node.func.value)
+            if lock is not None:
+                analysis.acquisitions.append((lock, tuple(held), node, False))
+                analysis.direct_locks.add(lock)
+        desc = self._blocking_desc(mod, fn, node, held)
+        if desc is not None:
+            # A (desc, effective_held) pair narrows the held set: the
+            # cv-wait case releases its own lock, leaving only the outer
+            # ones blocked for the wait's duration.
+            eff = tuple(held)
+            if isinstance(desc, tuple):
+                desc, eff = desc
+            analysis.blocking.append((node, desc, eff))
+
+    # -- resolution helpers ------------------------------------------------
+
+    def _resolve_call(self, mod, fn, node: ast.Call) -> List[str]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            scope: Optional[FunctionInfo] = fn
+            while scope is not None:
+                if f.id in scope.nested:
+                    return [scope.nested[f.id].qname]
+                scope = scope.parent
+            if fn.klass is not None and f.id in fn.klass.methods:
+                pass  # bare method names don't resolve without self
+            if f.id in mod.functions:
+                return [mod.functions[f.id].qname]
+            dotted = _resolve_dotted(mod, f.id)
+            return self._qnames_for(dotted)
+        if isinstance(f, ast.Attribute):
+            recv_type = self._expr_type(mod, fn, f.value)
+            if recv_type is not None:
+                m = self._lookup_method(recv_type, f.attr)
+                if m is not None:
+                    return [m.qname]
+            dotted = _dotted(f)
+            if dotted:
+                head = dotted.split(".")[0]
+                if head in mod.imports:
+                    return self._qnames_for(
+                        _resolve_dotted(mod, dotted)
+                    )
+            # Duck-typing fallback: a method name defined by few-enough
+            # project classes resolves to every candidate (conservative
+            # over-approximation; see module docstring).
+            if f.attr not in _FALLBACK_DENYLIST:
+                cands = self.methods_by_name.get(f.attr, [])
+                if 1 <= len(cands) <= _FALLBACK_MAX_CANDIDATES:
+                    return [m.qname for m in cands]
+        return []
+
+    def _qnames_for(self, dotted: str) -> List[str]:
+        if dotted in self.functions:
+            return [dotted]
+        if dotted in self.classes:
+            init = self.classes[dotted].methods.get("__init__")
+            return [init.qname] if init is not None else []
+        return []
+
+    def _lookup_method(self, class_qname: str, name: str) -> Optional[FunctionInfo]:
+        seen: Set[str] = set()
+        queue = [class_qname]
+        while queue:
+            q = queue.pop(0)
+            if q in seen:
+                continue
+            seen.add(q)
+            ci = self.classes.get(q)
+            if ci is None:
+                continue
+            if name in ci.methods:
+                return ci.methods[name]
+            queue.extend(ci.bases)
+        return None
+
+    def _expr_type(self, mod, fn, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return fn.local_types.get(expr.id)
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+        ):
+            base_t = fn.local_types.get(expr.value.id)
+            if base_t is not None:
+                ci = self.classes.get(base_t)
+                if ci is not None:
+                    rec = self._lookup_attr(ci, expr.attr)
+                    if rec is not None:
+                        return rec.type_qname
+        return None
+
+    def _lookup_attr(self, ci: ClassInfo, name: str) -> Optional[AttrRecord]:
+        seen: Set[str] = set()
+        queue = [ci.qname]
+        while queue:
+            q = queue.pop(0)
+            if q in seen:
+                continue
+            seen.add(q)
+            c = self.classes.get(q)
+            if c is None:
+                continue
+            if name in c.attrs:
+                return c.attrs[name]
+            queue.extend(c.bases)
+        return None
+
+    def _lock_of_expr(self, mod, fn, expr) -> Optional[str]:
+        """Resolve an expression to a lock node id, or None."""
+        if isinstance(expr, ast.Name):
+            g = mod.globals.get(expr.id)
+            return g.lock_id if g is not None else None
+        if isinstance(expr, ast.Attribute):
+            base_t = self._expr_type(mod, fn, expr.value)
+            if base_t is None and isinstance(expr.value, ast.Name):
+                base_t = fn.local_types.get(expr.value.id)
+            if base_t is not None:
+                ci = self.classes.get(base_t)
+                if ci is not None:
+                    rec = self._lookup_attr(ci, expr.attr)
+                    if rec is not None and rec.lock_id:
+                        return rec.lock_id
+        return None
+
+    # -- blocking-call classification (shared by DLP031/DLP033) ------------
+
+    _QUEUEISH = re.compile(r"(^|_)q(ueue)?$")
+    _THREADISH = re.compile(r"thread")
+
+    def _blocking_desc(self, mod, fn, node: ast.Call, held):
+        """A human description when ``node`` blocks, else None. Returns a
+        ``(desc, effective_held)`` pair instead when the call narrows the
+        held set (cv.wait releases its own lock for the duration)."""
+        f = node.func
+        dotted = _dotted(f)
+        tail = dotted.split(".")[-1] if dotted else ""
+        head = dotted.split(".")[0] if dotted else ""
+        head_mod = mod.imports.get(head, head)
+        if head_mod == "time" and tail == "sleep" and "." in dotted:
+            return f"`{dotted}()` (time.sleep)"
+        if head_mod == "subprocess" and tail in (
+            "run", "call", "check_call", "check_output"
+        ) and "." in dotted:
+            return f"`{dotted}()` (subprocess)"
+        if isinstance(f, ast.Name):
+            target = mod.imports.get(f.id, "")
+            if target in ("time.sleep",) or target.startswith("subprocess."):
+                return f"`{f.id}()` ({target})"
+            if f.id == "open":
+                return "`open()` (file I/O)"
+        if isinstance(f, ast.Attribute):
+            if f.attr in ("block_until_ready", "device_get"):
+                return f"`.{f.attr}()` (device sync)"
+            if f.attr in ("read_text", "write_text", "read_bytes", "write_bytes"):
+                return f"`.{f.attr}()` (file I/O)"
+            if f.attr in ("accept", "recv", "recvfrom", "recv_into"):
+                return f"synchronous socket `.{f.attr}()`"
+            recv_name = (
+                f.value.attr if isinstance(f.value, ast.Attribute)
+                else f.value.id if isinstance(f.value, ast.Name) else ""
+            )
+            if f.attr in ("write", "flush") and re.search(
+                r"writ|sink|_fh$|file", recv_name or ""
+            ):
+                return f"`{recv_name}.{f.attr}()` (file I/O)"
+            if f.attr == "get" and self._QUEUEISH.search(recv_name or ""):
+                if not any(
+                    kw.arg == "block"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False
+                    for kw in node.keywords
+                ):
+                    return f"blocking `{recv_name}.get()`"
+            if f.attr == "join" and self._THREADISH.search(recv_name or ""):
+                return f"`{recv_name}.join()`"
+            if f.attr == "wait":
+                # Condition.wait RELEASES the lock it waits on: exempt
+                # when the receiver is the innermost held lock AND nothing
+                # else is held — an OUTER lock stays held for the whole
+                # wait, which is exactly the convoy this rule exists for.
+                lock = self._lock_of_expr(mod, fn, f.value)
+                if lock is not None and held and held[-1] == lock:
+                    outer = tuple(held[:-1])
+                    if not outer:
+                        return None
+                    return (
+                        f"`{recv_name or '<expr>'}.wait()` (releases "
+                        f"`{lock}`, but not the outer lock)",
+                        outer,
+                    )
+                if lock is not None or _looks_waitable(recv_name):
+                    return f"`{recv_name or '<expr>'}.wait()`"
+        return None
+
+    # -- thread entries ----------------------------------------------------
+
+    def _find_entries(self) -> None:
+        for fn in self.functions.values():
+            if fn.is_async:
+                # The event loop is its own execution context, concurrent
+                # with every worker thread.
+                self.thread_entries.add(fn.qname)
+            if fn.analysis is None:
+                continue
+            mod = self.modules[fn.modname]
+            for call, _held in fn.analysis.calls:
+                site = self._entry_site(mod, fn, call)
+                if site is not None:
+                    self.entry_sites.append(site)
+                    self.thread_entries.update(site.targets)
+        # run() of threading.Thread subclasses.
+        for ci in self.classes.values():
+            if any(b.split(".")[-1] == "Thread" for b in ci.bases):
+                run = ci.methods.get("run")
+                if run is not None:
+                    self.thread_entries.add(run.qname)
+
+    def _entry_site(self, mod, fn, call: ast.Call) -> Optional[EntrySite]:
+        dotted = _dotted(call.func)
+        tail = dotted.split(".")[-1] if dotted else (
+            call.func.attr if isinstance(call.func, ast.Attribute) else ""
+        )
+        fn_args: List[ast.AST] = []
+        data_args: List[ast.AST] = []
+        kind = None
+        if tail == "Thread" and "threading" in _resolve_dotted(mod, dotted):
+            kind = "thread"
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    fn_args.append(kw.value)
+                elif kw.arg == "args" and isinstance(kw.value, (ast.Tuple, ast.List)):
+                    data_args.extend(kw.value.elts)
+                elif kw.arg == "kwargs" and isinstance(kw.value, ast.Dict):
+                    data_args.extend(kw.value.values)
+        elif tail == "Timer" and "threading" in _resolve_dotted(mod, dotted):
+            kind = "timer"
+            if len(call.args) >= 2:
+                fn_args.append(call.args[1])
+            for kw in call.keywords:
+                if kw.arg == "function":
+                    fn_args.append(kw.value)
+                elif kw.arg == "args" and isinstance(kw.value, (ast.Tuple, ast.List)):
+                    data_args.extend(kw.value.elts)
+        elif tail == "submit" and isinstance(call.func, ast.Attribute):
+            kind = "submit"
+            for i, a in enumerate(call.args):
+                (fn_args if self._is_callable_expr(mod, fn, a) or i == 0
+                 else data_args).append(a)
+            for kw in call.keywords:
+                (fn_args if self._is_callable_expr(mod, fn, kw.value)
+                 else data_args).append(kw.value)
+        elif tail == "run_in_executor":
+            kind = "executor"
+            if len(call.args) >= 2:
+                fn_args.append(call.args[1])
+                data_args.extend(call.args[2:])
+        elif tail in ("create_task", "ensure_future") and (
+            "asyncio" in _resolve_dotted(mod, dotted) or dotted.startswith("asyncio")
+        ):
+            kind = "task"
+            for a in call.args:
+                if isinstance(a, ast.Call):
+                    fn_args.append(a.func)
+        if kind is None:
+            return None
+        targets: List[str] = []
+        for e in fn_args:
+            targets.extend(self._resolve_callable_expr(mod, fn, e))
+        if not targets and kind == "submit":
+            # `.submit` on a non-worker object (e.g. a plain pool we can't
+            # see): still an entry site for escape checking, with no
+            # resolvable target.
+            pass
+        return EntrySite(
+            call=call, func=fn, targets=targets,
+            target_exprs=fn_args, data_args=data_args, kind=kind,
+        )
+
+    def _is_callable_expr(self, mod, fn, expr) -> bool:
+        return bool(self._resolve_callable_expr(mod, fn, expr))
+
+    def _resolve_callable_expr(self, mod, fn, expr) -> List[str]:
+        if isinstance(expr, ast.Name):
+            scope = fn
+            while scope is not None:
+                if expr.id in scope.nested:
+                    return [scope.nested[expr.id].qname]
+                scope = scope.parent
+            if expr.id in mod.functions:
+                return [mod.functions[expr.id].qname]
+            dotted = _resolve_dotted(mod, expr.id)
+            if dotted in self.functions:
+                return [dotted]
+            return []
+        if isinstance(expr, ast.Attribute):
+            recv_type = self._expr_type(mod, fn, expr.value)
+            if recv_type is not None:
+                m = self._lookup_method(recv_type, expr.attr)
+                if m is not None:
+                    return [m.qname]
+            if expr.attr not in _FALLBACK_DENYLIST:
+                cands = self.methods_by_name.get(expr.attr, [])
+                if 1 <= len(cands) <= _FALLBACK_MAX_CANDIDATES:
+                    return [m.qname for m in cands]
+        return []
+
+    # -- lock graph --------------------------------------------------------
+
+    def _fixpoint_acquires(self) -> None:
+        for q, fn in self.functions.items():
+            self.acquires_star[q] = set(
+                fn.analysis.direct_locks if fn.analysis else ()
+            )
+        changed = True
+        while changed:
+            changed = False
+            for q in self.functions:
+                acc = self.acquires_star[q]
+                before = len(acc)
+                for callee in self.callees.get(q, ()):
+                    acc |= self.acquires_star.get(callee, set())
+                if len(acc) != before:
+                    changed = True
+
+    def _build_lock_graph(self) -> None:
+        for fn in self.functions.values():
+            a = fn.analysis
+            if a is None:
+                continue
+            for lock, held, node, _via_with in a.acquisitions:
+                for h in held:
+                    if h != lock:
+                        self._add_edge(h, lock, fn.relpath, node.lineno, "direct")
+            for call, held in a.calls:
+                if not held:
+                    continue
+                for callee in self.call_targets.get(id(call), []):
+                    for m in self.acquires_star.get(callee, ()):
+                        for h in held:
+                            if h != m:
+                                self._add_edge(
+                                    h, m, fn.relpath, call.lineno,
+                                    f"via {callee.split('.<locals>.')[-1]}",
+                                )
+
+    def _add_edge(self, a: str, b: str, relpath: str, line: int, how: str) -> None:
+        self.lock_edges.setdefault((a, b), []).append((relpath, line, how))
+
+    def _entry_held_pass(self) -> None:
+        """Locks provably held on ENTRY to a function: the intersection of
+        the lexically-held sets over every resolved call site (one level —
+        callers' own inherited context is not chased). This is how the
+        ``_take_ready``-style "helper called only under the lock" idiom
+        type-checks against guarded-by annotations without lexically
+        re-acquiring in the helper. Thread entries get nothing: their
+        callers hand them to another thread, not a held region."""
+        sites: Dict[str, List[Tuple[str, ...]]] = {}
+        for fn in self.functions.values():
+            if fn.analysis is None:
+                continue
+            for call, held in fn.analysis.calls:
+                for callee in self.call_targets.get(id(call), []):
+                    sites.setdefault(callee, []).append(held)
+        for q, helds in sites.items():
+            if q in self.thread_entries or not helds:
+                continue
+            common = set(helds[0])
+            for h in helds[1:]:
+                common &= set(h)
+            if common:
+                self.entry_held[q] = tuple(sorted(common))
+
+    def _reach(self) -> None:
+        seen: Set[str] = set()
+        queue = list(self.thread_entries)
+        while queue:
+            q = queue.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            queue.extend(self.callees.get(q, ()))
+            # A thread entry drags its nested closures along.
+            fn = self.functions.get(q)
+            if fn is not None:
+                queue.extend(n.qname for n in fn.nested.values())
+        self.thread_reachable = seen
+
+    def lock_graph(self) -> dict:
+        """The static acquisition graph, JSON-able — the reference the
+        runtime sanitizer's observed graph is validated against."""
+        return {
+            "nodes": {
+                lock: {
+                    "kind": self.lock_kinds.get(lock, "lock"),
+                    "site": list(self.lock_sites.get(lock, ("?", 0))),
+                }
+                for lock in sorted(self.lock_kinds)
+            },
+            "edges": [
+                {
+                    "from": a,
+                    "to": b,
+                    "sites": [list(s) for s in sorted(set(sites))[:4]],
+                }
+                for (a, b), sites in sorted(self.lock_edges.items())
+            ],
+        }
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+
+
+def _iter_functions(mod: ModuleInfo) -> Iterator[FunctionInfo]:
+    """Every function of a module, parents before their nested defs (a
+    closure's resolution inherits the enclosing type environment)."""
+
+    def rec(fn: FunctionInfo) -> Iterator[FunctionInfo]:
+        yield fn
+        for sub in fn.nested.values():
+            yield from rec(sub)
+
+    for fn in mod.functions.values():
+        yield from rec(fn)
+    for ci in mod.classes.values():
+        for m in ci.methods.values():
+            yield from rec(m)
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _resolve_dotted(mod: ModuleInfo, dotted: str) -> str:
+    if not dotted:
+        return ""
+    head, _, rest = dotted.partition(".")
+    target = mod.imports.get(head)
+    if target is None:
+        if head in mod.classes:
+            target = f"{mod.modname}.{head}"
+        elif head in mod.functions:
+            target = f"{mod.modname}.{head}"
+        else:
+            target = head
+    return f"{target}.{rest}" if rest else target
+
+
+def _annotation_class(mod: ModuleInfo, ann) -> Optional[str]:
+    """Class qname named by an annotation: Name, dotted, Optional[X],
+    or the quoted-string form."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value.strip().split("[")[-1].rstrip("]").strip("\"'")
+        return _resolve_dotted(mod, name) if name else None
+    if isinstance(ann, ast.Subscript):
+        base = _dotted(ann.value).split(".")[-1]
+        if base in ("Optional", "Union"):
+            inner = ann.slice
+            if isinstance(inner, ast.Tuple):
+                for e in inner.elts:
+                    t = _annotation_class(mod, e)
+                    if t:
+                        return t
+                return None
+            return _annotation_class(mod, inner)
+        return None
+    dotted = _dotted(ann)
+    if not dotted or dotted in ("None",):
+        return None
+    return _resolve_dotted(mod, dotted)
+
+
+def _lock_factory(value) -> Tuple[Optional[str], Optional[str]]:
+    """(kind, explicit name) when ``value`` constructs a lock."""
+    if not isinstance(value, ast.Call):
+        return None, None
+    dotted = _dotted(value.func)
+    tail = dotted.split(".")[-1]
+    if tail in _LOCK_FACTORIES and ("threading" in dotted or dotted == tail):
+        return _LOCK_FACTORIES[tail], None
+    if tail == "make_lock":
+        name = None
+        if value.args and isinstance(value.args[0], ast.Constant) and isinstance(
+            value.args[0].value, str
+        ):
+            name = value.args[0].value
+        kind = "lock"
+        for kw in value.keywords:
+            if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+                kind = str(kw.value.value)
+        return kind, name
+    return None, None
+
+
+def _guard_comment(
+    ctx: FileContext, lineno: int
+) -> Optional[str]:
+    """The ``# guarded-by:`` annotation for an assignment at ``lineno``:
+    its own inline comment, or a PURE comment line directly above it. An
+    inline comment on the *previous statement's* line must never leak
+    onto this one, so the line-above form requires the line to hold
+    nothing but the comment."""
+    comments = ctx.comments()
+    m = GUARDED_BY_RE.search(comments.get(lineno, ""))
+    if m:
+        return m.group(1)
+    above = comments.get(lineno - 1)
+    if above and 0 < lineno - 1 <= len(ctx.lines):
+        if ctx.lines[lineno - 2].strip().startswith("#"):
+            m = GUARDED_BY_RE.search(above)
+            if m:
+                return m.group(1)
+    return None
+
+
+def _looks_waitable(name: str) -> bool:
+    return bool(name) and bool(
+        re.search(r"(event|done|_cv|cond|stop)", name, re.IGNORECASE)
+    )
+
+
+def _body_of(node) -> List[ast.stmt]:
+    body = getattr(node, "body", [])
+    return body if isinstance(body, list) else [ast.Expr(body)]
+
+
+def _own_nodes(func_node) -> Iterator[ast.AST]:
+    """Nodes of a function body, NOT descending into nested defs."""
+    stack = list(_body_of(func_node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _innermost_owner(root, target):
+    """The function node whose body (not a nested def's) contains target."""
+    owner = root
+    stack = [(root, root)]
+    while stack:
+        node, own = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                return own
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.append((child, child))
+            else:
+                stack.append((child, own))
+    return owner
+
+
+# --------------------------------------------------------------------------
+# project rule registry + runner
+
+
+class ProjectRule:
+    """Like :class:`core.Rule`, but checked against the whole program."""
+
+    code: str = ""
+    name: str = ""
+    rationale: str = ""
+
+    def check(self, pc: ProjectContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+PROJECT_RULES: Dict[str, ProjectRule] = {}
+
+
+def register_project(cls: type) -> type:
+    rule = cls()
+    if not rule.code or rule.code in PROJECT_RULES:
+        raise ValueError(f"bad or duplicate project rule code: {rule.code!r}")
+    PROJECT_RULES[rule.code] = rule
+    return cls
+
+
+def run_project(
+    contexts: Dict[str, FileContext],
+    select: Optional[List[str]] = None,
+) -> List[Finding]:
+    """Run the (selected) project rules over already-parsed contexts.
+
+    Suppression comments work exactly as for per-file rules: a finding's
+    line is looked up in its OWN file's comments.
+    """
+    pc = ProjectContext.build(contexts)
+    codes = list(select) if select else sorted(PROJECT_RULES)
+    findings: List[Finding] = []
+    for code in codes:
+        rule = PROJECT_RULES.get(code)
+        if rule is None:
+            raise KeyError(f"unknown project rule code {code!r}")
+        findings.extend(rule.check(pc))
+    out: List[Finding] = []
+    for f in findings:
+        ctx = contexts.get(f.path)
+        if ctx is not None and is_suppressed(ctx, f):
+            continue
+        out.append(f)
+    return out
+
+
+def project_lint_sources(
+    sources: Dict[str, str], select: Optional[List[str]] = None
+) -> List[Finding]:
+    """Fixture-test API: run project rules over in-memory modules."""
+    contexts = {
+        rel: FileContext.from_source(rel, src) for rel, src in sources.items()
+    }
+    return run_project(contexts, select=select)
+
+
+# Importing this module must leave PROJECT_RULES fully populated — the CLI
+# validates --select against it and --list-rules walks it. The import sits
+# at the BOTTOM because concurrency.py imports names defined above; by the
+# time it runs, they all exist, so the cycle is benign.
+from . import concurrency  # noqa: E402,F401  # dlint: disable=DLP001 imported for its register_project side effect

@@ -17,6 +17,7 @@ from .core import (
     Finding,
     Rule,
     dotted_name,
+    finding_at,
     module_level_statements,
     register,
 )
@@ -148,16 +149,20 @@ TRACE_BODY_CONSUMERS = {
 
 
 def _import_bindings(node: ast.AST):
-    """Yield (local_name, lineno) bound by an import statement."""
+    """Yield (local_name, alias_node) bound by an import statement.
+
+    The alias carries the name's own source span (3.10+), so findings can
+    point at the exact name inside a multi-name import, not just line 1
+    of the statement."""
     if isinstance(node, ast.Import):
         for a in node.names:
-            yield (a.asname or a.name.split(".")[0], node.lineno)
+            yield (a.asname or a.name.split(".")[0], a)
     elif isinstance(node, ast.ImportFrom):
         if node.module == "__future__":
             return
         for a in node.names:
             if a.name != "*":
-                yield (a.asname or a.name, node.lineno)
+                yield (a.asname or a.name, a)
 
 
 @register
@@ -188,11 +193,11 @@ class UnusedImport(Rule):
                             ):
                                 used.add(elt.value)
         for node in tree.body:  # module level only
-            for name, lineno in _import_bindings(node):
+            for name, alias in _import_bindings(node):
                 if name not in used and not name.startswith("_"):
-                    yield Finding(
+                    yield finding_at(
                         ctx.relpath,
-                        lineno,
+                        alias,
                         self.code,
                         f"`{name}` imported but unused (F401)",
                     )
@@ -210,15 +215,15 @@ class ImportRedefinition(Rule):
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         seen: Dict[str, int] = {}
         for node in ctx.tree.body:
-            for name, lineno in _import_bindings(node):
-                if name in seen and seen[name] != lineno:
-                    yield Finding(
+            for name, alias in _import_bindings(node):
+                if name in seen and seen[name] != alias.lineno:
+                    yield finding_at(
                         ctx.relpath,
-                        lineno,
+                        alias,
                         self.code,
                         f"redefinition of unused `{name}` (F811)",
                     )
-                seen[name] = lineno
+                seen[name] = alias.lineno
 
 
 def _module_level_jnp_import_line(tree: ast.AST) -> Optional[int]:
@@ -1156,23 +1161,32 @@ class UnregisteredMetricName(Rule):
     @classmethod
     def _registry(cls) -> Dict[str, str]:
         # The registry lives in the metrics module so there is exactly one
-        # copy; loading that FILE directly (not `import distilp_tpu...`)
-        # keeps dlint runnable in environments without the package's
-        # dependencies — the package __init__ chain pulls numpy/pydantic,
-        # while metrics.py itself is stdlib-only — and keeps a broken edit
-        # elsewhere in the package from taking the linter down with it.
+        # copy. It is a PURE dict literal, so dlint lifts it out of the
+        # AST with literal_eval instead of executing the module — no
+        # import chain to drag in (the package __init__ pulls numpy), no
+        # constraint that metrics.py stay free of relative imports, and a
+        # broken edit elsewhere in the package cannot take the linter
+        # down with it.
         if cls._registry_cache is None:
-            import importlib.util
-
             from .core import REPO
 
             path = REPO / "distilp_tpu" / "sched" / "metrics.py"
-            spec = importlib.util.spec_from_file_location(
-                "_dlint_metric_registry", path
-            )
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            cls._registry_cache = mod.METRIC_REGISTRY
+            tree = ast.parse(path.read_text())
+            for node in tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == "METRIC_REGISTRY"
+                        for t in node.targets
+                    )
+                ):
+                    cls._registry_cache = ast.literal_eval(node.value)
+                    break
+            else:
+                raise RuntimeError(
+                    "sched/metrics.py has no module-level METRIC_REGISTRY "
+                    "literal; DLP019 cannot run"
+                )
         return cls._registry_cache
 
     @staticmethod
