@@ -26,7 +26,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory smoke-combine smoke-lockwatch
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory smoke-combine smoke-lockwatch smoke-shard
 	python -m pytest tests/ -q
 
 # Lock-sanitizer smoke: the runtime half of DLP032's deadlock claim. The
@@ -391,6 +391,43 @@ smoke-memory: lint-strict
 		print('smoke-memory OK: %d entry model(s) (%s), leak gate FLAT (%+d B), peak live %.2f MB' \
 			% (len(analyzed), ', '.join(analyzed), leak['growth_bytes'], \
 			   m['watermarks']['peak_live_bytes'] / 1e6))"; \
+	rc=$$?; rm -rf $$D; exit $$rc
+
+# Sharded-mesh smoke: the row-partitioned PDHG engine (ops/meshlp.py) on
+# a forced 4-device host mesh, end to end through the solve CLI. Three
+# solves of the bundled fixture under the same gap/engine: (1) the plain
+# path; (2) --mesh-shards 1, which must be BIT-identical to (1) — the
+# shards=1 knob dispatches onto the very same executable, so any
+# difference is a threading bug, not numerics; (3) --mesh-shards 4, which
+# must certify with the objective inside the optimality-gap envelope of
+# (1). The CLI forces the host device count itself before the backend
+# initializes (utils.shardcompat), so this runs on any CPU box.
+.PHONY: smoke-shard
+smoke-shard: lint-strict
+	@D=$$(mktemp -d) && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli \
+		--profile tests/profiles/llama_3_70b/online --backend jax \
+		--lp-backend pdhg --mip-gap 1e-4 \
+		--save-solution $$D/base.json > /dev/null && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli \
+		--profile tests/profiles/llama_3_70b/online --backend jax \
+		--lp-backend pdhg --mip-gap 1e-4 --mesh-shards 1 \
+		--save-solution $$D/one.json > /dev/null && \
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli \
+		--profile tests/profiles/llama_3_70b/online --backend jax \
+		--lp-backend pdhg --mip-gap 1e-4 --mesh-shards 4 \
+		--save-solution $$D/mesh.json > /dev/null && \
+	python -c "import json; \
+		base = json.load(open('$$D/base.json')); \
+		one = json.load(open('$$D/one.json')); \
+		mesh = json.load(open('$$D/mesh.json')); \
+		assert one['obj_value'] == base['obj_value'], ('shards=1 not bit-stable', one['obj_value'], base['obj_value']); \
+		assert (one['k'], one['w'], one['n']) == (base['k'], base['w'], base['n']), 'shards=1 placement drifted'; \
+		assert mesh['certified'], 'sharded solve not certified'; \
+		assert abs(mesh['obj_value'] - base['obj_value']) <= 2e-4 * abs(base['obj_value']), ('sharded objective outside gap', mesh['obj_value'], base['obj_value']); \
+		assert sum(mesh['w']) > 0 and all(0 <= n <= w for w, n in zip(mesh['w'], mesh['n'])), 'invalid sharded placement'; \
+		print('smoke-shard OK: shards=1 bit-stable, 4-shard mesh certified at obj %.6f (unsharded %.6f)' \
+			% (mesh['obj_value'], base['obj_value']))"; \
 	rc=$$?; rm -rf $$D; exit $$rc
 
 .PHONY: smoke-sched

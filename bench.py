@@ -315,6 +315,7 @@ _COMPARE_LOWER_BETTER = (
     "scheduler_p50_ms", "scheduler_p99_ms",
     "cold_process_ms", "cold_process_cached_ms",
     "fleet_scale_pdhg_512_solve_ms", "fleet_scale_pdhg_2048_solve_ms",
+    "fleet_scale_sharded_512_solve_ms", "fleet_scale_sharded_8192_solve_ms",
     "gateway_p99_ms_100f_4w",
     "combine_p99_ms_100f", "combine_padding_waste",
     "overload_p999_ms",
@@ -508,6 +509,17 @@ def _compare_against(payload: dict, against: str) -> int:
             "memory_analysis temp bytes — fleet_scale's skip decisions "
             "can no longer trust it; see the memory section's "
             "calibration block)"
+        )
+    # The per-shard twin of the same contract, also absolute: the sharded
+    # arms' measured XLA temp bytes must sit inside the calibration band
+    # over memmodel's per-shard prediction, or choose_mesh_shards' sizing
+    # decisions stop being trustworthy.
+    if payload.get("fleet_shard_calibration_ok") is False:
+        failures.append(
+            "fleet_shard_calibration_ok is false (a sharded fleet_scale "
+            "arm's ledger-measured temp bytes fell outside the per-shard "
+            "memmodel prediction's calibration band — see fleet_scale's "
+            "sharded block)"
         )
     # SLO absolute contracts (checked on the new capture, never relative):
     # the committed overload capture must fire AND clear the expected
@@ -2207,7 +2219,14 @@ _FLEET_SCALE_SRC = r"""
 import json, resource, sys, time
 M = int(sys.argv[1]); engine = sys.argv[2]
 gap = float(sys.argv[3]); pdhg_iters = int(sys.argv[4])
-do_conv = len(sys.argv) > 5 and sys.argv[5] == "conv"
+shards = int(sys.argv[5])
+dtype = None if sys.argv[6] == "none" else sys.argv[6]
+do_conv = len(sys.argv) > 7 and sys.argv[7] == "conv"
+if shards > 1:
+    # Before ANY backend touch: a CPU host exposes one device otherwise
+    # and the row mesh cannot form (utils.shardcompat, same as the CLI).
+    from distilp_tpu.utils import shardcompat
+    shardcompat.force_host_devices(shards)
 from distilp_tpu.common import load_model_profile
 from distilp_tpu.solver import halda_solve
 from distilp_tpu.utils import make_synthetic_fleet, stretch_model_for_fleet
@@ -2218,6 +2237,20 @@ base = load_model_profile(
 model = stretch_model_for_fleet(base, M)
 devs = make_synthetic_fleet(M, seed=123)
 kw = {"pdhg_iters": pdhg_iters} if engine == "pdhg" else {}
+if shards > 1:
+    kw["mesh_shards"] = shards
+if dtype is not None:
+    kw["pdhg_dtype"] = dtype
+led = None
+if shards > 1:
+    # Sharded arms run under the memory ledger so the per-shard analytic
+    # prediction (ops/memmodel.pdhg_shard_peak_bytes) is checked against
+    # XLA's measured temp bytes for THIS executable — the PR 15
+    # calibration contract extended to the mesh. The ledger's per-entry
+    # analysis costs <5% (bench memory section gate), accepted here
+    # rather than paying a second fleet-scale solve.
+    from distilp_tpu.obs import memory as obs_memory
+    led = obs_memory.enable(obs_memory.MemoryLedger())
 tm = {}
 t0 = time.perf_counter()
 res = halda_solve(
@@ -2236,6 +2269,19 @@ payload = {
         resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e3, 1
     ),
 }
+if shards > 1:
+    payload["mesh_shards"] = tm.get("mesh_shards")
+    payload["pdhg_dtype"] = dtype
+if led is not None:
+    from distilp_tpu.obs import memory as obs_memory
+    from distilp_tpu.ops import memmodel
+    rec = led.analyses.get("solver._solve_packed") or {}
+    mem = rec.get("memory") or {}
+    payload["shard_temp_bytes_measured"] = mem.get("temp_bytes")
+    payload["shard_temp_bytes_predicted"] = memmodel.pdhg_shard_peak_bytes(
+        M, shards, memmodel.dtype_bytes_of(dtype)
+    )
+    obs_memory.disable()
 if do_conv:
     # ONE designated arm (the parent picks the smallest pdhg M) runs a
     # SECOND solve with solver-interior telemetry on: the fleet-scale
@@ -2290,6 +2336,16 @@ def _fleet_scale_bench() -> dict:
     at gap 0.0 in ONE root round — 1000 is what fits M=2048 inside
     DPERF_FLEET_TIMEOUT with the certificate intact) and recorded, so
     captures compare like for like.
+
+    PR 18 adds the sharded arms (DPERF_FLEET_SHARD_ARMS, "M:shards:dtype"
+    triples; default a 512:4:f32 parity arm + the 8192:4:f32 ceiling arm,
+    16384:4:f32 behind DPERF_FLEET_SHARD_SLOW=1): each runs on a forced
+    host mesh with f32 iterates + the f64 certificate, can extend
+    `fleet_scale_certified_m_max`, and reports memmodel's per-shard
+    predicted bytes against ledger-measured XLA temp bytes
+    (`fleet_shard_calibration_ok`, gated absolutely by --against). All
+    first-order arms draw on DPERF_FLEET_SHARD_BUDGET so an IPM arm can
+    no longer starve them.
     """
     ms_list = [
         int(x)
@@ -2310,11 +2366,13 @@ def _fleet_scale_bench() -> dict:
     from distilp_tpu.ops import memmodel
 
     def _run_arm(
-        M: int, engine: str, timeout_s: float, conv: bool = False
+        M: int, engine: str, timeout_s: float, conv: bool = False,
+        shards: int = 1, dtype: Optional[str] = None,
     ) -> dict:
         argv = [
             sys.executable, "-c", _FLEET_SCALE_SRC,
             str(M), engine, str(gap), str(pdhg_iters),
+            str(shards), dtype or "none",
         ]
         if conv:
             argv.append("conv")
@@ -2339,8 +2397,18 @@ def _fleet_scale_bench() -> dict:
         got["status"] = "ok"
         return got
 
+    # First-order arms (pdhg + sharded) draw on their OWN budget: before
+    # PR 18 a slow IPM arm at small M could exhaust DPERF_FLEET_BUDGET and
+    # starve the large-M PDHG arms — the section's actual headline. IPM
+    # arms keep charging DPERF_FLEET_BUDGET alone, so the section's total
+    # is bounded by the sum of the two knobs and neither side can starve
+    # the other.
+    shard_budget_s = max(
+        per_timeout, _env_num("DPERF_FLEET_SHARD_BUDGET", 4200)
+    )
     sizes: dict = {}
-    spent = 0.0
+    spent = 0.0  # IPM-side / total-section spend (DPERF_FLEET_BUDGET)
+    spent_fo = 0.0  # first-order arms (DPERF_FLEET_SHARD_BUDGET)
     crossover = None
     certified_max = None
     ipm_lost = False  # first IPM loss settles every larger M
@@ -2357,8 +2425,10 @@ def _fleet_scale_bench() -> dict:
             "pdhg_mem_proxy_gb": round(pdhg_gb, 3),
         }
 
-        if spent >= budget_s:
-            row["pdhg"] = {"status": "skipped (DPERF_FLEET_BUDGET exhausted)"}
+        if spent_fo >= shard_budget_s:
+            row["pdhg"] = {
+                "status": "skipped (DPERF_FLEET_SHARD_BUDGET exhausted)"
+            }
         else:
             t0 = time.perf_counter()
             # The smallest pdhg arm is the designated convergence arm: its
@@ -2370,11 +2440,11 @@ def _fleet_scale_bench() -> dict:
                 M, "pdhg",
                 min(
                     per_timeout * (2 if conv_arm else 1),
-                    max(120.0, budget_s - spent),
+                    max(120.0, shard_budget_s - spent_fo),
                 ),
                 conv=conv_arm,
             )
-            spent += time.perf_counter() - t0
+            spent_fo += time.perf_counter() - t0
         pd = row["pdhg"]
         pd_ok = pd.get("status") == "ok" and pd.get("certified")
 
@@ -2430,18 +2500,76 @@ def _fleet_scale_bench() -> dict:
                 if crossover is None:
                     crossover = M
 
+    # -- sharded arms: (M, shards, dtype) triples on a forced host mesh —
+    # the "move the ceiling" half of the section. Defaults: a small parity
+    # arm (sharded-vs-unsharded solve_ms at M=512 is directly comparable
+    # against the unsharded row above) and the M=8192 f32-iterate arm that
+    # extends fleet_scale_certified_m_max past the unsharded 4096.
+    # M=16384 exists for capable boxes behind DPERF_FLEET_SHARD_SLOW=1
+    # (the pytest twin is tests/test_meshlp.py's @pytest.mark.slow arm).
+    # Each arm's child reports memmodel's per-shard predicted bytes next
+    # to the ledger-measured XLA temp bytes; the measured/predicted ratio
+    # must sit in the PR 15 calibration band (above the dominant-term
+    # model, within two orders) for fleet_shard_calibration_ok to hold —
+    # `--against` fails on False, same contract as mem_calibration_ok.
+    arm_spec = os.environ.get(
+        "DPERF_FLEET_SHARD_ARMS", "512:4:f32,8192:4:f32"
+    )
+    if os.environ.get("DPERF_FLEET_SHARD_SLOW", ""):
+        arm_spec += ",16384:4:f32"
+    sharded: dict = {}
+    shard_ratios: list = []
+    for spec in [s.strip() for s in arm_spec.split(",") if s.strip()]:
+        m_s, s_s, dt = (spec.split(":") + ["f32"])[:3]
+        M, S = int(m_s), int(s_s)
+        key = f"{M}x{S}:{dt}"
+        if spent_fo >= shard_budget_s:
+            sharded[key] = {
+                "status": "skipped (DPERF_FLEET_SHARD_BUDGET exhausted)"
+            }
+            continue
+        t0 = time.perf_counter()
+        arm = _run_arm(
+            M, "pdhg",
+            min(per_timeout, max(120.0, shard_budget_s - spent_fo)),
+            shards=S, dtype=dt,
+        )
+        spent_fo += time.perf_counter() - t0
+        if arm.get("status") == "ok":
+            meas = arm.get("shard_temp_bytes_measured")
+            pred = arm.get("shard_temp_bytes_predicted")
+            arm["shard_calibration_ratio"] = (
+                round(meas / pred, 3) if meas and pred else None
+            )
+            if arm["shard_calibration_ratio"] is not None:
+                shard_ratios.append(arm["shard_calibration_ratio"])
+            if arm.get("certified"):
+                certified_max = max(certified_max or 0, M)
+        sharded[key] = arm
     out["fleet_scale"] = {
         "gap": gap,
         "pdhg_iters": pdhg_iters,
         "model": "llama_3_70b scalars stretched to L=2M",
         "sizes": sizes,
+        "sharded": sharded,
+        "shard_budget_s": shard_budget_s,
     }
     out["fleet_scale_crossover_m"] = crossover
     out["fleet_scale_certified_m_max"] = certified_max
+    # Band verdict mirrors mem_calibration_ok: None (no measurement) is
+    # not a failure — only a measured ratio OUTSIDE the band is.
+    out["fleet_shard_calibration_ok"] = (
+        None if not shard_ratios
+        else all(1.0 <= r <= 100.0 for r in shard_ratios)
+    )
     for M in (512, 2048):
         e = sizes.get(str(M), {}).get("pdhg", {})
         if e.get("status") == "ok" and e.get("certified"):
             out[f"fleet_scale_pdhg_{M}_solve_ms"] = e["solve_ms"]
+    for key, arm in sharded.items():
+        if arm.get("status") == "ok" and arm.get("certified"):
+            M = key.split("x")[0]
+            out[f"fleet_scale_sharded_{M}_solve_ms"] = arm["solve_ms"]
     return out
 
 

@@ -114,6 +114,19 @@ def build_parser() -> argparse.ArgumentParser:
         "engine; default 0.2 — smaller restarts less often)",
     )
     p.add_argument(
+        "--mesh-shards", type=int, default=None,
+        help="row-partition every PDHG LP relaxation across this many "
+        "devices (pdhg engine; default 1 = no mesh). On a CPU host the "
+        "CLI forces that many virtual host devices before the backend "
+        "initializes (utils.shardcompat)",
+    )
+    p.add_argument(
+        "--pdhg-dtype", choices=["f32", "f64"], default=None,
+        help="first-order iterate precision (pdhg engine; default: the "
+        "solver's search dtype). The mip-gap certificate is evaluated in "
+        "f64 regardless, and an uncertified f32 solve escalates to f64",
+    )
+    p.add_argument(
         "--batch-size", type=int, default=1,
         help="price dense compute at the profiles' b_N throughput column "
         "(default 1 = reference parity; the model profile must carry the "
@@ -194,6 +207,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--pdhg-restart-tol", type=float, default=None,
         help="Halpern restart sufficient-decay factor (pdhg engine)",
+    )
+    p.add_argument(
+        "--mesh-shards", type=int, default=None,
+        help="row-partition every tick's PDHG LP relaxations across this "
+        "many devices (pdhg engine; CPU hosts get forced virtual devices "
+        "before backend init)",
+    )
+    p.add_argument(
+        "--pdhg-dtype", choices=["f32", "f64"], default=None,
+        help="first-order iterate precision per tick (pdhg engine; f64 "
+        "certificate unconditional)",
     )
     p.add_argument(
         "--risk-aware",
@@ -938,6 +962,12 @@ def serve_main(argv=None) -> int:
     from ..axon_guard import force_cpu_if_env_requested
 
     force_cpu_if_env_requested()
+    if (args.mesh_shards or 1) > 1:
+        # Same pre-backend ordering as the one-shot solver: the daemon's
+        # first tick initializes the backend, so the flag goes in now.
+        from ..utils import shardcompat
+
+        shardcompat.force_host_devices(args.mesh_shards)
 
     # Gateway tier: any of the scale-out flags (or a fleet-tagged trace)
     # diverts to the sharded multi-worker path. With none of them, the
@@ -1062,6 +1092,8 @@ def serve_main(argv=None) -> int:
         lp_backend=args.lp_backend,
         pdhg_iters=args.pdhg_iters,
         pdhg_restart_tol=args.pdhg_restart_tol,
+        mesh_shards=args.mesh_shards,
+        pdhg_dtype=args.pdhg_dtype,
         risk_aware=args.risk_aware,
         risk_samples=args.risk_samples,
         risk_seed=args.risk_seed,
@@ -1308,6 +1340,8 @@ def _serve_gateway(args) -> int:
         lp_backend=args.lp_backend,
         pdhg_iters=args.pdhg_iters,
         pdhg_restart_tol=args.pdhg_restart_tol,
+        mesh_shards=getattr(args, "mesh_shards", None),
+        pdhg_dtype=getattr(args, "pdhg_dtype", None),
         risk_aware=args.risk_aware,
         risk_samples=args.risk_samples,
         risk_seed=args.risk_seed,
@@ -2891,6 +2925,12 @@ def main(argv=None) -> int:
     from ..axon_guard import force_cpu_if_env_requested
 
     force_cpu_if_env_requested()
+    if (args.mesh_shards or 1) > 1:
+        # Must land in XLA_FLAGS before the first backend touch — a CPU
+        # host exposes one device otherwise and the mesh cannot form.
+        from ..utils import shardcompat
+
+        shardcompat.force_host_devices(args.mesh_shards)
 
     from ..common import load_from_profile_folder
     from ..solver import halda_solve
@@ -2992,6 +3032,8 @@ def main(argv=None) -> int:
                 lp_backend=args.lp_backend,
                 pdhg_iters=args.pdhg_iters,
                 pdhg_restart_tol=args.pdhg_restart_tol,
+                mesh_shards=args.mesh_shards,
+                pdhg_dtype=args.pdhg_dtype,
                 batch_size=args.batch_size,
                 time_limit=args.time_limit,
                 debug=args.debug,
@@ -3065,6 +3107,8 @@ def main(argv=None) -> int:
                 lp_backend=args.lp_backend,
                 pdhg_iters=args.pdhg_iters,
                 pdhg_restart_tol=args.pdhg_restart_tol,
+                mesh_shards=args.mesh_shards,
+                pdhg_dtype=args.pdhg_dtype,
                 batch_size=args.batch_size,
             )
     except ValueError as e:

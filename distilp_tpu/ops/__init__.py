@@ -8,6 +8,7 @@ call time, the same DLP013 idiom as every other backend-touching import.
 """
 
 from .ipm import IPMResult, IPMWarmState, LPBatch, ipm_solve_batch
+from .meshlp import pdhg_solve_batch_mp, pdhg_solve_batch_sharded
 from .pdhg import PDHGWarmState, pdhg_solve_batch
 
 __all__ = [
@@ -17,4 +18,6 @@ __all__ = [
     "PDHGWarmState",
     "ipm_solve_batch",
     "pdhg_solve_batch",
+    "pdhg_solve_batch_sharded",
+    "pdhg_solve_batch_mp",
 ]

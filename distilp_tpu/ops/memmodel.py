@@ -44,10 +44,14 @@ from typing import Optional
 __all__ = [
     "DENSE_BEAM",
     "F32_BYTES",
+    "F64_BYTES",
     "ENGINES",
     "standard_form_dims",
     "ipm_peak_bytes",
     "pdhg_peak_bytes",
+    "pdhg_shard_peak_bytes",
+    "choose_mesh_shards",
+    "dtype_bytes_of",
     "peak_bytes",
     "peak_gb",
     "ipm_memory_infeasible",
@@ -57,6 +61,17 @@ __all__ = [
 # backend_jax's dense search-knob defaults; bench.py pinned the same 6).
 DENSE_BEAM = 6
 F32_BYTES = 4
+F64_BYTES = 8
+
+
+def dtype_bytes_of(pdhg_dtype: Optional[str]) -> int:
+    """Bytes per element of a ``pdhg_dtype`` knob value (None = the f32
+    search dtype the solver runs by default)."""
+    if pdhg_dtype in (None, "f32"):
+        return F32_BYTES
+    if pdhg_dtype == "f64":
+        return F64_BYTES
+    raise ValueError(f"unknown pdhg_dtype {pdhg_dtype!r} (expected f32|f64)")
 
 ENGINES = ("ipm", "pdhg")
 
@@ -84,6 +99,47 @@ def pdhg_peak_bytes(M: int, dtype_bytes: int = F32_BYTES) -> int:
     invariant PR 6 documented)."""
     m_rows, n_cols = standard_form_dims(M)
     return m_rows * n_cols * dtype_bytes
+
+
+def pdhg_shard_peak_bytes(
+    M: int, shards: int = 1, dtype_bytes: int = F32_BYTES
+) -> int:
+    """Per-DEVICE peak working set of the row-sharded PDHG engine
+    (ops/meshlp.py): each shard holds an ``(ceil(m/S), n)`` block of the
+    one shared operator — the row padding to a multiple of S is modeled
+    exactly, since the pad rows are real zero rows in the block. Iterates
+    are vectors (noise next to the block) and the f64 certificate is two
+    matvec passes over the same block at 2x element width, both absorbed
+    by the calibration band rather than modeled as separate terms — the
+    same single-dominant-term shape as ``pdhg_peak_bytes``, which this
+    reduces to at shards=1."""
+    if shards < 1:
+        raise ValueError(f"mesh_shards must be >= 1 (got {shards})")
+    m_rows, n_cols = standard_form_dims(M)
+    m_block = -(-m_rows // shards)  # ceil: the padded per-shard rows
+    return m_block * n_cols * dtype_bytes
+
+
+def choose_mesh_shards(
+    M: int,
+    per_device_budget_bytes: int,
+    max_shards: int,
+    dtype_bytes: int = F32_BYTES,
+) -> Optional[int]:
+    """Smallest shard count whose per-device operator block fits the
+    budget — model-predicted, ledger-verified (the PR 15 calibration band
+    is what licenses trusting this analytic answer). Returns None when
+    even ``max_shards`` devices cannot fit a block: the caller should say
+    so rather than OOM measuring it. shards=1 (no mesh) is preferred when
+    it fits — the unsharded program has no collectives to pay for."""
+    if max_shards < 1:
+        raise ValueError(f"max_shards must be >= 1 (got {max_shards})")
+    if per_device_budget_bytes < 1:
+        raise ValueError("per_device_budget_bytes must be positive")
+    for shards in range(1, max_shards + 1):
+        if pdhg_shard_peak_bytes(M, shards, dtype_bytes) <= per_device_budget_bytes:
+            return shards
+    return None
 
 
 def peak_bytes(M: int, engine: str, beam: int = DENSE_BEAM) -> int:

@@ -1,0 +1,287 @@
+"""Row-partitioned PDHG across a device mesh — ROADMAP item 3's engine.
+
+The matrix-free kernel in :mod:`distilp_tpu.ops.pdhg` only ever touches A
+through ``opA``/``opAT`` (the PR 6 fleet-scale invariant), which is exactly
+the property that lets the *operators* shard: partition the DEVICE ROWS of
+the standard-form instance across a 1-D mesh axis, keep the primal iterate
+(a column vector) replicated, and the whole restarted-Halpern iteration
+runs shard-local except for one ``psum`` per ``opAT`` (the column-sum
+A'y) plus the scalar reductions of the convergence/restart gauges — the
+MPAX batched/distributed LP-in-JAX design (arXiv 2412.09734) applied to
+the HALDA standard form, with HPR-LP's accelerator-resident first-order
+loop + cheap high-precision certificate split (arXiv 2408.12179).
+
+What each shard holds, for an ``(m, n)`` instance on ``S`` shards:
+
+- an ``(m/S, n)`` block of A (the whole memory story: the shared operator
+  is THE footprint at fleet scale — see ``ops/memmodel.py``'s per-shard
+  model, which *chooses* S so a block fits the per-device budget);
+- the matching slices of ``b``, the row equilibration/step vectors, and
+  the dual iterate ``y``;
+- a full (replicated) copy of the column data ``c``/``l``/``u``, the
+  primal iterate, and every scalar of the restart control — so all shards
+  take the same branch every step by construction.
+
+``m`` is padded up to a multiple of ``S`` with all-zero rows, which the
+kernel already treats as decoupled (their row scale never amplifies, their
+step size is 0, their dual stays 0, and they contribute nothing to any
+product or to the f64 certificate) — padding is exact, not approximate.
+
+Everything is resolved through :mod:`distilp_tpu.utils.shardcompat`, so
+this module runs on the jax 0.4.37 this image ships (where ``shard_map``
+still lives in ``jax.experimental``) and on current jax unchanged. On a
+CPU-only box a forced host mesh (``--xla_force_host_platform_device_count``)
+exercises the full collective program — that is how the tests and
+``make smoke-shard`` run it.
+
+Warm states stay in the ORIGINAL full-array coordinates on both edges:
+the sharded kernel slices ``y`` into blocks on entry and all-gathers the
+final iterates on exit, so a :class:`~distilp_tpu.ops.pdhg.PDHGWarmState`
+produced here is field-for-field the unsharded kernel's (and the IPM's) —
+``dump_warm_state``/``load_warm_state`` round-trip it bit-exactly with no
+shard-count in the blob, which is what lets a warm state dumped at one
+mesh size restore at any other.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..obs.compile_ledger import instrument
+from ..utils import shardcompat
+from .ipm import IPMResult, LPBatch
+from .pdhg import (
+    DEFAULT_RESTART_TOL,
+    PDHG_DEFAULT_CHUNK,
+    PDHGWarmState,
+    _default_tol_pdhg,
+    _pdhg_single,
+    resolve_pdhg_dtype,
+)
+
+__all__ = [
+    "MESH_AXIS",
+    "pad_rows_to",
+    "pdhg_solve_batch_sharded",
+    "pdhg_solve_batch_mp",
+]
+
+# The one mesh-axis name of the row partition. dlint DLP021 scopes its
+# mesh-body checks to shard_map callees; keeping the axis a module constant
+# keeps every collective call site greppable.
+MESH_AXIS = "rows"
+
+
+def pad_rows_to(m: int, shards: int) -> int:
+    """Rows after padding ``m`` up to a multiple of ``shards``."""
+    if shards < 1:
+        raise ValueError(f"mesh_shards must be >= 1 (got {shards})")
+    return int(-(-m // shards) * shards)
+
+
+def _pad_axis(x, target: int, axis: int):
+    """Zero-pad ``x`` along ``axis`` to length ``target`` (exact rows: the
+    kernel treats all-zero rows as decoupled, see module docstring)."""
+    pad = target - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def sharded_pdhg(
+    batch: LPBatch,
+    mesh_shards: int,
+    iters: int,
+    tol,
+    restart_tol,
+    warm: Optional[PDHGWarmState] = None,
+    skip: Optional[jax.Array] = None,
+    chunk: int = PDHG_DEFAULT_CHUNK,
+    trace: bool = False,
+) -> IPMResult:
+    """The traceable core: row-shard one LPBatch across ``mesh_shards``
+    devices and run the mesh-aware PDHG kernel. Callable standalone or
+    inside an enclosing jit (the fused B&B program calls it mid-trace;
+    ``mesh_shards`` is static there, so the mesh is built at trace time).
+
+    Returns the unsharded kernel's exact :class:`IPMResult` contract with
+    every array fully replicated — the caller cannot tell, shape-wise,
+    which engine ran.
+    """
+    P = shardcompat.partition_spec
+    mesh = shardcompat.shard_mesh(mesh_shards, axis=MESH_AXIS)
+
+    B = batch.b.shape[0]
+    m = batch.b.shape[1]
+    m_pad = pad_rows_to(m, mesh_shards)
+    shared_a = batch.A.ndim == 2
+    row_axis = 0 if shared_a else 1
+
+    A_p = _pad_axis(batch.A, m_pad, row_axis)
+    b_p = _pad_axis(batch.b, m_pad, 1)
+
+    # Materialize the optional operands: a disabled warm state (ok=False is
+    # pinned to behave exactly like no warm state) and an all-live skip
+    # keep the shard_map signature static across call sites.
+    if warm is None:
+        warm = PDHGWarmState(
+            v=jnp.zeros_like(batch.c),
+            y=jnp.zeros((B, m), batch.b.dtype),
+            z=jnp.zeros_like(batch.c),
+            f=jnp.zeros_like(batch.c),
+            ok=jnp.zeros((B,), bool),
+        )
+    wy_p = _pad_axis(jnp.asarray(warm.y, batch.b.dtype), m_pad, 1)
+    if skip is None:
+        skip = jnp.zeros((B,), bool)
+
+    a_spec = P(MESH_AXIS, None) if shared_a else P(None, MESH_AXIS, None)
+    rep2 = P(None, None)
+    rep1 = P(None)
+
+    def body(A_blk, b_blk, c, l, u, wv, wy_blk, wz, wf, wok, sk):
+        def single(A1, b1, c1, l1, u1, wm, s1):
+            return _pdhg_single(
+                A1, b1, c1, l1, u1, iters, tol, restart_tol,
+                warm=wm, skip=s1, chunk=chunk, trace=trace,
+                axis_name=MESH_AXIS,
+            )
+
+        res = jax.vmap(
+            single,
+            in_axes=(None if shared_a else 0, 0, 0, 0, 0, 0, 0),
+        )(
+            A_blk, b_blk, c, l, u,
+            PDHGWarmState(v=wv, y=wy_blk, z=wz, f=wf, ok=wok), sk,
+        )
+        # The dual block is the only row-sharded output; gather it so the
+        # result contract is fully replicated (tiled: concatenate the
+        # blocks back along the row axis in mesh order).
+        y_full = jax.lax.all_gather(res.y_dual, MESH_AXIS, axis=1, tiled=True)
+        return res._replace(y_dual=y_full)
+
+    out_specs = IPMResult(
+        v=rep2, bound=rep1, obj=rep1, rp_norm=rep1, rd_norm=rep1, mu=rep1,
+        converged=rep1, reduced=rep2, y_dual=rep2, z_dual=rep2, f_dual=rep2,
+        iters_run=rep1, trace_buf=P(None, None, None) if trace else None,
+    )
+    with jax.default_matmul_precision("highest"):
+        res = shardcompat.shard_map(
+            body,
+            mesh,
+            in_specs=(
+                a_spec, P(None, MESH_AXIS), rep2, rep2, rep2,
+                rep2, P(None, MESH_AXIS), rep2, rep2, rep1, rep1,
+            ),
+            out_specs=out_specs,
+            # The replication checker cannot prove psum/all_gather-fed
+            # replicated outputs on every jax this shim spans; the specs
+            # above ARE the contract and the parity tests pin it.
+            check_vma=False,
+        )(
+            A_p, b_p, batch.c, batch.l, batch.u,
+            warm.v, wy_p, warm.z, warm.f, warm.ok, skip,
+        )
+    return res._replace(y_dual=res.y_dual[:, :m])
+
+
+def _pdhg_sharded_entry(
+    batch: LPBatch,
+    tol=None,
+    restart_tol=None,
+    warm=None,
+    skip=None,
+    mesh_shards: int = 1,
+    iters: int = 1000,
+    chunk: int = PDHG_DEFAULT_CHUNK,
+    trace: bool = False,
+    dtype: Optional[str] = None,
+) -> IPMResult:
+    dt = resolve_pdhg_dtype(dtype)
+    if dt is not None and dt != batch.A.dtype:
+        batch = LPBatch(*(jnp.asarray(x).astype(dt) for x in batch))
+    tol_v = _default_tol_pdhg(batch.A.dtype) if tol is None else tol
+    rt_v = DEFAULT_RESTART_TOL if restart_tol is None else restart_tol
+    return sharded_pdhg(
+        batch, mesh_shards, iters, tol_v, rt_v,
+        warm=warm, skip=skip, chunk=chunk, trace=trace,
+    )
+
+
+# Registered compile-ledger entry point (obs.compile_ledger; dlint DLP020):
+# the sharded sibling of ops.pdhg.pdhg_solve_batch. `mesh_shards` is static
+# — each shard count is its own executable, attributed by the ledger, and a
+# warm streaming/bench loop at a fixed shard count must show ZERO warm-phase
+# compiles here (the same bucket-scoped gate contract as PR 16).
+_SHARDED_STATICS = ("mesh_shards", "iters", "chunk", "trace", "dtype")
+pdhg_solve_batch_sharded = instrument(
+    "ops.meshlp.pdhg_solve_batch_sharded",
+    jax.jit(_pdhg_sharded_entry, static_argnames=_SHARDED_STATICS),
+    static_argnames=_SHARDED_STATICS,
+)
+
+
+def pdhg_solve_batch_mp(
+    batch: LPBatch,
+    mesh_shards: int = 1,
+    iters: int = 1000,
+    tol: Optional[float] = None,
+    restart_tol: Optional[float] = None,
+    warm: Optional[PDHGWarmState] = None,
+    skip: Optional[jax.Array] = None,
+    chunk: int = PDHG_DEFAULT_CHUNK,
+    trace: bool = False,
+    dtype: str = "f32",
+    f64_fallback: bool = True,
+    fallback_report: Optional[dict] = None,
+) -> IPMResult:
+    """Mixed-precision sharded solve with the soundness escalation.
+
+    Runs the (optionally sharded) PDHG at ``dtype`` iterate precision —
+    f32 is the fleet-scale default: half the operator bytes per shard,
+    with the f64 Lagrangian bound as the certificate either way. A batch
+    element whose f32 run comes back non-finite or stalled (not converged)
+    is re-solved on the f64 path and spliced in per element — the same
+    shape as the warm-garbage→cold fallback inside the kernel: precision
+    is an optimization that can cost a re-solve, never soundness.
+
+    ``fallback_report`` (pass a dict) receives ``n_fallback`` — bench and
+    tests read it to prove the fast path stayed fast.
+    """
+    res = pdhg_solve_batch_sharded(
+        batch, tol=tol, restart_tol=restart_tol, warm=warm, skip=skip,
+        mesh_shards=mesh_shards, iters=iters, chunk=chunk, trace=trace,
+        dtype=dtype,
+    )
+    n_bad = 0
+    if f64_fallback and dtype != "f64":
+        import numpy as np
+
+        bad = ~np.asarray(res.converged) | ~np.isfinite(np.asarray(res.bound))
+        n_bad = int(bad.sum())
+        if n_bad:
+            res64 = pdhg_solve_batch_sharded(
+                batch, tol=tol, restart_tol=restart_tol, warm=warm,
+                skip=skip, mesh_shards=mesh_shards, iters=iters, chunk=chunk,
+                trace=trace, dtype="f64",
+            )
+            badj = jnp.asarray(bad)
+
+            def splice(a32, a64):
+                if a32 is None:
+                    return None
+                sel = badj.reshape((-1,) + (1,) * (a32.ndim - 1))
+                return jnp.where(sel, a64.astype(a32.dtype), a32)
+
+            res = jax.tree.map(
+                splice, res, res64,
+                is_leaf=lambda x: x is None or isinstance(x, jax.Array),
+            )
+    if fallback_report is not None:
+        fallback_report["n_fallback"] = n_bad
+    return res

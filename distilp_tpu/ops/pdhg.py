@@ -97,6 +97,26 @@ def _default_tol_pdhg(dtype) -> float:
 # drops below restart_tol × the residual at the current anchor.
 DEFAULT_RESTART_TOL = 0.2
 
+# Iterate-precision knob values (the `pdhg_dtype` threading). 'f32' is the
+# default everywhere — iterates and A blocks in f32, certificate in f64 via
+# `preferred_element_type` accumulation (the mixed-precision contract) —
+# and 'f64' is the soundness fallback a non-finite or stalled f32 run
+# escalates to, the way warm-garbage already falls back to cold.
+PDHG_DTYPES = ("f32", "f64")
+
+
+def resolve_pdhg_dtype(name):
+    """'f32'/'f64' (or None = keep the batch dtype) -> jnp dtype or None."""
+    if name is None:
+        return None
+    if name == "f32":
+        return jnp.float32
+    if name == "f64":
+        return jnp.float64
+    raise ValueError(
+        f"unknown pdhg_dtype {name!r}; expected one of {PDHG_DTYPES}"
+    )
+
 
 class PDHGWarmState(NamedTuple):
     """Warm-start iterate in ORIGINAL coordinates — field-for-field the same
@@ -118,7 +138,7 @@ class PDHGWarmState(NamedTuple):
 
 def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
                  skip=None, chunk: int = PDHG_DEFAULT_CHUNK,
-                 trace: bool = False):
+                 trace: bool = False, axis_name=None):
     """Restarted Halpern PDHG on one boxed LP. Runs under vmap.
 
     Mirrors ``_ipm_single``'s contract: ``warm`` seeds from a previous
@@ -127,7 +147,32 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
     while loop whose exit is the batch-wide convergence flag, and the
     returned bound is the f64 Lagrangian bound — valid for whatever dual
     the iteration reached.
+
+    ``axis_name`` (static) is the mesh-sharded mode (ops/meshlp.py): the
+    caller hands each shard a DEVICE-ROW block of the instance — ``A``
+    ``(m_blk, n)``, ``b`` ``(m_blk,)``, warm ``y`` ``(m_blk,)``; the
+    column data ``c``/``l``/``u`` and the primal iterate replicated — and
+    names the shard_map mesh axis here. Every cross-row reduction
+    (column 1-norms, the dual's contribution to residuals/gap/certificate,
+    the feasibility max) then closes over the mesh with a ``psum``/``pmax``
+    at exactly those points; everything else — the per-row scalings, opA,
+    the dual update, the restart control — is block-local. With
+    ``axis_name=None`` every hook is the identity and the program is
+    byte-for-byte the single-device kernel (the mesh_shards=1 bit-
+    stability contract).
     """
+    if axis_name is None:
+        def _psum(v):
+            return v
+
+        _pmax = _psum
+    else:
+        def _psum(v):
+            return jax.lax.psum(v, axis_name)
+
+        def _pmax(v):
+            return jax.lax.pmax(v, axis_name)
+
     dtype = A.dtype
     n = A.shape[1]
     m = A.shape[0]
@@ -170,8 +215,11 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
     def opA(x):
         return row_s * (A @ (cs_a * x))
 
+    # A'y spans every device row: in sharded mode each block contributes
+    # its partial column sum and the psum closes it — the ONE collective a
+    # PDHG iteration pays (opA is row-local because x is replicated).
     def opAT(y):
-        return cs_a * (A.T @ (row_s * y))
+        return cs_a * _psum(A.T @ (row_s * y))
 
     # Diagonal (Pock-Chambolle) step sizes on the scaled operator Ā:
     # tau_j = θ / Σ_i |Ā_ij|, sigma_i = θ / Σ_j |Ā_ij| with θ = 0.9 — the
@@ -185,7 +233,7 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
     # other touch of A, nothing per-element materialized.
     absA = jnp.abs(A)
     row_1n = row_s * (absA @ cs_a)
-    col_1n = cs_a * (absA.T @ row_s)
+    col_1n = cs_a * _psum(absA.T @ row_s)
     # Decoupled coordinates (fixed columns; rows whose every column is
     # fixed) get step 0, not 0.9/eps: a huge pseudo-step on a zero-coupling
     # lane would just amplify roundoff (or overflow f32 on an inconsistent
@@ -199,7 +247,7 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
     x0 = 0.5 * r
     y0 = jnp.zeros(m, dtype)
 
-    b_scale = 1.0 + jnp.max(jnp.abs(b_s))
+    b_scale = 1.0 + _pmax(jnp.max(jnp.abs(b_s)))
     c_scale = 1.0 + jnp.max(jnp.abs(cm))
 
     def T(x, y):
@@ -214,8 +262,12 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
         # Σ dy²/sigma with the cross term dropped — the standard restart
         # gauge. Zero-step lanes never move (dx = dy = 0 there), so they
         # are excluded rather than divided by zero.
+        # Sharded mode: dx is replicated (x updates through the psum'd
+        # opAT), dy is block-local — only the dual half needs the psum.
         qx = jnp.sum(jnp.where(tau > 0, dx * dx, 0.0) / jnp.maximum(tau, 1e-30))
-        qy = jnp.sum(jnp.where(sigma > 0, dy * dy, 0.0) / jnp.maximum(sigma, 1e-30))
+        qy = _psum(
+            jnp.sum(jnp.where(sigma > 0, dy * dy, 0.0) / jnp.maximum(sigma, 1e-30))
+        )
         return jnp.sqrt(qx + qy)
 
     def conv_stats(x, y):
@@ -228,15 +280,17 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
         rp = b_s - opA(x)
         obj = jnp.vdot(cm, x)
         red = cm - opAT(y)
-        lag = jnp.vdot(b_s, y) + jnp.vdot(act, jnp.minimum(0.0, red))
+        # b'y spans the row shards; the reduced-cost half is columnwise
+        # and already replicated through the psum'd opAT.
+        lag = _psum(jnp.vdot(b_s, y)) + jnp.vdot(act, jnp.minimum(0.0, red))
         gap = jnp.abs(obj - lag)
-        conv = (jnp.max(jnp.abs(rp)) < tol * b_scale) & (
+        conv = (_pmax(jnp.max(jnp.abs(rp))) < tol * b_scale) & (
             gap < tol * (b_scale + c_scale + jnp.abs(obj))
         )
         rd = red - jnp.minimum(0.0, red) * act
         return (
             conv,
-            jnp.max(jnp.abs(rp)),
+            _pmax(jnp.max(jnp.abs(rp))),
             jnp.max(jnp.abs(rd)),
             gap / (b_scale + c_scale),
         )
@@ -270,8 +324,13 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
 
         # Non-finite safety: a blown-up step keeps the previous iterate
         # (the element stalls honestly; the f64 bound of a stalled dual is
-        # still valid, and a NaN dual reports -inf downstream).
-        finite = jnp.all(jnp.isfinite(x_n)) & jnp.all(jnp.isfinite(y_n))
+        # still valid, and a NaN dual reports -inf downstream). The dual
+        # half is block-local in sharded mode, and the verdict must be
+        # mesh-global — a shard keeping its x while another rolls back
+        # would fork the replicated primal.
+        finite = jnp.all(jnp.isfinite(x_n)) & (
+            _pmax(jnp.any(~jnp.isfinite(y_n)).astype(dtype)) < 0.5
+        )
         x_n = jnp.where(finite, x_n, x)
         y_n = jnp.where(finite, y_n, y)
 
@@ -294,10 +353,12 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
         # non-finite component skips straight to cold, as in the IPM. z/f
         # ride along for plumbing compatibility but carry no PDHG state.
         v_w, y_w, z_w, f_w, ok_w = warm
+        # y_w is the block-local slice in sharded mode; the gate must be
+        # mesh-global or the shards would disagree on the warm entry.
         fin = (
             ok_w
             & jnp.all(jnp.isfinite(v_w))
-            & jnp.all(jnp.isfinite(y_w))
+            & (_pmax(jnp.any(~jnp.isfinite(y_w)).astype(dtype)) < 0.5)
             & jnp.all(jnp.isfinite(z_w))
             & jnp.all(jnp.isfinite(f_w))
         )
@@ -398,7 +459,7 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
     red32 = cm - opAT(y)
     rd = red32 - jnp.minimum(0.0, red32) * act  # dual infeas. of the split
     mu = jnp.abs(jnp.vdot(cm, x) - (
-        jnp.vdot(b_s, y) + jnp.vdot(act, jnp.minimum(0.0, red32))
+        _psum(jnp.vdot(b_s, y)) + jnp.vdot(act, jnp.minimum(0.0, red32))
     )) / (b_scale + c_scale)
     # Back to the original-units dual for the certificate and the warm
     # state (see the row re-equilibration note above).
@@ -419,8 +480,14 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
     bh64 = b.astype(BOUND_DTYPE) - jnp.matmul(
         A, l, preferred_element_type=BOUND_DTYPE
     )
-    reduced = c64 - jnp.matmul(A.T, y, preferred_element_type=BOUND_DTYPE)
-    bound = bh64 @ y64 + jnp.sum(r64 * jnp.minimum(0.0, reduced))
+    # Sharded: each block contributes its rows' share of both cross-row
+    # terms (the A'y partial and b̂'y); the f64 psum keeps the certificate
+    # precision of the single-device kernel — accumulation order changes,
+    # validity does not (the bound holds for ANY dual).
+    reduced = c64 - _psum(
+        jnp.matmul(A.T, y, preferred_element_type=BOUND_DTYPE)
+    )
+    bound = _psum(bh64 @ y64) + jnp.sum(r64 * jnp.minimum(0.0, reduced))
     bound = jnp.where(jnp.isfinite(bound), bound, -jnp.inf)
     shift = c64 @ l64
     v = l + jnp.where(active, col_s * x, 0.0)
@@ -437,7 +504,7 @@ def _pdhg_single(A, b, c, l, u, iters: int, tol, restart_tol, warm=None,
         v=v,
         bound=bound + shift,
         obj=c @ v,
-        rp_norm=jnp.max(jnp.abs(rp)),
+        rp_norm=_pmax(jnp.max(jnp.abs(rp))),
         rd_norm=jnp.max(jnp.abs(rd)),
         mu=mu,
         converged=done > 0,
@@ -459,6 +526,7 @@ def pdhg_solve_batch(
     skip: Optional[jax.Array] = None,
     chunk: int = PDHG_DEFAULT_CHUNK,
     trace: bool = False,
+    dtype: Optional[str] = None,
 ) -> IPMResult:
     """Solve a batch of boxed LPs matrix-free (shared (m, n) or per-instance
     (B, m, n) A) — the call-compatible first-order sibling of
@@ -475,7 +543,16 @@ def pdhg_solve_batch(
     — residual norms, normalized gap, the cumulative Halpern restart-chunk
     count — into ``trace_buf`` (see ops/ipm.py TRACE_COLS); the untraced
     program is bit-identical to the pre-trace one.
+
+    ``dtype`` (static: 'f32'/'f64', None = the batch's own dtype) sets the
+    ITERATION precision: the instance data and iterates are cast on entry,
+    while the exit certificate stays the f64 Lagrangian bound either way —
+    a cast only moves how fast a usable dual is reached (and the exit
+    tolerance floor, see ``_default_tol_pdhg``), never bound validity.
     """
+    dt = resolve_pdhg_dtype(dtype)
+    if dt is not None and dt != batch.A.dtype:
+        batch = LPBatch(*(jnp.asarray(x).astype(dt) for x in batch))
     dtype = batch.A.dtype
     tol_v = _default_tol_pdhg(dtype) if tol is None else tol
     rt_v = DEFAULT_RESTART_TOL if restart_tol is None else restart_tol
@@ -505,6 +582,8 @@ def pdhg_solve_batch(
 # statics each mint a distinct executable, and the ledger attributes them.
 pdhg_solve_batch = instrument(
     "ops.pdhg.pdhg_solve_batch",
-    jax.jit(pdhg_solve_batch, static_argnames=("iters", "chunk", "trace")),
-    static_argnames=("iters", "chunk", "trace"),
+    jax.jit(
+        pdhg_solve_batch, static_argnames=("iters", "chunk", "trace", "dtype")
+    ),
+    static_argnames=("iters", "chunk", "trace", "dtype"),
 )

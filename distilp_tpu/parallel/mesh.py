@@ -129,7 +129,12 @@ def solve_sweep_sharded(
     # spilled node floors its k's certificate), then mesh-align: cap and
     # beam round up to a multiple of the mesh size so every device solves
     # the same number of frontier rows.
-    cap, d_beam, d_iters, d_warm_iters, _, engine = _resolve_search_params(
+    # mesh_shards/pdhg_dtype stay default here: this path already owns the
+    # device mesh along the NODE axis (GSPMD over the frontier); the row
+    # mesh of ops/meshlp.py is the orthogonal, single-instance engine.
+    (
+        cap, d_beam, d_iters, d_warm_iters, _, engine, _shards, _dt,
+    ) = _resolve_search_params(
         sf.moe, len(sf.ks), node_cap, beam, ipm_iters, max_rounds,
         per_k=per_k, ipm_warm_iters=ipm_warm_iters,
         lp_backend=lp_backend, pdhg_iters=pdhg_iters, M=M,

@@ -42,7 +42,8 @@ def measure_interconnect(
     devices: Optional[List] = None,
     slice_of=None,
 ) -> InterconnectInfo:
-    """Time collectives over all local devices (shard_map psum/all_gather).
+    """Time collectives over all local devices (shard_map psum/all_gather,
+    via utils.shardcompat — works on jax 0.4.37's experimental spelling).
 
     When the device set spans more than one slice (multi-slice TPU pods:
     ICI inside a slice, DCN between slices), a second pair of collectives
@@ -99,7 +100,7 @@ def _collective_times(devs: List, latency_iters: int, bandwidth_mb: int):
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    shard_map = jax.shard_map
+    from ..utils.shardcompat import shard_map
     n = len(devs)
     mesh = Mesh(np.array(devs), ("d",))
     latency = bandwidth = 0.0

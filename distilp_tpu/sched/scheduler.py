@@ -301,6 +301,8 @@ class Scheduler:
         lp_backend: str = "auto",
         pdhg_iters: Optional[int] = None,
         pdhg_restart_tol: Optional[float] = None,
+        mesh_shards: Optional[int] = None,
+        pdhg_dtype: Optional[str] = None,
         risk_aware: bool = False,
         risk_samples: int = 256,
         risk_seed: int = 0,
@@ -341,6 +343,12 @@ class Scheduler:
         self.lp_backend = lp_backend
         self.pdhg_iters = pdhg_iters
         self.pdhg_restart_tol = pdhg_restart_tol
+        # Row-mesh + iterate-precision knobs (`serve --mesh-shards
+        # --pdhg-dtype`): inherited by every minted replanner's tick
+        # solves; speculation and the per-k risk enumeration keep their
+        # vmap composition (mesh_shards is a per-dispatch knob there).
+        self.mesh_shards = mesh_shards
+        self.pdhg_dtype = pdhg_dtype
         # Solver-interior diagnostics (`serve --solver-diagnostics`): every
         # tick solves with convergence tracing on; the conv_* digest rides
         # the timings dict onto the sched.solve span and the flight
@@ -498,6 +506,10 @@ class Scheduler:
             search["pdhg_iters"] = self.pdhg_iters
         if self.pdhg_restart_tol is not None:
             search["pdhg_restart_tol"] = self.pdhg_restart_tol
+        if self.mesh_shards is not None:
+            search["mesh_shards"] = self.mesh_shards
+        if self.pdhg_dtype is not None:
+            search["pdhg_dtype"] = self.pdhg_dtype
         planner = StreamingReplanner(
             mip_gap=self.mip_gap,
             kv_bits=self.kv_bits,
@@ -1313,6 +1325,7 @@ class Scheduler:
                     lp_backend=self.lp_backend,
                     pdhg_iters=self.pdhg_iters,
                     pdhg_restart_tol=self.pdhg_restart_tol,
+                    pdhg_dtype=self.pdhg_dtype,
                 )
             except (RuntimeError, ValueError, NotImplementedError) as e:
                 self.metrics.inc("spec_presolve_failed")
@@ -1851,6 +1864,8 @@ class Scheduler:
                     lp_backend=self.lp_backend,
                     pdhg_iters=self.pdhg_iters,
                     pdhg_restart_tol=self.pdhg_restart_tol,
+                    mesh_shards=self.mesh_shards,
+                    pdhg_dtype=self.pdhg_dtype,
                 )
                 self._risk_per_k_key = key
             except (RuntimeError, ValueError, NotImplementedError):

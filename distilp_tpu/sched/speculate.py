@@ -338,6 +338,7 @@ def presolve_candidates(
     lp_backend: str = "auto",
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    pdhg_dtype: Optional[str] = None,
 ) -> List[HALDAResult]:
     """Solve the forecast candidates as ONE vmapped scenario dispatch.
 
@@ -365,4 +366,5 @@ def presolve_candidates(
         lp_backend=lp_backend,
         pdhg_iters=pdhg_iters,
         pdhg_restart_tol=pdhg_restart_tol,
+        pdhg_dtype=pdhg_dtype,
     )

@@ -133,6 +133,8 @@ def halda_solve(
     lp_backend: str = "auto",
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    mesh_shards: Optional[int] = None,
+    pdhg_dtype: Optional[str] = None,
     convergence: Optional[dict] = None,
 ) -> HALDAResult:
     """Pick the best (k, w, n[, y]) placement over all candidate segment counts.
@@ -181,6 +183,17 @@ def halda_solve(
     - ``pdhg_iters`` / ``pdhg_restart_tol``: first-order budget per LP
       relaxation and the Halpern restart's sufficient-decay factor
       (pdhg engine only; see ``ops/pdhg.py``).
+    - ``mesh_shards``: row-partition every PDHG relaxation across this
+      many devices (``ops/meshlp.py``; pdhg engine only, default 1 = no
+      mesh). On a CPU host the mesh needs
+      ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` set before
+      the first jax import (``utils.shardcompat``).
+    - ``pdhg_dtype``: first-order iterate precision, ``'f32'``/``'f64'``
+      (pdhg engine only; None keeps the search default). The mip-gap
+      certificate is evaluated in f64 REGARDLESS — a lower iterate
+      precision can only loosen bounds or miss certification, never
+      corrupt it, and an uncertified f32 solve escalates to f64 on the
+      same ladder that escalates budgets.
 
     ``timings``: pass a dict to receive the JAX backend's wall-clock
     breakdown (build/pack/upload/solve+fetch milliseconds, see
@@ -257,6 +270,8 @@ def halda_solve(
             lp_backend=lp_backend,
             pdhg_iters=pdhg_iters,
             pdhg_restart_tol=pdhg_restart_tol,
+            mesh_shards=mesh_shards,
+            pdhg_dtype=pdhg_dtype,
             convergence=convergence,
         )
         # In-solver certification escalation (the ladder one-shot callers
@@ -305,8 +320,17 @@ def halda_solve(
             # warm rounds derive as a quarter of it (ipm_warm_iters is an
             # IPM knob the pdhg path ignores), i.e. each escalated warm
             # round runs the ORIGINAL full cold budget.
+            # The precision rung rides the same ladder: an uncertified f32
+            # run retries in f64 — reduced-precision iterates can stall
+            # short of the tolerance on hard instances, and the escalated
+            # attempt should remove BOTH suspects (budget and precision)
+            # before an honest uncertified return.
             esc_kw = (
-                {"pdhg_iters": 4 * default_pdhg_iters(len(devs))}
+                {
+                    "pdhg_iters": 4 * default_pdhg_iters(len(devs)),
+                    "pdhg_dtype": "f64" if pdhg_dtype == "f32" else pdhg_dtype,
+                    "mesh_shards": mesh_shards,
+                }
                 if engine == "pdhg"
                 else {"ipm_iters": IPM_ITERS, "ipm_warm_iters": IPM_ITERS}
             )
@@ -407,6 +431,8 @@ def halda_solve_async(
     lp_backend: str = "auto",
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    mesh_shards: Optional[int] = None,
+    pdhg_dtype: Optional[str] = None,
     convergence: Optional[dict] = None,
 ) -> PendingHalda:
     """Dispatch a HALDA solve and return without waiting for the result.
@@ -449,6 +475,8 @@ def halda_solve_async(
         lp_backend=lp_backend,
         pdhg_iters=pdhg_iters,
         pdhg_restart_tol=pdhg_restart_tol,
+        mesh_shards=mesh_shards,
+        pdhg_dtype=pdhg_dtype,
         convergence=convergence,
     )
     if not isinstance(pending, PendingSweep):
@@ -473,7 +501,8 @@ def _scenarios_via_batchlayout(
     lp_backend,
     pdhg_iters,
     pdhg_restart_tol,
-    timings,
+    pdhg_dtype=None,
+    timings=None,
 ):
     """Row-scale-crossing fallback for ``halda_solve_scenarios``: one
     packed instance per scenario (each carries its own static half), one
@@ -491,6 +520,7 @@ def _scenarios_via_batchlayout(
                 max_rounds=max_rounds, beam=beam, node_cap=node_cap,
                 ipm_warm_iters=ipm_warm_iters, lp_backend=lp_backend,
                 pdhg_iters=pdhg_iters, pdhg_restart_tol=pdhg_restart_tol,
+                pdhg_dtype=pdhg_dtype,
             )
             for i, (_, _, coeffs, arrays) in enumerate(built)
         ]
@@ -537,6 +567,7 @@ def halda_solve_scenarios(
     lp_backend: str = "auto",
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    pdhg_dtype: Optional[str] = None,
 ) -> List[HALDAResult]:
     """Solve S what-if variants of one fleet in a single device dispatch.
 
@@ -609,6 +640,7 @@ def halda_solve_scenarios(
             lp_backend=lp_backend,
             pdhg_iters=pdhg_iters,
             pdhg_restart_tol=pdhg_restart_tol,
+            pdhg_dtype=pdhg_dtype,
         )
     except ValueError:
         # Static halves diverged — an excursion crossed a row-scale
@@ -624,7 +656,8 @@ def halda_solve_scenarios(
             max_rounds=max_rounds, beam=beam, ipm_iters=ipm_iters,
             ipm_warm_iters=ipm_warm_iters, node_cap=node_cap,
             lp_backend=lp_backend, pdhg_iters=pdhg_iters,
-            pdhg_restart_tol=pdhg_restart_tol, timings=timings,
+            pdhg_restart_tol=pdhg_restart_tol, pdhg_dtype=pdhg_dtype,
+            timings=timings,
         )
 
     results: List[HALDAResult] = []
@@ -657,6 +690,8 @@ def halda_solve_per_k(
     lp_backend: str = "auto",
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    mesh_shards: Optional[int] = None,
+    pdhg_dtype: Optional[str] = None,
 ) -> List[HALDAResult]:
     """Certified optimum for EVERY feasible k.
 
@@ -731,6 +766,8 @@ def halda_solve_per_k(
         lp_backend=lp_backend,
         pdhg_iters=pdhg_iters,
         pdhg_restart_tol=pdhg_restart_tol,
+        mesh_shards=mesh_shards,
+        pdhg_dtype=pdhg_dtype,
     )
     out = [
         _best_to_result(res, sets)

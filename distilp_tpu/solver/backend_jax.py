@@ -91,10 +91,13 @@ from ..ops.ipm import (  # noqa: E402
     ipm_solve_batch,
     n_trace_rows,
 )
+from ..ops.meshlp import sharded_pdhg  # noqa: E402
 from ..ops.pdhg import (  # noqa: E402
     DEFAULT_RESTART_TOL,
     PDHG_DEFAULT_CHUNK,
+    _default_tol_pdhg,
     pdhg_solve_batch,
+    resolve_pdhg_dtype,
 )
 from .assemble import INACTIVE_RHS, MilpArrays, VarLayout  # noqa: E402
 from .coeffs import HaldaCoeffs  # noqa: E402
@@ -249,10 +252,20 @@ def _resolve_search_params(
     lp_backend: Optional[str] = None,
     pdhg_iters: Optional[int] = None,
     M: int = 0,
-) -> Tuple[int, int, int, int, int, str]:
-    """(cap, beam, lp_iters, lp_warm_iters, max_rounds, lp_backend): caller
-    overrides applied over the problem-class defaults — the one resolution
-    rule for every solve path (single-dispatch, async, scenario-batched).
+    mesh_shards: Optional[int] = None,
+    pdhg_dtype: Optional[str] = None,
+) -> Tuple[int, int, int, int, int, str, int, Optional[str]]:
+    """(cap, beam, lp_iters, lp_warm_iters, max_rounds, lp_backend,
+    mesh_shards, pdhg_dtype): caller overrides applied over the
+    problem-class defaults — the one resolution rule for every solve path
+    (single-dispatch, async, scenario-batched).
+
+    ``mesh_shards`` (None = 1) row-partitions each PDHG relaxation across
+    a device mesh (ops/meshlp.py) and ``pdhg_dtype`` sets the first-order
+    iterate precision ('f32'/'f64'; None keeps the search dtype — the f64
+    certificate is unconditional either way). Both are pdhg-engine knobs:
+    a resolution that lands on the IPM with either set is a caller error,
+    raised here rather than silently ignored downstream.
 
     ``lp_backend`` (None = 'auto') selects the LP relaxation engine; the
     returned element is the CONCRETE engine ('ipm' or 'pdhg' — 'auto'
@@ -300,6 +313,22 @@ def _resolve_search_params(
             ipm_warm_iters if ipm_warm_iters is not None else max(6, it // 2)
         )
         warm_it = min(warm_it, it) if ipm_warm_iters is None else warm_it
+    shards = 1 if mesh_shards is None else int(mesh_shards)
+    if shards < 1:
+        raise ValueError(f"mesh_shards must be >= 1 (got {mesh_shards})")
+    resolve_pdhg_dtype(pdhg_dtype)  # validate the spelling early
+    if engine != "pdhg":
+        if shards > 1:
+            raise ValueError(
+                f"mesh_shards={shards} requires the matrix-free pdhg "
+                f"engine, but lp_backend resolved to {engine!r} (pass "
+                f"lp_backend='pdhg', or 'auto' at fleet scale)"
+            )
+        if pdhg_dtype is not None:
+            raise ValueError(
+                f"pdhg_dtype={pdhg_dtype!r} is a pdhg-engine knob, but "
+                f"lp_backend resolved to {engine!r}"
+            )
     return (
         max(node_cap, n_k) if node_cap is not None else d_cap,
         beam if beam is not None else d_beam,
@@ -307,6 +336,8 @@ def _resolve_search_params(
         warm_it,
         max_rounds if max_rounds is not None else MAX_ROUNDS,
         engine,
+        shards,
+        pdhg_dtype,
     )
 
 
@@ -1380,6 +1411,26 @@ def _init_state(sf: StandardForm, cap: Optional[int] = None) -> SearchState:
     )
 
 
+def _cast_lp_result(res, tgt):
+    """Cast an LP result's iteration-dtype leaves back to the search dtype
+    so a pdhg_dtype-escalated (f64) solve re-enters the f32 carry without
+    changing any loop-carry signature. ``bound`` is ALREADY the f64
+    certificate and ``converged`` is boolean — both pass through; every
+    other leaf is iteration dtype by the IPMResult contract."""
+    if res.v.dtype == tgt:
+        return res
+    cast = {
+        f: getattr(res, f).astype(tgt)
+        for f in (
+            "v", "obj", "rp_norm", "rd_norm", "mu", "reduced",
+            "y_dual", "z_dual", "f_dual", "iters_run",
+        )
+    }
+    if res.trace_buf is not None:
+        cast["trace_buf"] = res.trace_buf.astype(tgt)
+    return res._replace(**cast)
+
+
 def _bnb_round(
     data: SweepData,
     state: SearchState,
@@ -1392,6 +1443,8 @@ def _bnb_round(
     ipm_chunk: Optional[int] = None,
     lp_backend: str = "ipm",
     pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    mesh_shards: int = 1,
+    pdhg_dtype: Optional[str] = None,
     lp_trace: bool = False,
 ):
     """One batched branch-and-bound round over the frontier (pure function;
@@ -1472,14 +1525,37 @@ def _bnb_round(
         # element converges is unknown even cold, so the kernel-default
         # chunking (batch-wide early exit every few dozen matvecs) is
         # always the right granularity.
-        res = pdhg_solve_batch(
-            lp_batch,
-            iters=ipm_iters,
-            restart_tol=pdhg_restart_tol,
-            warm=warm,
-            skip=~active_p,
-            trace=lp_trace,
-        )
+        if mesh_shards > 1:
+            # Row-partitioned engine (ops/meshlp.py): same warm-state and
+            # result contract, the mesh is built at trace time (mesh_shards
+            # is static here). The iterate dtype follows pdhg_dtype; the
+            # result's iteration-dtype leaves are cast back at this
+            # boundary so the loop carry never changes signature.
+            dt = resolve_pdhg_dtype(pdhg_dtype)
+            mesh_batch = lp_batch
+            if dt is not None and dt != lp_batch.A.dtype:
+                mesh_batch = LPBatch(*(x.astype(dt) for x in lp_batch))
+            res = sharded_pdhg(
+                mesh_batch,
+                mesh_shards,
+                ipm_iters,
+                _default_tol_pdhg(mesh_batch.A.dtype),
+                pdhg_restart_tol,
+                warm=warm,
+                skip=~active_p,
+                trace=lp_trace,
+            )
+        else:
+            res = pdhg_solve_batch(
+                lp_batch,
+                iters=ipm_iters,
+                restart_tol=pdhg_restart_tol,
+                warm=warm,
+                skip=~active_p,
+                trace=lp_trace,
+                dtype=pdhg_dtype,
+            )
+        res = _cast_lp_result(res, lp_batch.A.dtype)
     else:
         chunk_kw = {} if ipm_chunk is None else {"chunk": ipm_chunk}
         res = ipm_solve_batch(
@@ -2017,7 +2093,7 @@ _PACKED_STATIC_ARGS = (
     "M", "n_k", "m", "nf", "cap", "ipm_iters", "max_rounds", "beam", "moe",
     "has_warm", "w_max", "e_max", "decomp_steps", "has_duals", "per_k",
     "has_margin", "ipm_warm_iters", "has_root_warm", "lp_backend",
-    "pdhg_restart_tol", "diag",
+    "pdhg_restart_tol", "mesh_shards", "pdhg_dtype", "diag",
 )
 
 
@@ -2044,6 +2120,8 @@ def _solve_packed_impl(
     has_root_warm: bool = False,
     lp_backend: str = "ipm",
     pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    mesh_shards: int = 1,
+    pdhg_dtype: Optional[str] = None,
     diag: bool = False,
 ) -> jax.Array:
     """One-dispatch sweep: unpack the two blobs (``_pack_static`` stays
@@ -2303,6 +2381,8 @@ def _solve_packed_impl(
         root_warm_chunk=has_root_warm,
         lp_backend=lp_backend,
         pdhg_restart_tol=pdhg_restart_tol,
+        mesh_shards=mesh_shards,
+        pdhg_dtype=pdhg_dtype,
         collect_rounds=diag,
     )
     if diag:
@@ -2517,8 +2597,14 @@ def _solve_scenarios_packed(
     has_root_warm: bool = False,
     lp_backend: str = "ipm",
     pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    mesh_shards: int = 1,
+    pdhg_dtype: Optional[str] = None,
     diag: bool = False,
 ) -> jax.Array:
+    # mesh_shards is accepted for static-surface symmetry but clamped:
+    # the scenario axis already composes by vmap, and vmap-of-shard_map
+    # does not lower on the jax this image ships. pdhg_dtype composes
+    # fine and threads for real.
     return jax.vmap(
         lambda dyn: _solve_packed_impl(
             static_blob, dyn, M=M, n_k=n_k, m=m, nf=nf, cap=cap,
@@ -2527,7 +2613,8 @@ def _solve_scenarios_packed(
             decomp_steps=decomp_steps, has_duals=has_duals, per_k=per_k,
             has_margin=has_margin, ipm_warm_iters=ipm_warm_iters,
             has_root_warm=has_root_warm, lp_backend=lp_backend,
-            pdhg_restart_tol=pdhg_restart_tol, diag=diag,
+            pdhg_restart_tol=pdhg_restart_tol, mesh_shards=1,
+            pdhg_dtype=pdhg_dtype, diag=diag,
         )
     )(dyn_blobs)
 
@@ -2566,6 +2653,8 @@ def _solve_batched(
     has_root_warm: bool = False,
     lp_backend: str = "ipm",
     pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    mesh_shards: int = 1,
+    pdhg_dtype: Optional[str] = None,
     diag: bool = False,
 ) -> jax.Array:
     """Cross-instance batch: N heterogeneous HALDA instances, ONE dispatch.
@@ -2580,6 +2669,8 @@ def _solve_batched(
     (``solver.batchlayout``): every lane is a complete, exactly-priced MILP,
     so per-lane certificates decode independently.
     """
+    # Same mesh_shards clamp as _solve_scenarios_packed: the lane axis is
+    # the vmap, so the row mesh cannot nest under it on this jax.
     return jax.vmap(
         lambda stat, dyn: _solve_packed_impl(
             stat, dyn, M=M, n_k=n_k, m=m, nf=nf, cap=cap,
@@ -2588,7 +2679,8 @@ def _solve_batched(
             decomp_steps=decomp_steps, has_duals=has_duals, per_k=per_k,
             has_margin=has_margin, ipm_warm_iters=ipm_warm_iters,
             has_root_warm=has_root_warm, lp_backend=lp_backend,
-            pdhg_restart_tol=pdhg_restart_tol, diag=diag,
+            pdhg_restart_tol=pdhg_restart_tol, mesh_shards=1,
+            pdhg_dtype=pdhg_dtype, diag=diag,
         )
     )(static_blobs, dyn_blobs)
 
@@ -2652,6 +2744,8 @@ def _run_bnb_loop(
     root_beam: Optional[int] = None,
     lp_backend: str = "ipm",
     pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    mesh_shards: int = 1,
+    pdhg_dtype: Optional[str] = None,
     collect_rounds: bool = False,
 ):
     """B&B rounds with the mip-gap test on-device. The single shared
@@ -2729,6 +2823,7 @@ def _run_bnb_loop(
             moe=moe, per_k=per_k, return_res=True,
             ipm_chunk=None if root_warm_chunk else ipm_iters,
             lp_backend=lp_backend, pdhg_restart_tol=pdhg_restart_tol,
+            mesh_shards=mesh_shards, pdhg_dtype=pdhg_dtype,
             lp_trace=lp_trace,
         )
         return st2, (
@@ -2788,6 +2883,7 @@ def _run_bnb_loop(
                 data, state, mip_gap, ipm_iters=warm_iters, beam=beam,
                 moe=moe, per_k=per_k,
                 lp_backend=lp_backend, pdhg_restart_tol=pdhg_restart_tol,
+                mesh_shards=mesh_shards, pdhg_dtype=pdhg_dtype,
             )
             rlog = rlog.at[i].set(_round_row(state, st2, Bw))
             return (st2, i + 1, rlog)
@@ -2809,6 +2905,7 @@ def _run_bnb_loop(
                     data, state, mip_gap, ipm_iters=warm_iters, beam=beam,
                     moe=moe, per_k=per_k,
                     lp_backend=lp_backend, pdhg_restart_tol=pdhg_restart_tol,
+                    mesh_shards=mesh_shards, pdhg_dtype=pdhg_dtype,
                 ),
                 i + 1,
             )
@@ -2828,7 +2925,8 @@ def _run_bnb_loop(
 
 _FUSED_STATIC_ARGS = (
     "ipm_iters", "max_rounds", "beam", "moe", "per_k", "ipm_warm_iters",
-    "root_beam", "lp_backend", "pdhg_restart_tol",
+    "root_beam", "lp_backend", "pdhg_restart_tol", "mesh_shards",
+    "pdhg_dtype",
 )
 
 
@@ -2845,6 +2943,8 @@ def _solve_fused(
     root_beam: Optional[int] = None,
     lp_backend: str = "ipm",
     pdhg_restart_tol: float = DEFAULT_RESTART_TOL,
+    mesh_shards: int = 1,
+    pdhg_dtype: Optional[str] = None,
 ) -> SearchState:
     """The full branch-and-bound sweep as one device program; the host does
     one dispatch and one fetch per HALDA solve."""
@@ -2861,6 +2961,8 @@ def _solve_fused(
         root_beam=root_beam,
         lp_backend=lp_backend,
         pdhg_restart_tol=pdhg_restart_tol,
+        mesh_shards=mesh_shards,
+        pdhg_dtype=pdhg_dtype,
     )
 
 
@@ -2971,6 +3073,8 @@ def solve_sweep_jax(
     lp_backend: Optional[str] = None,
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    mesh_shards: Optional[int] = None,
+    pdhg_dtype: Optional[str] = None,
     convergence: Optional[dict] = None,
 ):
     """Solve the whole k-sweep on the accelerator.
@@ -3052,16 +3156,19 @@ def solve_sweep_jax(
     n_k = len(sf.ks)
     (
         cap, beam, ipm_iters, ipm_warm_iters, max_rounds, engine,
+        mesh_shards, pdhg_dtype,
     ) = _resolve_search_params(
         sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds,
         per_k=per_k_optima, ipm_warm_iters=ipm_warm_iters,
         lp_backend=lp_backend, pdhg_iters=pdhg_iters, M=M,
+        mesh_shards=mesh_shards, pdhg_dtype=pdhg_dtype,
     )
     restart_tol = (
         DEFAULT_RESTART_TOL if pdhg_restart_tol is None else pdhg_restart_tol
     )
     if timings is not None:
         timings["lp_backend"] = engine
+        timings["mesh_shards"] = mesh_shards
     diag = convergence is not None
     if diag:
         # One solve, one report: an escalated retry re-fills from scratch.
@@ -3157,6 +3264,8 @@ def solve_sweep_jax(
         has_root_warm=root_warm_tuple is not None,
         lp_backend=engine,
         pdhg_restart_tol=restart_tol,
+        mesh_shards=mesh_shards,
+        pdhg_dtype=pdhg_dtype,
         diag=diag,
     )
     n_rows_root = (
@@ -3669,6 +3778,8 @@ def solve_sweep_scenarios(
     lp_backend: Optional[str] = None,
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    mesh_shards: Optional[int] = None,
+    pdhg_dtype: Optional[str] = None,
 ) -> List[Tuple[List[Optional[ILPResult]], Optional[ILPResult]]]:
     """Solve S what-if scenarios of ONE fleet in a single device dispatch.
 
@@ -3726,10 +3837,12 @@ def solve_sweep_scenarios(
     n_k = len(sf.ks)
     (
         cap, beam, ipm_iters, ipm_warm_iters, max_rounds, engine,
+        _shards, pdhg_dtype,
     ) = _resolve_search_params(
         sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds,
         ipm_warm_iters=ipm_warm_iters,
         lp_backend=lp_backend, pdhg_iters=pdhg_iters, M=M,
+        mesh_shards=mesh_shards, pdhg_dtype=pdhg_dtype,
     )
     restart_tol = (
         DEFAULT_RESTART_TOL if pdhg_restart_tol is None else pdhg_restart_tol
@@ -3807,6 +3920,7 @@ def solve_sweep_scenarios(
         has_root_warm=use_root_warm,
         lp_backend=engine,
         pdhg_restart_tol=restart_tol,
+        pdhg_dtype=pdhg_dtype,
     )
     out_np = np.asarray(jax.device_get(out_dev))
     t3 = _time.perf_counter()

@@ -258,6 +258,7 @@ def pack_instance(
     lp_backend: Optional[str] = None,
     pdhg_iters: Optional[int] = None,
     pdhg_restart_tol: Optional[float] = None,
+    pdhg_dtype: Optional[str] = None,
     margin_state: Optional[dict] = None,
     per_k_optima: bool = False,
     stats: Optional[dict] = None,
@@ -300,12 +301,16 @@ def pack_instance(
 
     sf = build_standard_form(arrays, coeffs, feasible)
     n_k = len(sf.ks)
+    # Batched lanes compose by vmap, so mesh_shards stays 1 in the packed
+    # statics (see _solve_batched); pdhg_dtype threads for real.
     (
         cap, beam, ipm_iters, ipm_warm_iters, max_rounds, engine,
+        _shards, pdhg_dtype,
     ) = _resolve_search_params(
         sf.moe, n_k, node_cap, beam, ipm_iters, max_rounds,
         per_k=per_k_optima, ipm_warm_iters=ipm_warm_iters,
         lp_backend=lp_backend, pdhg_iters=pdhg_iters, M=M_pad,
+        pdhg_dtype=pdhg_dtype,
     )
     restart_tol = (
         DEFAULT_RESTART_TOL if pdhg_restart_tol is None else pdhg_restart_tol
@@ -364,6 +369,8 @@ def pack_instance(
         has_root_warm=root_warm_tuple is not None,
         lp_backend=engine,
         pdhg_restart_tol=restart_tol,
+        mesh_shards=1,
+        pdhg_dtype=pdhg_dtype,
         diag=False,
     )
     return PackedInstance(

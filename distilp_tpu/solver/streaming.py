@@ -132,7 +132,8 @@ class StreamingReplanner:
     # because both engines share the iterate contract.
     _SEARCH_KEYS = (
         "max_rounds", "beam", "ipm_iters", "ipm_warm_iters", "node_cap",
-        "lp_backend", "pdhg_iters", "pdhg_restart_tol",
+        "lp_backend", "pdhg_iters", "pdhg_restart_tol", "mesh_shards",
+        "pdhg_dtype",
     )
 
     def __init__(
@@ -530,12 +531,15 @@ class StreamingReplanner:
         Ks, sets, coeffs, arrays = _build_instance(
             devs, model, k_candidates, self.kv_bits, False, None, 1
         )
+        # mesh_shards deliberately absent: combine lanes compose by vmap
+        # (see backend_jax._solve_batched), so a replanner's row-mesh knob
+        # applies to its own-dispatch ticks, not to batched prep.
         knobs = {
             key: self.search.get(key)
             for key in (
                 "ipm_iters", "max_rounds", "beam", "node_cap",
                 "ipm_warm_iters", "lp_backend", "pdhg_iters",
-                "pdhg_restart_tol",
+                "pdhg_restart_tol", "pdhg_dtype",
             )
         }
         inst = pack_instance(

@@ -1,0 +1,152 @@
+"""Capability shim for ``shard_map`` across the jax versions this repo meets.
+
+This image ships jax 0.4.37, where ``jax.shard_map`` does not exist — the
+module-level ``__getattr__`` raises AttributeError; the API was promoted
+out of ``jax.experimental.shard_map`` only in later releases — and the
+experimental signature spells the replication-check knob ``check_rep``
+where the promoted API spells it ``check_vma``. Every mesh-partitioned
+program in this repo (the sharded PDHG engine in ``ops/meshlp.py``, the
+profiler's interconnect collectives in ``profiler/topology.py``) resolves
+``shard_map`` through this module instead of touching either spelling
+directly, so the call sites read like current jax and keep working
+unchanged when the environment upgrades.
+
+Also centralized here: the small mesh bookkeeping every caller repeats —
+a 1-D mesh over the first N local devices, replicated/sharded
+``NamedSharding`` helpers, and the CPU-mesh recipe for tests and bench
+runs (``--xla_force_host_platform_device_count``, which must land in
+``XLA_FLAGS`` *before* the backend initializes — see ``host_device_hint``).
+
+Import cost: jax is imported lazily inside each function, so backend-free
+layers (the CLI's argument parsing, dlint) can import this module without
+initializing a backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = [
+    "have_shard_map",
+    "shard_map",
+    "shard_mesh",
+    "named_sharding",
+    "partition_spec",
+    "host_device_hint",
+    "force_host_devices",
+]
+
+# The XLA flag that splits one host backend into N virtual devices — the
+# only way to exercise a real multi-device mesh on a CPU-only box. It is
+# consumed at backend initialization, so it must be in the environment
+# before the first jax device query (conftest.py sets it for the suite;
+# the CLI sets it in main() before any backend import when --mesh-shards
+# asks for more devices than one).
+HOST_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def have_shard_map() -> bool:
+    """True when SOME spelling of ``shard_map`` is importable — the
+    capability the profiler's collective microbenchmarks (and their
+    tests) actually need, as opposed to the ``jax.shard_map`` attribute
+    check that pinned them to jax versions this image does not have."""
+    try:
+        _resolve()
+        return True
+    except Exception:
+        return False
+
+
+def _resolve():
+    """The raw shard_map callable from whichever namespace has it."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map as fn  # type: ignore
+
+    return fn
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: Optional[bool] = None):
+    """``jax.shard_map`` with the current-jax signature, on any jax.
+
+    ``check_vma`` (the promoted API's name; the experimental API calls it
+    ``check_rep``) disables the output-replication proof — shard bodies
+    whose replicated outputs come from psum'd values that the checker
+    cannot prove replicated (e.g. an all-gather feeding a replicated
+    out_spec) pass ``check_vma=False`` exactly as they would on a current
+    jax, and the shim maps the kwarg to whatever this jax spells it.
+    """
+    import inspect
+
+    fn = _resolve()
+    kwargs = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    if check_vma is not None:
+        params = inspect.signature(fn).parameters
+        if "check_vma" in params:
+            kwargs["check_vma"] = check_vma
+        elif "check_rep" in params:
+            kwargs["check_rep"] = check_vma
+        # Neither spelling: the jax at hand dropped the knob; the call is
+        # still correct, just unchecked/checked per its default.
+    return fn(f, **kwargs)
+
+
+def shard_mesh(n_shards: int, axis: str = "rows"):
+    """1-D mesh over the first ``n_shards`` local devices.
+
+    Raises with the CPU-mesh recipe when the backend has fewer devices —
+    the one operational mistake everyone makes once (the flag must be set
+    before the backend initializes, so a running process cannot fix it).
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_shards > len(devices):
+        raise ValueError(
+            f"mesh_shards={n_shards} but only {len(devices)} device(s) "
+            f"visible; on a CPU host export "
+            f"XLA_FLAGS='{HOST_COUNT_FLAG}={n_shards}' (or more) BEFORE "
+            f"the first jax import — see {__name__}.force_host_devices"
+        )
+    return Mesh(np.array(devices[:n_shards]), (axis,))
+
+
+def named_sharding(mesh, *axes):
+    """``NamedSharding(mesh, P(*axes))`` — the one-liner every placement
+    site repeats."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(*axes))
+
+
+def partition_spec(*axes):
+    from jax.sharding import PartitionSpec as P
+
+    return P(*axes)
+
+
+def host_device_hint(n: int) -> str:
+    """The XLA_FLAGS value that makes ``n`` virtual host devices."""
+    return f"{HOST_COUNT_FLAG}={n}"
+
+
+def force_host_devices(n: int) -> bool:
+    """Best-effort: append the host-device-count flag to ``XLA_FLAGS`` if
+    no such flag is present yet. Returns True when the environment was
+    changed. MUST run before the first backend touch to have any effect —
+    callers that cannot guarantee that (a library user mid-process)
+    should treat False-with-too-few-devices as a hard config error, which
+    is what ``shard_mesh`` raises.
+    """
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if HOST_COUNT_FLAG in flags:
+        return False
+    os.environ["XLA_FLAGS"] = (flags + " " + host_device_hint(n)).strip()
+    return True

@@ -46,24 +46,25 @@ def jax_shard_map_available() -> bool:
     """Capability detect for the profiler's collective microbenchmarks.
 
     ``profiler.topology`` times its interconnect collectives through
-    ``jax.shard_map``; this image ships jax 0.4.37, where that symbol does
-    not exist in the ``jax`` namespace (the module-level ``__getattr__``
-    raises AttributeError — the API was promoted out of
-    ``jax.experimental`` only in later releases). Tests that need the
-    collectives skip on this CAPABILITY check, not a version pin, so the
-    skip lifts the moment the environment is fixed and a real regression
-    in a capable environment still fails loudly.
+    ``utils.shardcompat.shard_map``, which resolves ``jax.shard_map`` on
+    new releases and ``jax.experimental.shard_map.shard_map`` on this
+    image's jax 0.4.37 (mapping the ``check_vma`` knob to the old
+    ``check_rep`` spelling). Tests that need the collectives skip on this
+    CAPABILITY check, not a version pin, so a jax with neither spelling
+    still skips cleanly while a real regression in a capable environment
+    fails loudly.
     """
-    import jax
+    from distilp_tpu.utils.shardcompat import have_shard_map
 
-    return hasattr(jax, "shard_map")
+    return have_shard_map()
 
 
 SHARD_MAP_SKIP_REASON = (
-    "env defect: this image's jax (0.4.37) has no `jax.shard_map` "
-    "(promoted to the jax namespace only in later releases), so the "
-    "profiler's interconnect collectives (profiler/topology.py) cannot "
-    "run here; capability-detected skip, lifts on a fixed environment"
+    "env defect: this jax has neither `jax.shard_map` nor "
+    "`jax.experimental.shard_map.shard_map` (see utils/shardcompat.py), "
+    "so the profiler's interconnect collectives (profiler/topology.py) "
+    "cannot run here; capability-detected skip, lifts on a fixed "
+    "environment"
 )
 
 

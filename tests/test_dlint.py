@@ -36,7 +36,7 @@ def test_registry_has_all_rule_codes():
     expected = {
         "DLP001", "DLP002", "DLP010", "DLP011",
         "DLP012", "DLP013", "DLP014", "DLP015", "DLP016", "DLP017",
-        "DLP018", "DLP019", "DLP020",
+        "DLP018", "DLP019", "DLP020", "DLP021",
     }
     assert expected <= set(RULES)
     for code, rule in RULES.items():
@@ -1671,6 +1671,132 @@ def test_dlp020_out_of_scope_and_tests_exempt():
         """
     assert findings_for("DLP020", "distilp_tpu/profiler/device2.py", snippet) == []
     assert findings_for("DLP020", "tests/test_something.py", snippet) == []
+
+
+# --------------------------------------------------------------------------
+# DLP021 — hazards inside shard_map mesh bodies (host syncs + dense-A)
+
+
+def test_host_sync_in_mesh_body_flagged():
+    """DLP011's full call set re-fires as DLP021 inside a shard_map body
+    — a gap DLP011 itself does not cover (shard_map is not in its
+    consumer set), and in SPMD code the sync stalls every shard."""
+    out = findings_for("DLP021", "distilp_tpu/ops/newmesh.py", """\
+        import numpy as np
+        import jax.numpy as jnp
+        from ..utils import shardcompat
+
+        def run(batch, mesh):
+            def body(A_blk, b_blk):
+                g = float(jnp.max(b_blk))
+                k = int(b_blk.shape[0] * g)
+                s = b_blk.sum().item()
+                h = np.asarray(b_blk)
+                return A_blk * (g + k + s) + h
+
+            return shardcompat.shard_map(
+                body, mesh, in_specs=None, out_specs=None
+            )(batch.A, batch.b)
+        """)
+    assert len(out) == 4
+    assert all("stalls every shard" in f.message for f in out)
+    # ...and plain DLP011 stays silent here: shard_map bodies are DLP021's.
+    assert findings_for("DLP011", "distilp_tpu/ops/newmesh.py", """\
+        import jax.numpy as jnp
+        from ..utils import shardcompat
+
+        def run(batch, mesh):
+            def body(b_blk):
+                return float(jnp.max(b_blk))
+
+            return shardcompat.shard_map(
+                body, mesh, in_specs=None, out_specs=None
+            )(batch.b)
+        """) == []
+
+
+def test_dense_a_materialization_in_mesh_body_flagged():
+    out = findings_for("DLP021", "distilp_tpu/solver/newdispatch.py", """\
+        import jax.numpy as jnp
+        from ..utils import shardcompat
+
+        def run(batch, mesh, B, m, n):
+            def body(A_blk, b_blk):
+                full = jnp.broadcast_to(A_blk, (B, m, n))
+                z = jnp.zeros(shape=(B, m, n), dtype=A_blk.dtype)
+                t = jnp.tile(A_blk, reps=(B, 1, 1))
+                op = jnp.outer(b_blk, b_blk)
+                return full + z + t + op.sum()
+
+            return shardcompat.shard_map(
+                body, mesh, in_specs=None, out_specs=None
+            )(batch.A, batch.b)
+        """)
+    assert len(out) == 4
+    assert sum("(B, m, n) dense operator" in f.message for f in out) == 3
+    assert sum("per element" in f.message for f in out) == 1
+
+
+def test_mesh_body_lambda_and_raw_shard_map_spelling():
+    """Lambdas in the callable position count, under any shard_map
+    spelling — the raw jax.experimental import, not just the shim."""
+    out = findings_for("DLP021", "distilp_tpu/ops/newmesh.py", """\
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+
+        def run(x, mesh):
+            return shard_map(
+                lambda b: b * float(jnp.max(b)),
+                mesh, in_specs=None, out_specs=None,
+            )(x)
+        """)
+    assert len(out) == 1 and "host sync" in out[0].message
+
+
+def test_mesh_body_negatives_stay_silent():
+    """Per-shard rank-2 blocks inside the body, rank-3 work OUTSIDE the
+    body, out-of-scope layers, and tests all stay clean."""
+    good = """\
+        import jax
+        import jax.numpy as jnp
+        from ..utils import shardcompat
+
+        def run(batch, mesh, B, m, n):
+            pad = jnp.zeros((B, m, n), batch.A.dtype)  # host side: fine
+
+            def body(A_blk, b_blk):
+                blk = jnp.zeros((B, 4), b_blk.dtype)
+                y = jax.vmap(lambda a, b: a @ b)(A_blk, b_blk + blk)
+                return jax.lax.all_gather(y, "rows", axis=1, tiled=True)
+
+            return shardcompat.shard_map(
+                body, mesh, in_specs=None, out_specs=None
+            )(pad, batch.b)
+        """
+    assert findings_for("DLP021", "distilp_tpu/ops/newmesh.py", good) == []
+    bad = """\
+        import jax.numpy as jnp
+        from ..utils import shardcompat
+
+        def run(x, mesh):
+            return shardcompat.shard_map(
+                lambda b: b * float(jnp.max(b)),
+                mesh, in_specs=None, out_specs=None,
+            )(x)
+        """
+    # Same hazard outside ops//solver/ (or in a test) is not this rule's.
+    assert findings_for("DLP021", "distilp_tpu/profiler/topology2.py", bad) == []
+    assert findings_for("DLP021", "tests/test_something.py", bad) == []
+
+
+def test_dlp021_real_mesh_kernel_is_currently_clean():
+    """The actual sharded kernel (ops/meshlp.py) passes its own gate:
+    the shard_map body holds only per-shard blocks and collectives."""
+    from pathlib import Path
+
+    mod = "distilp_tpu/ops/meshlp.py"
+    src = Path(mod).read_text()
+    assert lint_source(mod, src, select=["DLP021"]) == [], mod
 
 
 def test_dlp020_real_jit_modules_are_currently_clean():

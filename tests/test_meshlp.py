@@ -1,0 +1,478 @@
+"""Sharded + mixed-precision PDHG tests (ops/meshlp.py, ROADMAP item 3).
+
+The fleet-scale contract: the row-sharded mesh kernel must be *invisible*
+above the ops layer — same ``LPBatch`` in, same fully-replicated
+``IPMResult`` out, same warm-state fields in full-array coordinates, same
+rigorous f64 Lagrangian certificate — so ``mesh_shards`` is a pure
+capacity knob: it changes which devices hold which operator rows and
+nothing else. These tests pin that on the forced host mesh the whole
+suite runs under (conftest sets ``--xla_force_host_platform_device_count=8``
+before any jax import), plus the mixed-precision soundness half: f32
+iterates are an optimization that can cost an f64 re-solve, never a wrong
+certificate.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from test_pdhg import GOLDEN, _random_feasible_batch  # noqa: E402
+
+from distilp_tpu.common import load_from_profile_folder, load_model_profile  # noqa: E402
+from distilp_tpu.ops import (  # noqa: E402
+    LPBatch,
+    pdhg_solve_batch,
+    pdhg_solve_batch_mp,
+    pdhg_solve_batch_sharded,
+)
+from distilp_tpu.ops import memmodel  # noqa: E402
+from distilp_tpu.ops.meshlp import pad_rows_to  # noqa: E402
+from distilp_tpu.ops.pdhg import PDHGWarmState  # noqa: E402
+from distilp_tpu.solver import halda_solve  # noqa: E402
+from distilp_tpu.solver.streaming import StreamingReplanner  # noqa: E402
+from distilp_tpu.utils import make_synthetic_fleet  # noqa: E402
+
+GAP = 1e-3
+SHARDS = 4
+
+# The mesh tests need >= SHARDS local devices; conftest forces 8 virtual
+# CPU devices, so this only skips when run outside the suite's env.
+requires_mesh = pytest.mark.skipif(
+    len(jax.devices()) < SHARDS,
+    reason=f"needs >= {SHARDS} local devices "
+    "(run under --xla_force_host_platform_device_count)",
+)
+
+
+# --------------------------------------------------------------------------
+# Kernel level: sharded vs unsharded parity, padding, warm interchange.
+
+
+@requires_mesh
+def test_sharded_matches_unsharded_kernel():
+    """4-shard solve == unsharded solve on random feasible LPs, with the
+    row padding exercised (m=10 is not a multiple of 4): objectives,
+    f64 bounds and the gathered dual agree to collective-reduction noise,
+    and the bound stays a valid lower bound."""
+    rng = np.random.default_rng(42)
+    batch, refs = _random_feasible_batch(rng, m=10, n=25, B=6)
+    assert pad_rows_to(10, SHARDS) == 12  # padding is really in play
+    ref = pdhg_solve_batch(batch, iters=20000, tol=1e-8)
+    res = pdhg_solve_batch_sharded(
+        batch, tol=1e-8, mesh_shards=SHARDS, iters=20000
+    )
+    assert np.all(np.array(res.converged))
+    np.testing.assert_allclose(
+        np.array(res.obj), np.array(ref.obj), rtol=1e-9, atol=1e-9
+    )
+    np.testing.assert_allclose(
+        np.array(res.bound), np.array(ref.bound), rtol=1e-9, atol=1e-9
+    )
+    # y_dual is gathered back to full coordinates and sliced to m=10.
+    assert res.y_dual.shape == ref.y_dual.shape
+    np.testing.assert_allclose(
+        np.array(res.y_dual), np.array(ref.y_dual), rtol=1e-7, atol=1e-9
+    )
+    assert np.all(np.array(res.bound) <= refs + 1e-6)
+
+
+@requires_mesh
+def test_shards1_matches_unsharded_to_ulp():
+    """mesh_shards=1 runs the identity-collective program: same math, but
+    a different XLA executable than the plain entry, so agreement is
+    asserted to last-ulp tolerance here. TRUE bit-stability of the
+    mesh_shards=1 *solver* knob is pinned in
+    test_sharded_and_f64_match_north_star — backend_jax dispatches
+    shards=1 onto the plain path, byte-identical by construction."""
+    rng = np.random.default_rng(7)
+    batch, _ = _random_feasible_batch(rng, m=9, n=20, B=4)
+    ref = pdhg_solve_batch(batch, iters=5000)
+    res = pdhg_solve_batch_sharded(batch, mesh_shards=1, iters=5000)
+    np.testing.assert_allclose(
+        np.array(res.obj), np.array(ref.obj), rtol=1e-12, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.array(res.bound), np.array(ref.bound), rtol=1e-12, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.array(res.v), np.array(ref.v), rtol=1e-9, atol=1e-9
+    )
+    np.testing.assert_allclose(
+        np.array(res.y_dual), np.array(ref.y_dual), rtol=1e-9, atol=1e-9
+    )
+    assert np.array_equal(np.array(res.iters_run), np.array(ref.iters_run))
+
+
+@requires_mesh
+def test_sharded_warm_states_interchange_with_unsharded():
+    """Warm states cross the mesh boundary in both directions: the sharded
+    kernel's result (full-array coordinates by construction) warm-starts
+    the unsharded kernel and vice versa, early-exiting both ways — no
+    shard count is baked into the iterate."""
+    rng = np.random.default_rng(11)
+    B = 6
+    batch, refs = _random_feasible_batch(rng, m=10, n=25, B=B)
+    cold = pdhg_solve_batch_sharded(
+        batch, tol=1e-8, mesh_shards=SHARDS, iters=20000
+    )
+    assert np.all(np.array(cold.converged))
+    warm_state = PDHGWarmState(
+        v=cold.v, y=cold.y_dual, z=cold.z_dual, f=cold.f_dual,
+        ok=jnp.ones(B, bool),
+    )
+    # sharded iterate -> unsharded kernel
+    w_u = pdhg_solve_batch(batch, iters=20000, tol=1e-8, warm=warm_state)
+    assert np.all(np.array(w_u.converged))
+    assert np.array(w_u.iters_run).max() < np.array(cold.iters_run).max()
+    np.testing.assert_allclose(np.array(w_u.obj), refs, rtol=1e-5, atol=1e-5)
+    # unsharded iterate -> sharded kernel (y is sliced into row blocks on
+    # entry; the skip mask must still freeze elements shard-locally)
+    cold_u = pdhg_solve_batch(batch, iters=20000, tol=1e-8)
+    w_s = pdhg_solve_batch_sharded(
+        batch, tol=1e-8,
+        warm=PDHGWarmState(
+            v=cold_u.v, y=cold_u.y_dual, z=cold_u.z_dual, f=cold_u.f_dual,
+            ok=jnp.ones(B, bool),
+        ),
+        skip=jnp.zeros(B, bool).at[3].set(True),
+        mesh_shards=SHARDS, iters=20000,
+    )
+    runs = np.array(w_s.iters_run)
+    assert runs[3] == 0
+    live = np.delete(np.arange(B), 3)
+    assert np.all(runs[live] > 0)
+    assert runs[live].max() < np.array(cold.iters_run).max()
+
+
+# --------------------------------------------------------------------------
+# Mixed precision: f32 iterates + f64 certificate, and the fallback.
+
+
+@requires_mesh
+def test_mp_f32_sound_vs_f64_vs_highs():
+    """f32 iterates with the f64 certificate: both precisions' bounds are
+    VALID lower bounds on the HiGHS optimum (soundness is precision-
+    independent), f32 objectives agree at first-order-appropriate
+    tolerance, f64 tighter — and no element needed the fallback."""
+    rng = np.random.default_rng(21)
+    batch, refs = _random_feasible_batch(rng, m=10, n=25, B=8)
+    rep32: dict = {}
+    r32 = pdhg_solve_batch_mp(
+        batch, mesh_shards=SHARDS, iters=40000, dtype="f32",
+        fallback_report=rep32,
+    )
+    r64 = pdhg_solve_batch_mp(
+        batch, mesh_shards=SHARDS, iters=40000, dtype="f64",
+    )
+    assert rep32["n_fallback"] == 0
+    assert np.all(np.array(r32.converged))
+    assert np.all(np.array(r64.converged))
+    # Bound validity holds for ANY dual — including an f32 iterate's.
+    assert np.all(np.array(r32.bound) <= refs + 1e-5)
+    assert np.all(np.array(r64.bound) <= refs + 1e-6)
+    np.testing.assert_allclose(np.array(r32.obj), refs, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.array(r64.obj), refs, rtol=1e-5, atol=1e-5)
+
+
+@requires_mesh
+def test_mp_nonfinite_f32_falls_back_to_f64():
+    """An element whose data overflows f32 entirely (b ~ 1e39 casts to
+    inf) is re-solved on the f64 path and spliced in per element; the
+    other elements keep their f32 results untouched."""
+    rng = np.random.default_rng(33)
+    B = 4
+    batch, _ = _random_feasible_batch(rng, m=8, n=18, B=B)
+    b_bad = np.array(batch.b, dtype=np.float64)
+    b_bad[0] *= 1e39  # f32(1e39) == inf: the f32 run cannot be finite
+    poisoned = LPBatch(
+        batch.A, jnp.array(b_bad), batch.c, batch.l, batch.u
+    )
+    rep: dict = {}
+    res = pdhg_solve_batch_mp(
+        poisoned, mesh_shards=SHARDS, iters=4000, dtype="f32",
+        fallback_report=rep,
+    )
+    assert rep["n_fallback"] >= 1
+    # Splice correctness: the fallen-back element carries the pure-f64
+    # run's values (cast to the f32 result dtype), the healthy elements
+    # the pure-f32 run's — bit-for-bit in both directions.
+    r32 = pdhg_solve_batch_mp(
+        poisoned, mesh_shards=SHARDS, iters=4000, dtype="f32",
+        f64_fallback=False,
+    )
+    r64 = pdhg_solve_batch_mp(
+        poisoned, mesh_shards=SHARDS, iters=4000, dtype="f64",
+    )
+    bad = ~np.asarray(r32.converged) | ~np.isfinite(np.asarray(r32.bound))
+    assert bad[0]
+    np.testing.assert_array_equal(
+        np.array(res.obj)[bad],
+        np.array(r64.obj).astype(np.array(res.obj).dtype)[bad],
+    )
+    np.testing.assert_array_equal(
+        np.array(res.obj)[~bad], np.array(r32.obj)[~bad]
+    )
+    assert np.all(np.isfinite(np.array(res.bound)[~bad]))
+
+
+def test_mp_rejects_unknown_dtype():
+    rng = np.random.default_rng(3)
+    batch, _ = _random_feasible_batch(rng, m=6, n=12, B=2)
+    with pytest.raises(ValueError, match="pdhg_dtype"):
+        pdhg_solve_batch_mp(batch, dtype="bf16")
+
+
+# --------------------------------------------------------------------------
+# Solver level: mesh_shards/pdhg_dtype through halda_solve — golden
+# fixtures, north star, bit-stability, validation, streaming warm state.
+
+
+@requires_mesh
+@pytest.mark.parametrize("folder,k_star,obj", GOLDEN)
+def test_sharded_backend_matches_golden(profiles_dir, folder, k_star, obj):
+    """mesh_shards=4 certifies the same optimum as the committed golden
+    objectives on every dense fixture — the B&B search cannot tell the
+    sharded engine ran."""
+    devs, model = load_from_profile_folder(profiles_dir / folder)
+    result = halda_solve(
+        devs, model, mip_gap=1e-4, kv_bits="4bit", backend="jax",
+        lp_backend="pdhg", mesh_shards=SHARDS,
+    )
+    assert result.k == k_star
+    assert result.obj_value == pytest.approx(obj, rel=2e-4)
+    assert sum(result.w) * result.k == model.L
+    for wi, ni in zip(result.w, result.n):
+        assert 0 <= ni <= wi
+
+
+@requires_mesh
+def test_sharded_and_f64_match_north_star(profiles_dir):
+    """The north-star agreement grid: sharded f32-iterate and sharded
+    f64-iterate solves both certify within mip_gap of the HiGHS oracle,
+    mesh_shards=1 is BIT-stable against the default path, and the shard
+    count is echoed in timings."""
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(16, seed=123)
+    ref = halda_solve(devs, model, mip_gap=GAP, kv_bits="4bit", backend="cpu")
+    base = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax",
+        lp_backend="pdhg",
+    )
+    one = halda_solve(
+        devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax",
+        lp_backend="pdhg", mesh_shards=1,
+    )
+    assert one.obj_value == base.obj_value  # bit-stable, not merely close
+    assert one.k == base.k and one.w == base.w and one.n == base.n
+    for dtype in (None, "f64"):
+        tm: dict = {}
+        res = halda_solve(
+            devs, model, mip_gap=GAP, kv_bits="4bit", backend="jax",
+            lp_backend="pdhg", mesh_shards=SHARDS, pdhg_dtype=dtype,
+            timings=tm,
+        )
+        assert tm["mesh_shards"] == SHARDS
+        assert res.certified
+        assert res.obj_value == pytest.approx(ref.obj_value, rel=2 * GAP)
+        assert res.obj_value == pytest.approx(base.obj_value, rel=2 * GAP)
+        assert sum(res.w) * res.k == model.L
+
+
+def test_mesh_knob_validation(profiles_dir):
+    """The row mesh is a PDHG capability: asking the IPM for it (or a
+    nonsense shard count / dtype spelling) fails loudly at resolve time,
+    before any device program is built."""
+    devs, model = load_from_profile_folder(
+        profiles_dir / "llama_3_70b" / "online"
+    )
+    with pytest.raises(ValueError, match="mesh_shards"):
+        halda_solve(
+            devs, model, backend="jax", lp_backend="ipm", mesh_shards=2
+        )
+    with pytest.raises(ValueError, match="mesh_shards"):
+        halda_solve(
+            devs, model, backend="jax", lp_backend="pdhg", mesh_shards=0
+        )
+    with pytest.raises(ValueError, match="pdhg_dtype"):
+        halda_solve(
+            devs, model, backend="jax", lp_backend="ipm", pdhg_dtype="f64"
+        )
+    with pytest.raises(ValueError, match="pdhg_dtype"):
+        halda_solve(
+            devs, model, backend="jax", lp_backend="pdhg", pdhg_dtype="f16"
+        )
+
+
+@requires_mesh
+def test_sharded_warm_state_roundtrips_through_dump_load(profiles_dir):
+    """dump_warm_state/load_warm_state carry the sharded engine's warm
+    state bit-exactly: a restored replanner's warm tick is identical to
+    the uninterrupted replanner's — and the blob has no shard count in
+    it, so it restores under any mesh size."""
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(16, seed=123)
+    search = {"lp_backend": "pdhg", "mesh_shards": SHARDS}
+    planner = StreamingReplanner(
+        mip_gap=GAP, kv_bits="4bit", backend="jax", search=dict(search)
+    )
+    first = planner.step(devs, model)
+    assert first.certified
+    blob = planner.dump_warm_state()
+
+    rng = np.random.default_rng(7)
+    for d in devs:
+        d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.95, 1.05)))
+    uninterrupted = planner.step(devs, model)
+    assert planner.last_tick_mode == "warm"
+
+    restored = StreamingReplanner(
+        mip_gap=GAP, kv_bits="4bit", backend="jax", search=dict(search)
+    )
+    restored.load_warm_state(blob)
+    resumed = restored.step(devs, model)
+    assert restored.last_tick_mode == "warm"
+    assert resumed.obj_value == uninterrupted.obj_value
+    assert resumed.k == uninterrupted.k
+    assert resumed.w == uninterrupted.w and resumed.n == uninterrupted.n
+
+    # The blob is mesh-size-agnostic: restore it into an UNSHARDED
+    # replanner and the warm tick still certifies.
+    unsharded = StreamingReplanner(
+        mip_gap=GAP, kv_bits="4bit", backend="jax",
+        search={"lp_backend": "pdhg"},
+    )
+    unsharded.load_warm_state(blob)
+    crossed = unsharded.step(devs, model)
+    assert unsharded.last_tick_mode == "warm"
+    assert crossed.certified
+    assert crossed.obj_value == pytest.approx(
+        uninterrupted.obj_value, rel=2 * GAP
+    )
+
+
+@requires_mesh
+def test_zero_warm_phase_compiles_for_sharded_entry(profiles_dir):
+    """A warm streaming tick at a fixed shard count dispatches the sharded
+    executable compiled on the cold tick — ZERO warm-phase compiles
+    attributed to the meshlp entry (the PR 16 gate contract, extended to
+    the mesh engine)."""
+    from distilp_tpu.obs import compile_ledger as cl
+
+    model = load_model_profile(
+        profiles_dir / "llama_3_70b" / "online" / "model_profile.json"
+    )
+    devs = make_synthetic_fleet(16, seed=123)
+    led = cl.enable()
+    try:
+        planner = StreamingReplanner(
+            mip_gap=GAP, kv_bits="4bit", backend="jax",
+            search={"lp_backend": "pdhg", "mesh_shards": SHARDS},
+        )
+        planner.step(devs, model)
+        tok = led.seq()
+        rng = np.random.default_rng(5)
+        for d in devs:
+            d.t_comm = max(0.0, d.t_comm * float(rng.uniform(0.97, 1.03)))
+        warm = planner.step(devs, model)
+        assert planner.last_tick_mode == "warm"
+        assert warm.certified
+        warm_events = [
+            e for e in led.events_since(tok)
+            if e["entry"] == "ops.meshlp.pdhg_solve_batch_sharded"
+            and e["cause"] != "cache_hit"
+        ]
+        assert warm_events == []
+    finally:
+        cl.disable()
+
+
+@pytest.mark.slow
+@requires_mesh
+def test_fleet_scale_sharded_m16384_arm():
+    """The capable-box ceiling arm: M=16384 sharded f32-iterate solve via
+    the bench child (same code path as DPERF_FLEET_SHARD_SLOW=1), must
+    certify at the fleet-scale gap. Hours of wall clock on a CPU box —
+    slow-marked on purpose."""
+    import subprocess
+    import sys as _sys
+
+    import bench
+
+    proc = subprocess.run(
+        [
+            _sys.executable, "-c", bench._FLEET_SCALE_SRC,
+            "16384", "pdhg", "0.05", "1000", str(SHARDS), "f32",
+        ],
+        capture_output=True, text=True, timeout=4 * 3600,
+        cwd=str(bench.REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = next(
+        ln for ln in proc.stdout.splitlines()
+        if ln.startswith("DPERF_FLEET ")
+    )
+    import json
+
+    got = json.loads(line[len("DPERF_FLEET "):])
+    assert got["certified"]
+    assert got["mesh_shards"] == SHARDS
+    assert got["shard_temp_bytes_measured"] is None or (
+        1.0
+        <= got["shard_temp_bytes_measured"]
+        / got["shard_temp_bytes_predicted"]
+        <= 100.0
+    )
+
+
+# --------------------------------------------------------------------------
+# memmodel: the per-shard sizing that CHOOSES the mesh (stdlib-only).
+
+
+def test_memmodel_shard_peak_reduces_and_ceils():
+    M = 512
+    assert memmodel.pdhg_shard_peak_bytes(M, 1) == memmodel.pdhg_peak_bytes(M)
+    m_rows, n_cols = memmodel.standard_form_dims(M)
+    # m_rows = 3075 on 4 shards -> ceil to 769-row blocks, modeled exactly.
+    assert memmodel.pdhg_shard_peak_bytes(M, 4) == -(-m_rows // 4) * n_cols * 4
+    assert memmodel.pdhg_shard_peak_bytes(M, 4, dtype_bytes=8) == (
+        2 * memmodel.pdhg_shard_peak_bytes(M, 4)
+    )
+    with pytest.raises(ValueError, match="mesh_shards"):
+        memmodel.pdhg_shard_peak_bytes(M, 0)
+
+
+def test_memmodel_choose_mesh_shards():
+    M = 512
+    full = memmodel.pdhg_peak_bytes(M)
+    # A budget that fits the whole operator prefers no mesh at all.
+    assert memmodel.choose_mesh_shards(M, full, max_shards=8) == 1
+    # A budget fitting half the operator needs (at least) 2 shards; the
+    # ceil'd block makes exactly-half slightly too big, so budget for the
+    # block, not the naive half.
+    two = memmodel.pdhg_shard_peak_bytes(M, 2)
+    assert memmodel.choose_mesh_shards(M, two, max_shards=8) == 2
+    assert memmodel.choose_mesh_shards(M, two - 1, max_shards=8) == 3
+    # Even max_shards devices can't fit: refuse, don't lie.
+    assert memmodel.choose_mesh_shards(M, 1024, max_shards=8) is None
+    # f64 iterates double the block: the same budget needs more shards.
+    s32 = memmodel.choose_mesh_shards(M, two, max_shards=16)
+    s64 = memmodel.choose_mesh_shards(M, two, max_shards=16, dtype_bytes=8)
+    assert s64 > s32
+    with pytest.raises(ValueError, match="max_shards"):
+        memmodel.choose_mesh_shards(M, 1, max_shards=0)
+
+
+def test_memmodel_dtype_bytes_of():
+    assert memmodel.dtype_bytes_of(None) == 4
+    assert memmodel.dtype_bytes_of("f32") == 4
+    assert memmodel.dtype_bytes_of("f64") == 8
+    with pytest.raises(ValueError, match="pdhg_dtype"):
+        memmodel.dtype_bytes_of("bf16")

@@ -150,10 +150,13 @@ def test_timings_breakdown_populated(profiles_dir):
     assert result.certified
     assert set(tm) == {
         "build_ms", "pack_ms", "upload_ms", "solve_ms", "static_hit",
-        "ipm_iters_executed", "bnb_rounds", "lp_backend",
+        "ipm_iters_executed", "bnb_rounds", "lp_backend", "mesh_shards",
     }
     # The LP engine echo: 'auto' on a 4-device fleet resolves to the IPM.
     assert tm.pop("lp_backend") == "ipm"
+    # The mesh echo: no --mesh-shards request resolves to the 1-shard
+    # (plain single-device) engine.
+    assert tm.pop("mesh_shards") == 1
     assert all(v >= 0 for v in tm.values())
     assert tm["build_ms"] > 0
     assert tm["solve_ms"] > 0

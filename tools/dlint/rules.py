@@ -13,6 +13,7 @@ import ast
 from typing import Dict, Iterator, List, Optional, Set
 
 from .core import (
+    RULES,
     FileContext,
     Finding,
     Rule,
@@ -1234,3 +1235,148 @@ class UnregisteredMetricName(Rule):
                         "help text) so the Prometheus exposition and "
                         "dashboards cannot drift from the code",
                     )
+
+
+# --------------------------------------------------------------------------
+# DLP021 — shard_map mesh-body hazards
+
+
+class _MeshBodyCollector(_TracedScopeCollector):
+    """Collect function nodes whose bodies run inside a shard_map mesh
+    region: lambdas and named defs in the callable position of a
+    ``shard_map(...)`` call under any spelling — ``jax.shard_map``,
+    ``jax.experimental.shard_map.shard_map``, or the
+    ``utils.shardcompat`` shim the kernels actually use. Inherits the
+    traced-scope collector's lexical name resolution; decorators are
+    ignored here — only being handed to shard_map marks a body."""
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._remember_def(node)
+        self._visit_scope(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (
+            dotted_name(node.func).split(".")[-1] == "shard_map"
+            and node.args
+        ):
+            body = node.args[0]
+            if isinstance(body, ast.Lambda):
+                self.traced.append(body)
+            elif isinstance(body, ast.Name):
+                self._consumed.append((body.id, tuple(self._scope)))
+        self.generic_visit(node)
+
+
+# Array constructors whose leading argument is an explicit shape, and
+# broadcast ops whose second argument is one: a literal rank-3 shape in
+# either position inside a mesh body is the full (B, m, n) operator.
+MESH_DENSE_CONSTRUCTORS = {"zeros", "ones", "full", "empty"}
+MESH_DENSE_BROADCASTERS = {"broadcast_to", "tile"}
+# Per-element outer products: under the body's vmap these materialize the
+# dense (m, n) operator per batch element — (B, m, n) in aggregate.
+MESH_DENSE_OUTER = {"outer", "kron"}
+ARRAY_NAMESPACES = NUMPY_ALIASES | {"jnp", "jax.numpy"}
+
+
+@register
+class MeshBodyHazard(Rule):
+    code = "DLP021"
+    name = "mesh-body-hazard"
+    rationale = (
+        "A shard_map body (ops/meshlp.py) exists to keep PER-SHARD state "
+        "per-shard: each device holds a (B, m/shards, n) row block of A "
+        "and meets the others only at psum/pmax/all_gather points. Two "
+        "hazards silently void that contract from inside the body. "
+        "(1) Host syncs — DLP011's call set — stall EVERY shard: the "
+        "mesh program is SPMD, so one device pausing at a host round-trip "
+        "parks all of them at the next collective. (2) Materializing a "
+        "full (B, m, n) dense A inside the body recreates on every shard "
+        "the exact allocation row-sharding exists to avoid — the "
+        "fleet-scale memory model (ops/memmodel.py) prices per-shard "
+        "blocks, so the predicted-vs-measured ledger band breaks and the "
+        "M~10^4 fleet solves the sharding was built for OOM again. "
+        "Scoped to ops//solver/, where the mesh kernels live."
+    )
+
+    _PATH_PREFIXES = ("distilp_tpu/ops/", "distilp_tpu/solver/")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.is_test or not any(
+            ctx.relpath.startswith(p) for p in self._PATH_PREFIXES
+        ):
+            return
+        collector = _MeshBodyCollector()
+        collector.visit(ctx.tree)
+        emitted = set()
+        for scope in collector.finish():
+            body = scope.body if isinstance(scope.body, list) else [scope.body]
+            for stmt in body:
+                for f in self._scan(ctx, stmt):
+                    key = (f.line, f.message)
+                    if key not in emitted:
+                        emitted.add(key)
+                        yield f
+
+    @staticmethod
+    def _is_rank3_literal(arg: Optional[ast.AST]) -> bool:
+        return (
+            isinstance(arg, (ast.Tuple, ast.List)) and len(arg.elts) >= 3
+        )
+
+    def _shape_arg(self, node: ast.Call, tail: str) -> Optional[ast.AST]:
+        """The shape-like argument of a constructor/broadcast call."""
+        if tail in MESH_DENSE_CONSTRUCTORS:
+            pos, kw_names = 0, ("shape",)
+        else:  # broadcasters: broadcast_to(x, shape) / tile(x, reps)
+            pos, kw_names = 1, ("shape", "reps")
+        if len(node.args) > pos:
+            return node.args[pos]
+        for kw in node.keywords:
+            if kw.arg in kw_names:
+                return kw.value
+        return None
+
+    def _scan(self, ctx: FileContext, root: ast.AST) -> Iterator[Finding]:
+        # Host syncs: the exact DLP011 call set (float/int/bool on a
+        # traced value, .item(), np.asarray/np.array), re-tagged with the
+        # mesh consequence — in SPMD code the sync stalls all shards.
+        for f in RULES["DLP011"]._scan(ctx, root):
+            yield Finding(
+                ctx.relpath,
+                f.line,
+                self.code,
+                f.message.split(";")[0].split(" (")[0]
+                + "; inside a shard_map mesh body the sync stalls every "
+                "shard at the next collective — return the value and "
+                "read it outside the mesh",
+            )
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            head, _, tail = fn.rpartition(".")
+            if head not in ARRAY_NAMESPACES:
+                continue
+            if tail in MESH_DENSE_CONSTRUCTORS | MESH_DENSE_BROADCASTERS:
+                if self._is_rank3_literal(self._shape_arg(node, tail)):
+                    yield Finding(
+                        ctx.relpath,
+                        node.lineno,
+                        self.code,
+                        f"`{fn}` with a rank-3 shape inside a shard_map "
+                        "mesh body materializes the full (B, m, n) dense "
+                        "operator on every shard — the allocation "
+                        "row-sharding exists to avoid; build the "
+                        "(B, m/shards, n) block outside and pass it "
+                        "through in_specs (ops/meshlp.py)",
+                    )
+            elif tail in MESH_DENSE_OUTER:
+                yield Finding(
+                    ctx.relpath,
+                    node.lineno,
+                    self.code,
+                    f"`{fn}` inside a shard_map mesh body builds the "
+                    "dense operator per element — (B, m, n) in aggregate "
+                    "under the body's vmap; keep A as the row-sharded "
+                    "block passed through in_specs (ops/meshlp.py)",
+                )
