@@ -26,7 +26,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory smoke-combine smoke-lockwatch smoke-shard
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory smoke-combine smoke-lockwatch smoke-shard smoke-autoscale
 	python -m pytest tests/ -q
 
 # Lock-sanitizer smoke: the runtime half of DLP032's deadlock claim. The
@@ -296,6 +296,43 @@ smoke-slo: lint-strict
 		--max-queue-depth 2 --check --expect-sheds \
 		--slo tests/traces/slo_live_spec.json --settle-s 3 \
 		--expect-alert page --quiet
+
+# Autoscale smoke: the closed control loop, both halves of its
+# determinism claim (mirrors smoke-slo's offline/live split).
+# (1) OFFLINE: Controller.replay over the committed synthetic overload
+# timeline + committed policy must reproduce the committed action
+# fixture BYTE-for-byte — decisions over a dumped timeline are a pure
+# function of (timeline, policy, spec, step), so any diff is controller
+# drift, not noise; --check replays twice and fails on any difference.
+# (2) LIVE: the committed diurnal+burst capture replayed as a
+# time-scaled flood through ONE process-backed worker (stub factory —
+# the child hosts schedulers behind the unix-socket RPC, no jax) with a
+# tiny queue and the live SLO spec: sheds open the availability page
+# alert, the controller votes scale_out on it, a second worker
+# subprocess spawns and the ring rebalance migrates shards live. The
+# --check contract reconciles actions == counters == flight records,
+# spawn/retire counts against scale actions, zero failed migrations,
+# and --expect-scale 2 asserts the fleet actually reached two workers.
+.PHONY: smoke-autoscale
+smoke-autoscale: lint-strict
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli autoscale \
+		--timeline tests/traces/slo_timeline_overload.jsonl \
+		--policy tests/traces/control_policy.json \
+		--spec tests/traces/slo_overload_spec.json \
+		--step-s 0.5 --expect tests/traces/control_expected_actions.jsonl \
+		--check --quiet
+	JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli overload \
+		--trace tests/traces/openloop_diurnal_burst.jsonl \
+		--profile tests/profiles/llama_3_70b/online \
+		--workers 1 --k-candidates 8,10 --time-scale 0.001 \
+		--max-queue-depth 2 \
+		--worker-backend process \
+		--scheduler-factory tests.procstub:make_scheduler \
+		--autoscale tests/traces/control_live_policy.json \
+		--slo tests/traces/slo_live_spec.json \
+		--capacity-probe 3 --control-period-s 0.05 \
+		--check --expect-scale 2 --expect-sheds --expect-alert page \
+		--settle-s 3 --quiet
 
 # Combine smoke: the committed diurnal+burst capture replayed with
 # cross-shard batching ON (coalesce folds a shard's burst into one tick;

@@ -1836,6 +1836,43 @@ def build_overload_parser() -> argparse.ArgumentParser:
         "with the open/close counters reconciling record-by-record "
         "against the flight recorder's slo ring (repeatable)",
     )
+    p.add_argument(
+        "--worker-backend", choices=("thread", "process"), default="thread",
+        help="host shard workers in threads (default) or dedicated "
+        "subprocesses (own GIL, own XLA runtime; README 'Closed-loop "
+        "autoscaling & process workers')",
+    )
+    p.add_argument(
+        "--scheduler-factory", default=None, metavar="MOD:FN",
+        help="'module:callable' scheduler factory resolved in whichever "
+        "process hosts the shard — the only factory form that crosses a "
+        "process boundary (tests.procstub:make_scheduler is the no-jax "
+        "stub the smokes use)",
+    )
+    p.add_argument(
+        "--autoscale", default=None, metavar="POLICY.json",
+        help="close the loop: build the gateway dynamic and run a "
+        "ControlLoop under this policy for the flood's whole life — "
+        "spawning/retiring workers with live warm shard migration, "
+        "flipping degrade admission; report grows a 'control' block "
+        "with the action trail + flight reconciliation",
+    )
+    p.add_argument(
+        "--control-period-s", type=float, default=0.1,
+        help="control loop decision period (seconds)",
+    )
+    p.add_argument(
+        "--capacity-probe", type=int, default=0, metavar="N",
+        help="with --autoscale: closed-loop probe of N events/fleet "
+        "post-warmup to auto-populate the /signals headroom denominator "
+        "(refreshed per worker-count change; 0 skips the probe)",
+    )
+    p.add_argument(
+        "--expect-scale", type=int, default=None, metavar="N",
+        help="with --check and --autoscale: fail unless the controller "
+        "scaled the fleet out to at least N workers during the flood "
+        "(and the control accounting reconciled record-by-record)",
+    )
     p.add_argument("--metrics-out", default=None,
                    help="write the report JSON here too")
     p.add_argument("--quiet", action="store_true", help="summary line only")
@@ -1884,8 +1921,17 @@ def overload_main(argv=None) -> int:
         except (OSError, ValueError) as e:
             print(f"error: cannot load --slo spec: {e}", file=sys.stderr)
             return 2
+    autoscale = None
+    if args.autoscale:
+        from ..control import ControlPolicy
+
+        try:
+            autoscale = ControlPolicy.from_json(args.autoscale)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load --autoscale: {e}", file=sys.stderr)
+            return 2
     timeline = None
-    if args.slo or args.timeline_out:
+    if args.slo or args.timeline_out or args.autoscale:
         from ..obs import Timeline
 
         timeline = Timeline()
@@ -1919,6 +1965,11 @@ def overload_main(argv=None) -> int:
         slo_config=slo_config,
         timeline=timeline,
         settle_s=args.settle_s,
+        worker_backend=args.worker_backend,
+        scheduler_factory=args.scheduler_factory,
+        autoscale=autoscale,
+        control_period_s=args.control_period_s,
+        capacity_probe_events=args.capacity_probe,
     )
     if args.timeline_out and timeline is not None:
         timeline.dump(args.timeline_out)
@@ -1993,6 +2044,24 @@ def overload_main(argv=None) -> int:
                     "combined traffic compile NOTHING after warm_combine "
                     f"(entries: {report['compile']['warm_phase_entries']})"
                 )
+        if autoscale is not None:
+            ctl = report.get("control") or {}
+            problems.extend(ctl.get("violations", []))
+            if args.expect_scale is not None:
+                peak = max(
+                    (
+                        a["target_workers"]
+                        for a in ctl.get("actions", [])
+                        if a.get("kind") == "scale_out"
+                    ),
+                    default=args.workers,
+                )
+                if peak < args.expect_scale:
+                    problems.append(
+                        f"expected the controller to scale out to >= "
+                        f"{args.expect_scale} workers but it peaked at "
+                        f"{peak}"
+                    )
         if args.expect_alert:
             slo_rep = report.get("slo") or {}
             events = slo_rep.get("events", [])
@@ -2281,6 +2350,162 @@ def slo_main(argv=None) -> int:
             return 1
     elif args.check and not args.quiet:
         print("slo check OK", file=sys.stderr)
+    return 0
+
+
+def build_autoscale_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="solver autoscale",
+        description="replay a dumped metrics timeline through the "
+        "closed-loop controller OFFLINE: a pure function of (timeline, "
+        "policy, slo spec, step) — same inputs, same action sequence, "
+        "byte for byte; the proof that the live loop's decisions are "
+        "reproducible from its recorded signals (README 'Closed-loop "
+        "autoscaling & process workers')",
+    )
+    p.add_argument(
+        "--timeline", required=True, metavar="FILE",
+        help="dumped timeline JSONL (serve --timeline-dir / overload "
+        "--timeline-out)",
+    )
+    p.add_argument(
+        "--policy", required=True, metavar="POLICY.json",
+        help="control policy file (control.ControlPolicy JSON; "
+        "tests/traces/control_policy.json is the committed smoke fixture)",
+    )
+    p.add_argument(
+        "--spec", default=None, metavar="SLO.json",
+        help="SLO spec evaluated alongside the replay so page/warn "
+        "alerts feed the policy's alert-driven levers (omitting it "
+        "leaves alerts_open at 0 for every step)",
+    )
+    p.add_argument(
+        "--step-s", type=float, default=0.5,
+        help="replay decision step (seconds of timeline time)",
+    )
+    p.add_argument(
+        "--capacity-eps", type=float, default=None,
+        help="max-sustainable events/sec pin for the headroom signal "
+        "(the live loop measures this with a closed-loop probe; offline "
+        "it must be pinned or the headroom levers stay dark)",
+    )
+    p.add_argument(
+        "--workers", type=int, default=None,
+        help="starting worker count (default: inferred from the "
+        "timeline's queue_depth.w* series)",
+    )
+    p.add_argument(
+        "--expect", default=None, metavar="FILE",
+        help="expected action JSONL (actions_to_jsonl format, one "
+        "key-sorted object per line): the replayed sequence must match "
+        "BYTE FOR BYTE",
+    )
+    p.add_argument(
+        "--out", default=None, metavar="FILE",
+        help="write the replayed action JSONL here (the fixture "
+        "regeneration path)",
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="exit 1 on any violation: --expect mismatch, or a "
+        "determinism failure (the replay runs TWICE from fresh "
+        "controllers; the two byte streams must be identical)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the evaluation as one JSON object instead of a table",
+    )
+    p.add_argument("--quiet", action="store_true", help="no table")
+    return p
+
+
+def autoscale_main(argv=None) -> int:
+    """``solver autoscale``: offline controller replay, byte-deterministic."""
+    args = build_autoscale_parser().parse_args(argv)
+
+    # Pure JSON-in, JSON-out: no profiles, no backend, no axon guard.
+    from ..control import Controller, ControlPolicy, actions_to_jsonl
+    from ..obs import Timeline
+
+    try:
+        timeline = Timeline.load(args.timeline)
+        policy = ControlPolicy.from_json(args.policy)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot load inputs: {e}", file=sys.stderr)
+        return 2
+    slo_config = None
+    if args.spec:
+        from ..obs import SLOConfig
+
+        try:
+            slo_config = SLOConfig.from_json(args.spec)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load --spec: {e}", file=sys.stderr)
+            return 2
+
+    def _run() -> str:
+        return actions_to_jsonl(
+            Controller.replay(
+                timeline,
+                policy,
+                slo_config=slo_config,
+                step_s=args.step_s,
+                capacity_eps=args.capacity_eps,
+                n_workers=args.workers,
+            )
+        )
+
+    violations: list = []
+    got = _run()
+    if args.check and _run() != got:
+        # A pure function cannot disagree with itself: any drift means a
+        # clock or ambient-state leak into the decision path.
+        violations.append(
+            "determinism: two replays of the same (timeline, policy) "
+            "produced different action streams"
+        )
+    if args.expect:
+        try:
+            expect = Path(args.expect).read_text()
+        except OSError as e:
+            print(f"error: cannot load --expect: {e}", file=sys.stderr)
+            return 2
+        if got != expect:
+            violations.append(
+                "action sequence mismatch:\n  expected "
+                f"{expect!r}\n  got      {got!r}"
+            )
+    if args.out:
+        Path(args.out).write_text(got)
+    actions = [json.loads(ln) for ln in got.splitlines()]
+    if args.json:
+        print(json.dumps({
+            "actions": actions,
+            "step_s": args.step_s,
+            "policy": policy.model_dump(),
+            "violations": violations,
+        }))
+    elif not args.quiet:
+        if actions:
+            print(f"{'t':>10s} {'action':<12s} {'workers':>7s} reason")
+            for a in actions:
+                tw = a.get("target_workers")
+                print(
+                    f"{a['t']:>10.3f} {a['kind']:<12s} "
+                    f"{'-' if tw is None else tw:>7} {a['reason']}"
+                )
+        else:
+            print("no actions")
+    if violations:
+        for v in violations:
+            print(f"autoscale violation: {v}", file=sys.stderr)
+        if args.check:
+            return 1
+    elif args.check and not args.quiet:
+        print(
+            f"autoscale check OK: {len(actions)} action(s), "
+            "byte-deterministic", file=sys.stderr,
+        )
     return 0
 
 
@@ -2920,6 +3145,8 @@ def main(argv=None) -> int:
         return overload_main(argv[1:])
     if argv and argv[0] == "slo":
         return slo_main(argv[1:])
+    if argv and argv[0] == "autoscale":
+        return autoscale_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     from ..axon_guard import force_cpu_if_env_requested

@@ -20,7 +20,12 @@ MANY fleets at once (ROADMAP open item 2):
 - ``traces``   — fleet-tagged JSONL traces (the multi-fleet replay
   format) and deterministic synthetic-fleet specs;
 - ``loadgen``  — the throughput harness behind ``bench.py``'s gateway
-  section (K fleets × N workers, events/sec + latency quantiles).
+  section (K fleets × N workers, events/sec + latency quantiles);
+- ``procworker`` — process-backed workers: the same ShardWorker
+  contract with the schedulers hosted in a dedicated subprocess (own
+  GIL, own XLA runtime) behind a length-prefixed unix-socket RPC — the
+  backend the closed-loop autoscaler (``distilp_tpu.control``) spawns
+  and retires, migrating shards live and warm.
 
 Stdlib + the existing solver stack only — no new dependencies.
 """
@@ -50,6 +55,18 @@ from .traces import (
 )
 from .worker import ShardWorker, WorkerQueueFull
 
+
+def __getattr__(name):
+    # Lazy on purpose: the worker CHILD process runs `python -m
+    # distilp_tpu.gateway.procworker`, which imports this package first;
+    # an eager `from .procworker import …` here would double-import the
+    # child's own entry module (runpy's sys.modules warning).
+    if name in ("ProcShardWorker", "SchedulerProxy"):
+        from . import procworker
+
+        return getattr(procworker, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "Gateway",
     "QueueFull",
@@ -71,4 +88,6 @@ __all__ = [
     "read_gateway_trace",
     "write_gateway_trace",
     "ShardWorker",
+    "ProcShardWorker",
+    "SchedulerProxy",
 ]

@@ -154,6 +154,8 @@ class Gateway:
         mem_degrade_headroom_bytes: Optional[float] = None,
         combine: bool = False,
         combine_policy=None,
+        worker_backend: str = "thread",
+        dynamic: bool = False,
     ):
         # Library entry point that dispatches backend work (via the
         # schedulers it builds): arm the axon-wedge guard exactly like
@@ -177,11 +179,71 @@ class Gateway:
         # no-op and schedulers are built exactly as before.
         self.tracer = NOOP_TRACER if tracer is None else tracer
         self.flight = flight
+        # -- worker backend + dynamic fleet (PR 19) ------------------------
+        # worker_backend='process' hosts each worker's schedulers in a
+        # dedicated subprocess (own GIL, own XLA runtime) behind the same
+        # ShardWorker contract; it excludes the cross-shard combiner,
+        # chaos fault_hook injection and CALLABLE scheduler factories —
+        # none of those cross a process boundary (a 'module:callable'
+        # factory string works on both backends).
+        if worker_backend not in ("thread", "process"):
+            raise ValueError(
+                f"worker_backend must be 'thread' or 'process', "
+                f"got {worker_backend!r}"
+            )
+        if worker_backend == "process":
+            if combine:
+                raise ValueError(
+                    "combine needs in-process shard access; use thread "
+                    "workers or disable combine"
+                )
+            if scheduler_factory is not None and not isinstance(
+                scheduler_factory, str
+            ):
+                raise ValueError(
+                    "process workers need a 'module:callable' factory "
+                    "string (a callable cannot cross a process boundary)"
+                )
+        self.worker_backend = worker_backend
+        # dynamic=True arms live topology changes (spawn/retire/migrate).
+        # Default OFF: the static gateway's ingest path takes no
+        # migration gate — byte-identical to the pre-autoscaler serving
+        # path, pinned by test.
+        self._dynamic = bool(dynamic)
         self.router = ConsistentHashRouter(n_workers, replicas=replicas)
-        self.workers: List[ShardWorker] = [
-            ShardWorker(i, metrics=self.metrics) for i in range(n_workers)
+        # Worker SLOTS: a retired worker leaves None at its index so
+        # worker ids stay stable ring labels; iterate live_workers() —
+        # never this list directly — everywhere that touches all workers.
+        self.workers: List[Optional[ShardWorker]] = [
+            self._make_worker(i) for i in range(n_workers)
         ]
+        # In-flight migrations: shard key -> {'parked': [waiter tuples]}.
+        # Ingest for a migrating shard PARKS under this lock; the flip
+        # closure replays parked events onto the destination before the
+        # entry is cleared, so no event is lost or double-applied.
+        self._migration_lock = make_lock("gateway.migration")
+        self._migrating: Dict[str, dict] = {}  # guarded-by: self._migration_lock
+        # Scheduler metrics are live-copy-only by contract (dump_state
+        # drops them); when a migration retires a source copy its
+        # counters fold in here so per-fleet shard_totals stay
+        # cumulative across moves (warm_resumes == shards migrated).
+        self._folded_counters: Dict[str, Dict[str, int]] = {}
+        # Serializes whole migrations (and spawn/retire rebalances):
+        # two concurrent flips in opposite directions would deadlock
+        # their worker threads on each other's load round trips.
+        self._migrate_serial = make_lock("gateway.migrate_serial")
+        # Autoscaler admission actuation: force_degrade(True) marks every
+        # tick under PRESSURE (spec_near serving) regardless of depth —
+        # the controller's fast, reversible lever while scale-out warms.
+        self._forced_pressure = False
+        # Per-worker sustainable eps from the capacity probe; capacity_eps
+        # refreshes deterministically as worker count changes.
+        self._capacity_per_worker: Optional[float] = None
+        self._controller = None  # attach_controller(); /control reads it
         # shard_key -> (fleet_id, model_id, worker index); fleet -> key.
+        # Written at registration (under the migration lock, for the
+        # lock-discipline audit) and by a live migration's owner flip;
+        # dynamic-mode ingest re-reads the entry under the same lock.
         self._shards: Dict[str, Tuple[str, str, int]] = {}
         self._fleet_key: Dict[str, str] = {}
         # Per-fleet handled-event cursor (quarantines included): the
@@ -271,8 +333,35 @@ class Gateway:
         self.slo_engine = None
         # Max-sustainable events/sec from the PR 12 closed-loop capacity
         # probe (serve --capacity-eps / the bench's measured value): the
-        # denominator of /signals' headroom computation.
+        # denominator of /signals' headroom computation. Written by
+        # note_capacity and by the per-worker refresh inside a
+        # spawn/retire, both under the migrate-serial lock.
         self.capacity_eps: Optional[float] = None
+
+    # -- worker fleet ------------------------------------------------------
+
+    def _make_worker(self, worker_id: int) -> ShardWorker:
+        if self.worker_backend == "process":
+            from ..obs import compile_ledger as _cl
+            from .procworker import ProcShardWorker
+
+            # The child mirrors the parent's compile-ledger enablement:
+            # a ledgered run gets per-process compile attribution (the
+            # bench federation section's zero-warm-compiles gate reads
+            # it via ledger_counters()); an unledgered run pays nothing.
+            return ProcShardWorker(
+                worker_id,
+                metrics=self.metrics,
+                compile_ledger=_cl.current() is not None,
+            )
+        return ShardWorker(worker_id, metrics=self.metrics)
+
+    def live_workers(self) -> List[ShardWorker]:
+        """Current worker fleet, retired slots excluded."""
+        return [w for w in self.workers if w is not None]
+
+    def live_worker_ids(self) -> List[int]:
+        return [w.worker_id for w in self.workers if w is not None]
 
     # -- shard lifecycle ---------------------------------------------------
 
@@ -285,6 +374,12 @@ class Gateway:
         if self._factory is not None:
             # Factory signature stays (devices, model): tests inject
             # failing schedulers through it and obs plumbing is theirs.
+            # A 'module:callable' string resolves to the same shape (the
+            # form process workers require — the child imports it too).
+            if isinstance(self._factory, str):
+                from .procworker import resolve_factory
+
+                return resolve_factory(self._factory)(devices, model)
             return self._factory(devices, model)
         kw = dict(self.scheduler_kwargs)
         if self.tracer is not NOOP_TRACER:
@@ -293,6 +388,28 @@ class Gateway:
             kw["flight"] = self.flight
             kw["flight_key"] = fleet_id
         return Scheduler(devices, model, **kw)
+
+    def _shard_spec(self, devices, model, fleet_id: str) -> Optional[dict]:
+        """Picklable build instructions for a process worker's child
+        (None on the thread backend — it builds via the closure)."""
+        if self.worker_backend != "process":
+            return None
+        return {
+            "devices": [
+                d.model_dump() if hasattr(d, "model_dump") else d
+                for d in devices
+            ],
+            "model": (
+                model.model_dump()
+                if hasattr(model, "model_dump")
+                else model
+            ),
+            "fleet_id": fleet_id,
+            "kwargs": dict(self.scheduler_kwargs),
+            "factory": (
+                self._factory if isinstance(self._factory, str) else None
+            ),
+        }
 
     def register_fleet(
         self,
@@ -326,15 +443,14 @@ class Gateway:
             )
         widx = self.router.owner(key)
         worker = self.workers[widx]
-
-        def _do() -> None:
-            sched = self._build_scheduler(devices, model, fleet_id)
-            if state is not None:
-                sched.load_state(state)
-            worker.shards[key] = sched
-
-        worker.call(_do)
-        self._shards[key] = (fleet_id, model_id, widx)
+        worker.create_shard(
+            key,
+            build=lambda: self._build_scheduler(devices, model, fleet_id),
+            state=state,
+            spec=self._shard_spec(devices, model, fleet_id),
+        )
+        with self._migration_lock:
+            self._shards[key] = (fleet_id, model_id, widx)
         self._fleet_key[fleet_id] = key
         self._handled[fleet_id] = events_handled
         self.metrics.inc("shards_registered")
@@ -394,6 +510,11 @@ class Gateway:
         policies. All-default arguments turn admission OFF — back to the
         byte-identical pre-admission ingest path.
         """
+        if combine and self.worker_backend == "process":
+            raise ValueError(
+                "combine needs in-process shard access; use thread "
+                "workers or disable combine"
+            )
         old_combiner = None
         with self._admission_lock:
             if self._pending or self._combine_inflight:
@@ -596,6 +717,42 @@ class Gateway:
         self, fleet_id: str, key: str, worker: ShardWorker, event, parent, t_enq,
         on_done=None,
     ):
+        """Route one event to its worker, migration-aware when dynamic.
+
+        Static gateways (``dynamic=False``, the default) fall straight
+        through to the admission path below — no extra lock, no new code
+        on the hot path. Dynamic gateways take the migration gate: an
+        event for a shard whose flip is in flight PARKS (no closure is
+        queued anywhere) and is replayed onto the destination worker by
+        the flip itself, in arrival order, before the gate clears — so a
+        live migration loses no event, double-applies no event, and
+        serves every tick. The gate also re-resolves the owning worker
+        under the lock: the caller's ``worker`` argument may predate a
+        completed flip.
+        """
+        if self._dynamic:
+            with self._migration_lock:
+                mig = self._migrating.get(key)
+                if mig is not None:
+                    box: dict = {}
+                    done = threading.Event()
+                    mig["parked"].append(
+                        (event, parent, t_enq, on_done, box, done)
+                    )
+                    self.metrics.inc("migration_parked")
+                    return box, done
+                worker = self.workers[self._shards[key][2]]
+                return self._submit_tick_routed(
+                    fleet_id, key, worker, event, parent, t_enq, on_done
+                )
+        return self._submit_tick_routed(
+            fleet_id, key, worker, event, parent, t_enq, on_done
+        )
+
+    def _submit_tick_routed(
+        self, fleet_id: str, key: str, worker: ShardWorker, event, parent, t_enq,
+        on_done=None,
+    ):
         """Route one event through the admission gate onto its worker.
 
         Returns the ``(box, done)`` pair the waiter resolves on. With
@@ -616,10 +773,15 @@ class Gateway:
           still drains exactly the events that joined before the barrier)
           and queue behind it, preserving per-fleet order.
         """
+        # force_degrade(True) routes through the admission branch even
+        # when no static knob is set: the forced flag IS the pressure
+        # verdict. With it off (always, on static gateways) this line is
+        # exactly the old precomputed check.
+        admission = self._admission or self._forced_pressure
         depth: Optional[int] = None
-        if self._admission or self.tracer.enabled:
+        if admission or self.tracer.enabled:
             depth = worker.depth()
-        if not self._admission:
+        if not admission:
             return worker.submit(
                 self._tick_closure(
                     fleet_id, key, worker, event,
@@ -628,8 +790,10 @@ class Gateway:
                 on_done,
             )
         pressure = (
-            self.degrade_depth is not None and depth >= self.degrade_depth
-        ) or self._mem_pressure()
+            (self.degrade_depth is not None and depth >= self.degrade_depth)
+            or self._forced_pressure
+            or self._mem_pressure()
+        )
         structural = getattr(event, "kind", None) in STRUCTURAL_KINDS
         if self.coalesce and not structural:
             return self._submit_coalesced(
@@ -971,6 +1135,311 @@ class Gateway:
         with self._shed_lock:
             return dict(self._shed_counts)
 
+    # -- dynamic fleet: spawn / retire / live migration --------------------
+    #
+    # All three verbs require dynamic=True (the static hot path takes no
+    # migration gate) and are serialized by one lock: two in-flight
+    # flips in opposite directions would deadlock their worker threads
+    # on each other's load round trips, and the autoscaler is
+    # single-threaded anyway.
+
+    def _require_dynamic(self) -> None:
+        if not self._dynamic:
+            raise RuntimeError(
+                "live topology changes need a dynamic gateway "
+                "(Gateway(..., dynamic=True))"
+            )
+        if self.combine:
+            raise RuntimeError(
+                "live topology changes are unsupported with the "
+                "cross-shard combiner on"
+            )
+
+    def spawn_worker(self) -> Tuple[int, List[str]]:
+        """Add one worker; rebalance the ring onto it via live migration.
+
+        Returns ``(worker_id, moved shard keys)``. The new worker takes
+        ~1/N of the ring (consistent hashing), and every moved shard
+        arrives warm: its pool and published placement ride the
+        bit-exact snapshot blob through ``migrate_shard``.
+        """
+        self._require_dynamic()
+        with self._migrate_serial:
+            widx = len(self.workers)
+            self.workers.append(self._make_worker(widx))
+            self.n_workers = len(self.live_worker_ids())
+            self.router = ConsistentHashRouter(
+                replicas=self.router.replicas,
+                worker_ids=self.live_worker_ids(),
+            )
+            self.metrics.inc("workers_spawned")
+            moved = self._rebalance()
+            self._refresh_capacity()
+            return widx, moved
+
+    def retire_worker(self, widx: Optional[int] = None) -> Tuple[int, List[str]]:
+        """Drain one worker (default: highest id) and stop it.
+
+        Its ring slices — and only its — move to the survivors first
+        (live migrations, warm), then the worker stops. The slot stays
+        ``None`` so remaining worker ids keep their stable ring labels.
+        """
+        self._require_dynamic()
+        with self._migrate_serial:
+            live = self.live_worker_ids()
+            if len(live) <= 1:
+                raise RuntimeError("cannot retire the last worker")
+            if widx is None:
+                widx = live[-1]
+            worker = self.workers[widx] if 0 <= widx < len(self.workers) else None
+            if worker is None:
+                raise ValueError(f"worker {widx} is not live")
+            remaining = [w for w in live if w != widx]
+            self.router = ConsistentHashRouter(
+                replicas=self.router.replicas, worker_ids=remaining
+            )
+            moved = self._rebalance()
+            worker.stop(join=True)
+            self.workers[widx] = None
+            self.n_workers = len(remaining)
+            self.metrics.inc("workers_retired")
+            self._refresh_capacity()
+            return widx, moved
+
+    def _rebalance(self) -> List[str]:
+        """Migrate every shard whose ring owner changed. Caller holds
+        ``_migrate_serial``."""
+        moved: List[str] = []
+        for key, (fid, _mid, cur) in list(self._shards.items()):
+            target = self.router.owner(key)
+            if target != cur:
+                self._migrate_shard_locked(fid, target)
+                moved.append(key)
+        return moved
+
+    def migrate_shard(self, fleet_id: str, dst_widx: int) -> None:
+        """Move one fleet's shard to another worker with zero cold ticks.
+
+        Two phases. **Prefetch** (source keeps serving): snapshot the
+        shard behind whatever is queued, build + warm-load the
+        destination copy — the expensive part (scheduler build, first
+        compile) happens entirely off the serving path. **Flip**: mark
+        the shard migrating (ingest parks — no closure queued anywhere),
+        queue the flip on the source; it runs after every tick admitted
+        before parking, dumps the now-quiescent final state, loads it
+        into the destination (re-arming the warm-resume audit: the blob
+        is the authority, the prefetch was advisory), flips routing, and
+        replays parked events onto the destination in arrival order
+        before the gate clears. No event is lost, none applies twice,
+        and the destination's first tick is warm — ``warm_resumes``
+        advances by exactly one per migrated shard, ``cold_resumes`` and
+        ``tick_cold`` by zero.
+
+        On a flip failure the gate clears with routing unchanged and
+        parked events replay onto the still-intact source — the
+        migration failed, serving did not.
+        """
+        self._require_dynamic()
+        with self._migrate_serial:
+            self._migrate_shard_locked(fleet_id, dst_widx)
+
+    def _migrate_shard_locked(self, fleet_id: str, dst_widx: int) -> None:
+        key = self._fleet_key.get(fleet_id)
+        if key is None:
+            raise KeyError(f"unknown fleet {fleet_id!r}")
+        fid, mid, src_widx = self._shards[key]
+        if dst_widx == src_widx:
+            return
+        src = self.workers[src_widx]
+        dst = (
+            self.workers[dst_widx]
+            if 0 <= dst_widx < len(self.workers)
+            else None
+        )
+        if dst is None:
+            raise ValueError(f"worker {dst_widx} is not live")
+
+        # Phase 1 — prefetch: base snapshot + destination build, source
+        # still serving every tick.
+        base = src.dump_shard(key)
+        dst.create_shard(
+            key,
+            build=lambda: self._build_from_blob(base, fid),
+            state=base,
+            spec=self._spec_from_blob(base, fid),
+        )
+
+        # Phase 2 — park and flip.
+        with self._migration_lock:
+            self._migrating[key] = {"parked": []}
+
+        def _flip():
+            ok = False
+            try:
+                state = src.shards[key].dump_state()
+                dst.load_shard(key, state)
+                ok = True
+            finally:
+                with self._migration_lock:
+                    mig = self._migrating.pop(key, None)
+                    if ok:
+                        self._shards[key] = (fid, mid, dst_widx)
+                    target = self.workers[self._shards[key][2]]
+                    parked = mig["parked"] if mig else []
+                    for rec in parked:
+                        self._submit_parked(fid, key, target, rec)
+                if ok:
+                    # The source copy is inert (nothing routes to it);
+                    # fold its counters into the fleet's running totals
+                    # (they do not ride the blob), then drop it off the
+                    # gate, still on the source thread.
+                    stale = src.shards.pop(key)
+                    counters = dict(stale.metrics.counters)
+                    with self._migration_lock:
+                        acc = self._folded_counters.setdefault(fid, {})
+                        for name, v in counters.items():
+                            if v:
+                                acc[name] = acc.get(name, 0) + v
+                    stale.close()
+            return len(parked)
+
+        try:
+            parked_n = src.call(_flip)
+        except BaseException:
+            # Failed flip: best-effort drop of the prefetched copy.
+            self.metrics.inc("migration_failed")
+            try:
+                dst.drop_shard(key)
+            except Exception:  # dlint: disable=DLP017 the flip failure was counted (migration_failed) and re-raises below; this drop is best-effort cleanup of the never-published prefetch copy
+                pass
+            raise
+        self.metrics.inc("shards_migrated")
+        if self.flight is not None:
+            self.flight.record(
+                "migration",
+                {
+                    "t": time.time(),
+                    "fleet": fid,
+                    "shard": key,
+                    "src": src_widx,
+                    "dst": dst_widx,
+                    "parked": parked_n,
+                },
+            )
+
+    def _submit_parked(self, fleet_id, key, worker, rec) -> None:
+        """Replay one parked event onto the post-flip owner, resolving
+        the waiter that has been parked since ingest."""
+        event, parent, t_enq, on_done, box, done = rec
+        inner = self._tick_closure(
+            fleet_id, key, worker, event, parent=parent, t_enq=t_enq
+        )
+
+        def _do():
+            shared: dict = {}
+            try:
+                shared["result"] = inner()
+            except BaseException as e:
+                self.metrics.inc("worker_exception")
+                shared["exc"] = e
+            finally:
+                self._resolve_waiters([(box, done, on_done)], shared)
+
+        worker.submit(_do)
+
+    def _build_from_blob(self, blob: dict, fleet_id: str):
+        """Rebuild a shard's scheduler from its snapshot's own fleet
+        profile (migration has no caller-supplied devices/model)."""
+        if self._factory is not None:
+            # A factory owns its own devices/model contract — the blob's
+            # values pass through exactly as dump_state recorded them.
+            return self._build_scheduler(
+                blob["devices"], blob.get("model"), fleet_id
+            )
+        devices = [
+            DeviceProfile.model_validate(d) for d in blob["devices"]
+        ]
+        model = (
+            ModelProfile.model_validate(blob["model"])
+            if blob.get("model") is not None
+            else None
+        )
+        return self._build_scheduler(devices, model, fleet_id)
+
+    def _spec_from_blob(self, blob: dict, fleet_id: str) -> Optional[dict]:
+        if self.worker_backend != "process":
+            return None
+        return {
+            "devices": list(blob["devices"]),
+            "model": blob.get("model"),
+            "fleet_id": fleet_id,
+            "kwargs": dict(self.scheduler_kwargs),
+            "factory": (
+                self._factory if isinstance(self._factory, str) else None
+            ),
+        }
+
+    # -- controller actuation seams ----------------------------------------
+
+    def force_degrade(self, on: bool) -> None:
+        """Mark every tick under PRESSURE (spec_near serving) regardless
+        of queue depth — the autoscaler's fast, reversible lever while a
+        spawned worker warms. Off restores the static admission verdict
+        exactly."""
+        self._forced_pressure = bool(on)
+
+    def set_spec_k(self, k: int) -> None:
+        """Set ``spec_k`` on every live shard (ON each worker thread; a
+        process worker forwards per shard over its RPC)."""
+        for w in self.live_workers():
+            def _do(w=w):
+                for sched in w.shards.values():
+                    sched.spec_k = k
+
+            w.call(_do)
+
+    def note_capacity(self, eps: float, n_workers: Optional[int] = None) -> None:
+        """Record the closed-loop capacity probe: ``eps`` sustainable at
+        ``n_workers`` (default: current live count). The per-worker
+        quotient is kept so ``capacity_eps`` refreshes deterministically
+        on every spawn/retire — no live re-probe inside the control loop
+        (replay must stay a pure function of timeline + policy)."""
+        n = n_workers if n_workers is not None else len(self.live_workers())
+        with self._migrate_serial:
+            self._capacity_per_worker = eps / max(1, n)
+            self.capacity_eps = eps
+
+    def _refresh_capacity(self) -> None:
+        if self._capacity_per_worker is not None:
+            self.capacity_eps = self._capacity_per_worker * len(
+                self.live_workers()
+            )
+
+    def attach_controller(self, loop) -> None:
+        """Attach a running ControlLoop; stopped with the samplers on
+        close() (before the workers — an actuation mid-close must not
+        land on a stopping worker)."""
+        self._controller = loop
+        self.attach_sampler(loop)
+
+    def control_status(self) -> dict:
+        """The /control payload: live topology + the decision trail."""
+        actions: List[dict] = []
+        if self.flight is not None and "control" in self.flight.keys():
+            actions = [dict(r) for r in self.flight.snapshot("control")]
+        return {
+            "enabled": self._controller is not None,
+            "dynamic": self._dynamic,
+            "worker_backend": self.worker_backend,
+            "workers": self.live_worker_ids(),
+            "capacity_eps": self.capacity_eps,
+            "forced_degrade": self._forced_pressure,
+            "migrations": int(
+                self.metrics.counters.get("shards_migrated", 0)
+            ),
+            "actions": actions,
+        }
+
     def handle_event(self, fleet_id: str, event) -> PlacementView:
         """Apply one event to its fleet's shard; blocks for the view.
 
@@ -1118,7 +1587,7 @@ class Gateway:
             "status": worst,
             "workers": self.n_workers,
             "shards": shards,
-            "queue_depths": [w.depth() for w in self.workers],
+            "queue_depths": [w.depth() for w in self.live_workers()],
         }
 
     def metrics_snapshot(self) -> dict:
@@ -1128,7 +1597,15 @@ class Gateway:
         all_counters = self._per_worker(
             lambda s, _fid: dict(s.metrics.counters)
         )
+        with self._migration_lock:
+            folded = {
+                f: dict(c) for f, c in self._folded_counters.items()
+            }
         for fleet_id, counters in all_counters.items():
+            # Counters of copies this fleet's migrations retired: the
+            # live copy starts fresh, the totals stay cumulative.
+            for name, v in folded.get(fleet_id, {}).items():
+                counters[name] = counters.get(name, 0) + v
             per_shard[fleet_id] = {
                 c: counters.get(c, 0)
                 for c in _AGGREGATED_SHARD_COUNTERS
@@ -1182,7 +1659,8 @@ class Gateway:
             # it in the same exposition).
             worker_gauges={
                 "worker_queue_depth": {
-                    str(w.worker_id): w.depth() for w in self.workers
+                    str(w.worker_id): w.depth()
+                    for w in self.live_workers()
                 }
             },
         )
@@ -1206,7 +1684,9 @@ class Gateway:
         self.slo_engine = engine
         self.timeline = timeline
         if capacity_eps is not None:
-            self.capacity_eps = capacity_eps
+            # Route through note_capacity: same lock, and the per-worker
+            # quotient stays consistent if the fleet later goes dynamic.
+            self.note_capacity(capacity_eps)
 
     def timeline_sample(self) -> Dict[str, float]:
         """One flat ``{series: value}`` sample for the metrics timeline:
@@ -1232,10 +1712,17 @@ class Gateway:
         # (a shed never reaches gateway_events, and an error ratio over
         # accepted-only would understate a shedding gateway's burn).
         out["c.events_offered"] = out["c.gateway_events"] + out["c.events_shed"]
-        depths = [w.depth() for w in self.workers]
-        for i, d in enumerate(depths):
-            out[f"queue_depth.w{i}"] = float(d)
+        depths = []
+        for w in self.live_workers():
+            d = w.depth()
+            depths.append(d)
+            out[f"queue_depth.w{w.worker_id}"] = float(d)
         out["queue_depth.max"] = float(max(depths) if depths else 0)
+        if self._dynamic:
+            # The controller's own series, only on dynamic gateways so
+            # static samples stay byte-identical: live worker count is
+            # the signal a replayed decision trail is audited against.
+            out["control.workers"] = float(len(depths))
         from ..obs import compile_ledger as _cl
 
         led = _cl.current()
@@ -1348,11 +1835,18 @@ class Gateway:
                 "registered)"
             )
         for shard in snap.shards:
-            devices = [
-                DeviceProfile.model_validate(d)
-                for d in shard.state["devices"]
-            ]
-            model = ModelProfile.model_validate(shard.state["model"])
+            if self._factory is not None:
+                # A factory owns its own devices/model contract — the
+                # blob's raw values pass through (mirrors
+                # ``_build_from_blob``; no profile validation).
+                devices = shard.state["devices"]
+                model = shard.state["model"]
+            else:
+                devices = [
+                    DeviceProfile.model_validate(d)
+                    for d in shard.state["devices"]
+                ]
+                model = ModelProfile.model_validate(shard.state["model"])
             self.register_fleet(
                 shard.fleet_id,
                 devices,
@@ -1413,7 +1907,7 @@ class Gateway:
             # closures on still-running workers, and the workers' own
             # graceful stop then drains those.
             self._combiner.stop()
-        for w in self.workers:
+        for w in self.live_workers():
             w.stop()
 
     def __enter__(self) -> "Gateway":

@@ -266,6 +266,11 @@ class GatewayHTTPServer:
         if method == "GET" and path == "/signals":
             signals = await loop.run_in_executor(None, self.gateway.signals)
             return 200, signals, _JSON
+        if method == "GET" and path == "/control":
+            status = await loop.run_in_executor(
+                None, self.gateway.control_status
+            )
+            return 200, status, _JSON
         if method == "GET" and path.startswith("/debug/flight/"):
             fleet_id = path[len("/debug/flight/"):]
             records = await loop.run_in_executor(

@@ -162,9 +162,13 @@ async def replay_concurrent(
             ms = (time.perf_counter() - t0) * 1e3
             if record:
                 latencies.append(ms)
-                if view.events_behind > 0:
+                # getattr-tolerant: stub schedulers (process-worker test
+                # factory) serve plain dicts, not PlacementViews.
+                if getattr(view, "events_behind", 0) > 0:
                     failures["tick_failed"] += 1
-                elif not view.result.certified:
+                elif not getattr(
+                    getattr(view, "result", None), "certified", True
+                ):
                     failures["uncertified"] += 1
 
     split = {f: measure_from.get(f, 0) for f in per_fleet}
@@ -221,6 +225,7 @@ def run_loadgen(
     timeline_period_s: Optional[float] = None,
     compile_ledger: bool = False,
     memory_ledger: bool = False,
+    worker_backend: str = "thread",
 ) -> dict:
     """One full loadgen arm: build fleets, replay, report, tear down.
 
@@ -245,8 +250,36 @@ def run_loadgen(
         "k_candidates": list(k_candidates) if k_candidates else None,
     }
     kwargs.update(scheduler_kwargs or {})
+    # Compile-ledger arm (bench `compile` section): reuse the process
+    # ledger if one is already enabled, otherwise enable for this arm and
+    # disable after — the interleaved ledger-OFF arms must run the true
+    # passthrough path or the overhead measurement lies. Enabled BEFORE
+    # the Gateway exists: process workers inherit the ledger decision at
+    # spawn time (the child gets --compile-ledger only if the parent's
+    # ledger is live when _make_worker runs).
+    led = led_owned = None
+    warm_tok: dict = {"seq": None}
+    if compile_ledger:
+        from ..obs import compile_ledger as _cl
+
+        led = _cl.current()
+        if led is None:
+            led = led_owned = _cl.enable()
+    # Memory-ledger arm (bench `memory` section): same reuse-or-own
+    # contract as the compile ledger — the interleaved OFF arms must run
+    # the true passthrough path or the overhead measurement lies.
+    mled = mled_owned = None
+    if memory_ledger:
+        from ..obs import memory as _mem
+
+        mled = _mem.current()
+        if mled is None:
+            mled = mled_owned = _mem.enable()
     gateway = Gateway(
-        n_workers=n_workers, scheduler_kwargs=kwargs, tracer=tracer
+        n_workers=n_workers,
+        scheduler_kwargs=kwargs,
+        tracer=tracer,
+        worker_backend=worker_backend,
     )
     scraper = None
     if prom_scrape_s is not None:
@@ -268,28 +301,6 @@ def run_loadgen(
                 metrics=gateway.metrics,
             )
         )
-    # Compile-ledger arm (bench `compile` section): reuse the process
-    # ledger if one is already enabled, otherwise enable for this arm and
-    # disable after — the interleaved ledger-OFF arms must run the true
-    # passthrough path or the overhead measurement lies.
-    led = led_owned = None
-    warm_tok: dict = {"seq": None}
-    if compile_ledger:
-        from ..obs import compile_ledger as _cl
-
-        led = _cl.current()
-        if led is None:
-            led = led_owned = _cl.enable()
-    # Memory-ledger arm (bench `memory` section): same reuse-or-own
-    # contract as the compile ledger — the interleaved OFF arms must run
-    # the true passthrough path or the overhead measurement lies.
-    mled = mled_owned = None
-    if memory_ledger:
-        from ..obs import memory as _mem
-
-        mled = _mem.current()
-        if mled is None:
-            mled = mled_owned = _mem.enable()
     try:
         for fleet_id, spec in specs.items():
             gateway.register_fleet(
@@ -300,6 +311,10 @@ def run_loadgen(
         if sampler is not None:
             sampler.start()
         arm_tok = led.seq() if led is not None else 0
+        # Per-CHILD warm baselines on the process backend: each worker
+        # subprocess runs its own compile ledger, and the federation
+        # bench's zero-recompile gate is per process, not per parent.
+        proc_warm_base: Dict[int, Optional[int]] = {}
 
         def _on_timed_start() -> None:
             # The warmup barrier is BOTH ledgers' warm boundary: compile
@@ -309,6 +324,12 @@ def run_loadgen(
                 warm_tok["seq"] = led.seq()
             if mled is not None:
                 mled.mark_warm()
+            if worker_backend == "process":
+                for w in gateway.live_workers():
+                    c = w.ledger_counters()
+                    proc_warm_base[w.worker_id] = (
+                        c.get("compiles", 0) if c else None
+                    )
 
         measure_from = {f: warmup_per_fleet for f in specs}
         report = asyncio.run(
@@ -318,7 +339,11 @@ def run_loadgen(
                 measure_from,
                 on_timed_start=(
                     None
-                    if (led is None and mled is None)
+                    if (
+                        led is None
+                        and mled is None
+                        and worker_backend != "process"
+                    )
                     else _on_timed_start
                 ),
             )
@@ -328,6 +353,7 @@ def run_loadgen(
             {
                 "fleets": n_fleets,
                 "workers": n_workers,
+                "worker_backend": worker_backend,
                 "events_per_fleet": events_per_fleet,
                 "warmup_per_fleet": warmup_per_fleet,
                 "shard_totals": snap["shard_totals"],
@@ -337,6 +363,24 @@ def run_loadgen(
                 ],
             }
         )
+        if worker_backend == "process":
+            # Per-child compile view: total compiles and the timed-phase
+            # delta against the warm baseline (None when the child runs
+            # without a ledger).
+            per_proc: Dict[str, dict] = {}
+            for w in gateway.live_workers():
+                c = w.ledger_counters()
+                base = proc_warm_base.get(w.worker_id)
+                total = c.get("compiles", 0) if c else None
+                per_proc[f"w{w.worker_id}"] = {
+                    "compiles": total,
+                    "warm_phase_compiles": (
+                        total - base
+                        if total is not None and base is not None
+                        else None
+                    ),
+                }
+            report["proc_workers"] = per_proc
         if prom_scrape_s is not None:
             report["prom_scrape_errors"] = snap["counters"].get(
                 "prom_scrape_error", 0

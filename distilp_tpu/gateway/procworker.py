@@ -1,0 +1,512 @@
+"""Process-backed shard workers: one subprocess per worker, thin RPC.
+
+A ``ProcShardWorker`` IS a ``ShardWorker`` — same daemon thread, same
+queue, same submit/stop/read contract — except the objects in its
+``shards`` dict are ``SchedulerProxy`` instances: every scheduler method
+a queued closure touches (``handle``, ``dump_state``, ``health`` …) is
+forwarded over a length-prefixed RPC on a private Unix domain socket to
+a child process that hosts the real ``Scheduler``. The child has its own
+Python interpreter and its own XLA runtime, so N process workers solve
+on N GILs and N device runtimes — the scaling the thread backend cannot
+reach (measured 1.68x at 2 thread workers, negative at 4: one GIL, one
+process-wide XLA runtime).
+
+Why this shape and not multiprocessing:
+
+- ``subprocess.Popen([sys.executable, "-m", …])`` gives the child a
+  FRESH interpreter. ``fork`` after jax initializes is undefined
+  behavior (XLA runtime state forks mid-flight); ``spawn`` via
+  multiprocessing drags a pickled parent context we don't want. The
+  child imports jax lazily, on the first shard build — same discipline
+  dlint enforces on every serving-tier module (DLP013).
+- The parent binds and listens BEFORE spawning, so the child's connect
+  never races the listener; the socket lives in a mode-0700 tempdir, so
+  the pickle channel is private to this uid (pickle over a socket is an
+  RCE vector only if something else can write to it — nothing can).
+- Framing is 8-byte big-endian length + pickle payload. One
+  request/one reply, strictly serialized under the parent's RPC lock:
+  the worker thread is the only steady-state caller, but control-plane
+  probes (health under load) share the channel, and interleaved frames
+  would corrupt it.
+
+The RPC carries only plain data: events and ``dump_state`` blobs are
+already picklable by the snapshot contract, and ``PlacementView``
+results cross the wire as ``model_dump()`` dicts (rebuilt parent-side
+via ``model_validate`` — the exact round trip ``dump_state`` already
+proves bit-exact), so no jax array ever crosses a process boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+from typing import Any, Callable, Dict, Optional
+
+from ..sched.metrics import SchedulerMetrics
+from ..utils.lockwatch import make_lock
+from .worker import ShardWorker
+
+_LEN = struct.Struct(">Q")
+
+# Scheduler methods whose return value is a PlacementView (or None):
+# converted to a wire dict child-side, rebuilt parent-side.
+_VIEW_METHODS = frozenset({"handle", "handle_coalesced", "latest"})
+
+
+# -- framing (shared by both ends) ----------------------------------------
+
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_frame(sock: socket.socket) -> Optional[Any]:
+    """One framed object, or None on clean EOF at a frame boundary."""
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise EOFError("peer closed mid-frame")
+    return pickle.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if buf:
+                # Partial bytes then EOF: a torn connection, never a
+                # clean shutdown — must not parse as a (corrupt) frame.
+                raise EOFError("peer closed mid-frame")
+            return None
+        buf += chunk
+    return buf
+
+
+def _view_to_wire(view) -> Optional[dict]:
+    """PlacementView -> plain dict (no jax leaves cross the socket)."""
+    if view is None:
+        return None
+    if not hasattr(view, "result") or not hasattr(view, "mode"):
+        # Stub schedulers (tests) return plain picklable values; only a
+        # real PlacementView needs the model_dump round trip.
+        return view
+    return {
+        "__placement_view__": 1,
+        "result": view.result.model_dump(),
+        "seq": view.seq,
+        "fleet_seq": view.fleet_seq,
+        "events_behind": view.events_behind,
+        "age_s": view.age_s,
+        "mode": view.mode,
+        "key": tuple(view.key) if view.key is not None else None,
+        "twin_p95_s": view.twin_p95_s,
+        "risk_selected": view.risk_selected,
+    }
+
+
+def _view_from_wire(wire: Optional[dict]):
+    if wire is None:
+        return None
+    if not (isinstance(wire, dict) and wire.get("__placement_view__")):
+        return wire  # stub schedulers may return plain picklable values
+    from ..solver.result import HALDAResult
+    from ..sched.scheduler import PlacementView
+
+    return PlacementView(
+        result=HALDAResult.model_validate(wire["result"]),
+        seq=wire["seq"],
+        fleet_seq=wire["fleet_seq"],
+        events_behind=wire["events_behind"],
+        age_s=wire["age_s"],
+        mode=wire["mode"],
+        key=wire["key"],
+        twin_p95_s=wire["twin_p95_s"],
+        risk_selected=wire["risk_selected"],
+    )
+
+
+def resolve_factory(spec: str) -> Callable:
+    """'package.module:callable' -> the callable (shared by both ends:
+    the Gateway validates it parent-side; the child imports it to build).
+    """
+    mod_name, sep, attr = spec.partition(":")
+    if not sep or not mod_name or not attr:
+        raise ValueError(
+            f"scheduler factory spec must be 'module:callable', got {spec!r}"
+        )
+    import importlib
+
+    fn = getattr(importlib.import_module(mod_name), attr)
+    if not callable(fn):
+        raise TypeError(f"factory {spec!r} resolved to non-callable {fn!r}")
+    return fn
+
+
+# -- parent side ----------------------------------------------------------
+
+
+class _MetricsView:
+    """Read-only snapshot of a child scheduler's metrics, shaped like the
+    live ``SchedulerMetrics`` surface the gateway's read closures use
+    (``.counters`` mapping + ``.snapshot()``)."""
+
+    def __init__(self, counters: dict, snapshot: dict):
+        self.counters = counters
+        self._snapshot = snapshot
+
+    def snapshot(self) -> dict:
+        return dict(self._snapshot)
+
+
+class SchedulerProxy:
+    """Parent-side stand-in for one child-hosted ``Scheduler``.
+
+    Quacks exactly like the scheduler surface the gateway's queued
+    closures touch, so ``_tick_closure``/``dump_shard``/``healthz`` run
+    unchanged. Methods here are called ON the worker thread (or from
+    quiescent control-plane reads); the owning worker's RPC lock
+    serializes the channel either way.
+    """
+
+    def __init__(self, owner: "ProcShardWorker", key: str):
+        self._owner = owner
+        self._key = key
+
+    def _call(self, method: str, *args, **kwargs):
+        out = self._owner.rpc(
+            {
+                "op": "call",
+                "key": self._key,
+                "method": method,
+                "args": args,
+                "kwargs": kwargs,
+            }
+        )
+        if method in _VIEW_METHODS:
+            return _view_from_wire(out)
+        return out
+
+    # the tick surface
+    def handle(self, event, pressure: bool = False):
+        if pressure:
+            return self._call("handle", event, pressure=True)
+        return self._call("handle", event)
+
+    def handle_coalesced(self, events, pressure: bool = False):
+        return self._call("handle_coalesced", events, pressure=pressure)
+
+    def latest(self):
+        return self._call("latest")
+
+    # the snapshot chain (bit-exact blobs pass through untouched)
+    def dump_state(self) -> dict:
+        return self._call("dump_state")
+
+    def load_state(self, state: dict) -> None:
+        self._call("load_state", state)
+
+    # the read surface
+    def health_snapshot(self) -> dict:
+        return self._call("health_snapshot")
+
+    def metrics_snapshot(self) -> dict:
+        return self._call("metrics_snapshot")
+
+    @property
+    def health(self) -> str:
+        return self._owner.rpc(
+            {"op": "getattr", "key": self._key, "name": "health"}
+        )
+
+    @property
+    def metrics(self) -> _MetricsView:
+        out = self._owner.rpc({"op": "metrics", "key": self._key})
+        return _MetricsView(out["counters"], out["snapshot"])
+
+    # the control surface (autoscaler spec_k actuation)
+    @property
+    def spec_k(self) -> int:
+        return self._owner.rpc(
+            {"op": "getattr", "key": self._key, "name": "spec_k"}
+        )
+
+    @spec_k.setter
+    def spec_k(self, k: int) -> None:
+        self._owner.rpc(
+            {"op": "setattr", "key": self._key, "name": "spec_k", "value": k}
+        )
+
+    def close(self) -> None:
+        """Drop + close the child-side scheduler (idempotent, best
+        effort: a dead child already closed everything the hard way)."""
+        try:
+            self._owner.rpc({"op": "drop", "key": self._key})
+        except Exception:  # dlint: disable=DLP017 best-effort teardown: a dead child already dropped everything; the worker's stop() path counts real RPC failures
+            pass
+
+
+class ProcShardWorker(ShardWorker):
+    """A ShardWorker whose shards live in a dedicated subprocess.
+
+    The parent keeps the thread + queue (closures, FIFO ordering, the
+    submit/stop contract, coalescing — all parent-side and unchanged);
+    only the scheduler calls inside those closures cross the socket.
+    Unsupported with cross-shard combine, chaos ``fault_hook`` injection
+    and callable scheduler factories — the Gateway gates those off for
+    this backend (each needs in-process object sharing).
+    """
+
+    def __init__(
+        self,
+        worker_id: int,
+        metrics: SchedulerMetrics,
+        *,
+        python: Optional[str] = None,
+        spawn_timeout_s: float = 60.0,
+        compile_ledger: bool = False,
+    ):
+        self._sock_dir = tempfile.mkdtemp(prefix=f"distilp-pw{worker_id}-")
+        path = os.path.join(self._sock_dir, "rpc.sock")
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(path)
+        self._listener.listen(1)
+        cmd = [
+            python or sys.executable,
+            "-m",
+            "distilp_tpu.gateway.procworker",
+            "--socket",
+            path,
+        ]
+        if compile_ledger:
+            cmd.append("--compile-ledger")
+        self._proc = subprocess.Popen(cmd)
+        self._listener.settimeout(spawn_timeout_s)
+        try:
+            self._conn, _ = self._listener.accept()
+        except socket.timeout:
+            self._proc.kill()
+            raise RuntimeError(
+                f"process worker {worker_id} child did not connect within "
+                f"{spawn_timeout_s}s"
+            )
+        self._conn.settimeout(None)
+        # Serializes request/reply pairs on the one channel: the worker
+        # thread is the steady-state caller but control-plane reads
+        # (health probes under load, ledger snapshots) share it.
+        self._rpc_lock = make_lock("procworker.rpc")
+        super().__init__(worker_id, metrics)
+        self.rpc({"op": "ping"})  # fail fast if the child can't serve
+
+    # -- channel -----------------------------------------------------------
+
+    def rpc(self, req: dict) -> Any:
+        with self._rpc_lock:
+            send_frame(self._conn, req)
+            reply = recv_frame(self._conn)
+        if reply is None:
+            raise EOFError(
+                f"process worker {self.worker_id} child exited "
+                f"(rc={self._proc.poll()})"
+            )
+        if reply.get("ok"):
+            return reply.get("result")
+        exc = reply.get("exc")
+        if isinstance(exc, BaseException):
+            raise exc
+        raise RuntimeError(f"process worker {self.worker_id}: {exc}")
+
+    # -- shard lifecycle ---------------------------------------------------
+
+    def create_shard(self, key: str, build=None, state=None, spec=None):
+        """Build the shard IN the child from its picklable ``spec``; the
+        parent installs a proxy. Runs as a queued closure so registration
+        keeps the thread backend's FIFO placement behind queued work."""
+        if spec is None:
+            raise RuntimeError(
+                "process workers need a picklable build spec (a callable "
+                "scheduler_factory cannot cross a process boundary — pass "
+                "a 'module:callable' factory string instead)"
+            )
+
+        def _do():
+            self.rpc({"op": "build", "key": key, "spec": spec, "state": state})
+            self.shards[key] = SchedulerProxy(self, key)
+
+        self.call(_do)
+
+    # -- shutdown ----------------------------------------------------------
+
+    def stop(self, join: bool = True, timeout: float = 5.0) -> None:
+        """Base stop drains the queue and closes every proxy (child-side
+        drops), then the child itself is stopped and reaped. ``join`` is
+        forced: the child teardown RPC must not race queued drop RPCs."""
+        with self._submit_lock:
+            already = self._stopped
+        super().stop(join=True, timeout=timeout)
+        if already:
+            return
+        try:
+            self.rpc({"op": "stop"})
+        except Exception:  # dlint: disable=DLP017 teardown race: the child may have exited on socket EOF before the stop RPC lands; proc.wait/kill below is the enforcement
+            pass
+        try:
+            self._proc.wait(timeout=timeout)
+        except Exception:  # dlint: disable=DLP017 the recovery IS the recording: a child that ignores stop gets SIGKILLed, never orphaned
+            self._proc.kill()
+        for s in (self._conn, self._listener):
+            try:
+                s.close()
+            except Exception:  # dlint: disable=DLP017 socket already torn down by the dead child; nothing to account
+                pass
+        import shutil
+
+        shutil.rmtree(self._sock_dir, ignore_errors=True)
+
+    # -- child observability (bench: per-process compile accounting) ------
+
+    def ledger_counters(self) -> Optional[dict]:
+        """The CHILD's compile-ledger counters (None when not enabled):
+        the bench's zero-warm-compiles gate reads these per process."""
+        return self.rpc({"op": "ledger_counters"})
+
+
+# -- child side -----------------------------------------------------------
+
+
+def _child_build(shards: Dict[str, Any], req: dict) -> None:
+    spec = req["spec"]
+    if spec.get("factory"):
+        factory = resolve_factory(spec["factory"])
+        devices = spec["devices"]
+        model = spec["model"]
+        if devices and all(isinstance(d, dict) for d in devices):
+            from ..common import DeviceProfile
+
+            devices = [DeviceProfile.model_validate(d) for d in devices]
+        if isinstance(model, dict):
+            from ..common import ModelProfile
+
+            model = ModelProfile.model_validate(model)
+        sched = factory(devices, model)
+    else:
+        # jax enters the child here, on first real shard build — never at
+        # module import (DLP013 discipline holds in the child too).
+        from ..common import DeviceProfile, ModelProfile
+        from ..sched.scheduler import Scheduler
+
+        devices = [
+            DeviceProfile.model_validate(d) for d in spec["devices"]
+        ]
+        model = (
+            ModelProfile.model_validate(spec["model"])
+            if spec.get("model") is not None
+            else None
+        )
+        sched = Scheduler(devices, model, **dict(spec.get("kwargs") or {}))
+    if req.get("state") is not None:
+        sched.load_state(req["state"])
+    shards[req["key"]] = sched
+
+
+def _child_dispatch(shards: Dict[str, Any], req: dict) -> Any:
+    op = req["op"]
+    if op == "ping":
+        return os.getpid()
+    if op == "build":
+        _child_build(shards, req)
+        return None
+    if op == "call":
+        sched = shards[req["key"]]
+        out = getattr(sched, req["method"])(
+            *req.get("args", ()), **req.get("kwargs", {})
+        )
+        if req["method"] in _VIEW_METHODS:
+            return _view_to_wire(out)
+        return out
+    if op == "getattr":
+        return getattr(shards[req["key"]], req["name"])
+    if op == "setattr":
+        setattr(shards[req["key"]], req["name"], req["value"])
+        return None
+    if op == "metrics":
+        m = shards[req["key"]].metrics
+        return {"counters": dict(m.counters), "snapshot": m.snapshot()}
+    if op == "drop":
+        sched = shards.pop(req["key"], None)
+        if sched is not None:
+            sched.close()
+        return None
+    if op == "ledger_counters":
+        from ..obs import compile_ledger as _cl
+
+        led = _cl.current()
+        return led.counters() if led is not None else None
+    raise ValueError(f"unknown procworker op {op!r}")
+
+
+def child_main(argv: Optional[list] = None) -> int:
+    """The worker subprocess: connect, then serve one request at a time.
+
+    Single-threaded by design — the parent's worker thread already
+    serializes shard work, so a concurrent child would only add races.
+    Clean EOF (parent died or closed) exits 0 after closing shards: an
+    orphaned child must not outlive its gateway.
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="distilp-procworker")
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--compile-ledger", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.compile_ledger:
+        from ..obs import compile_ledger as _cl
+
+        _cl.enable()
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(args.socket)
+    shards: Dict[str, Any] = {}
+    try:
+        while True:
+            req = recv_frame(sock)
+            if req is None:
+                break
+            if req.get("op") == "stop":
+                send_frame(sock, {"ok": True, "result": None})
+                break
+            try:
+                result = _child_dispatch(shards, req)
+                reply = {"ok": True, "result": result}
+            except BaseException as e:  # dlint: disable=DLP017 not swallowed: the exception crosses the wire in the reply and re-raises parent-side, where the worker's metrics sink lives
+                try:
+                    pickle.dumps(e)
+                    reply = {"ok": False, "exc": e}
+                except Exception:  # dlint: disable=DLP017 the failure is not swallowed — it crosses the wire as a repr string and re-raises parent-side
+                    reply = {"ok": False, "exc": f"{type(e).__name__}: {e}"}
+            send_frame(sock, reply)
+    finally:
+        for sched in shards.values():
+            try:
+                sched.close()
+            except Exception:  # dlint: disable=DLP017 child exit path: the process dies next line, there is no sink left to record into
+                pass
+        try:
+            sock.close()
+        except Exception:  # dlint: disable=DLP017 child exit path: the process is exiting, the parent's EOF read is the signal
+            pass
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    sys.exit(child_main())

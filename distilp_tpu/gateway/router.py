@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import bisect
 import hashlib
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 def _ring_hash(label: str) -> int:
@@ -30,17 +30,36 @@ def _ring_hash(label: str) -> int:
 
 
 class ConsistentHashRouter:
-    """Stable shard->worker assignment over a virtual-node hash ring."""
+    """Stable shard->worker assignment over a virtual-node hash ring.
 
-    def __init__(self, n_workers: int, replicas: int = 64):
-        if n_workers < 1:
+    ``worker_ids`` generalizes the ring to a sparse id set for the
+    autoscaler: retiring worker 1 of {0, 1, 2} leaves ids {0, 2} on the
+    ring, and only worker 1's slices move (~1/N churn, same property as
+    growing N). When ``worker_ids`` is exactly ``range(n_workers)`` the
+    ring is label-for-label identical to the fixed-count form, so
+    snapshot/restore routing semantics are unchanged.
+    """
+
+    def __init__(
+        self,
+        n_workers: Optional[int] = None,
+        replicas: int = 64,
+        worker_ids: Optional[Sequence[int]] = None,
+    ):
+        if worker_ids is None:
+            if n_workers is None:
+                raise ValueError("router needs n_workers or worker_ids")
+            worker_ids = range(n_workers)
+        ids = sorted(set(int(w) for w in worker_ids))
+        if len(ids) < 1:
             raise ValueError("router needs at least one worker")
         if replicas < 1:
             raise ValueError("router needs at least one virtual node")
-        self.n_workers = n_workers
+        self.worker_ids = ids
+        self.n_workers = len(ids)
         self.replicas = replicas
         ring: List[Tuple[int, int]] = []
-        for w in range(n_workers):
+        for w in ids:
             for v in range(replicas):
                 ring.append((_ring_hash(f"worker:{w}#{v}"), w))
         ring.sort()
@@ -59,10 +78,15 @@ class ConsistentHashRouter:
         return {k: self.owner(k) for k in shard_keys}
 
     def load(self, shard_keys: Sequence[str]) -> List[int]:
-        """Shards per worker — the balance gauge the bench reports."""
+        """Shards per worker — the balance gauge the bench reports.
+
+        Positionally aligned with ``worker_ids`` (identical to the old
+        index-aligned list when ids are dense from zero).
+        """
+        slot = {w: i for i, w in enumerate(self.worker_ids)}
         counts = [0] * self.n_workers
         for k in shard_keys:
-            counts[self.owner(k)] += 1
+            counts[slot[self.owner(k)]] += 1
         return counts
 
 

@@ -146,6 +146,48 @@ class ShardWorker:
         """Commands queued but not yet finished (the backpressure gauge)."""
         return self._q.qsize()
 
+    # -- the shard lifecycle seam ------------------------------------------
+    #
+    # Gateway talks to shards ONLY through these four verbs plus queued
+    # closures over ``self.shards``. ``ProcShardWorker`` overrides just
+    # ``create_shard`` (a subprocess cannot run the parent's build closure;
+    # it needs the picklable ``spec``) — everything else rides the same
+    # closures because its ``shards`` dict holds RPC proxies that quack
+    # like ``Scheduler``.
+
+    def create_shard(self, key: str, build: Callable, state=None, spec=None):
+        """Build a shard's scheduler ON the worker thread and install it.
+
+        ``build`` is a zero-arg closure returning a ready ``Scheduler``;
+        ``spec`` is the picklable equivalent that process workers need
+        (thread workers ignore it). ``state`` (a ``dump_state`` blob) is
+        loaded before the shard is published, so the first tick it ever
+        serves is already warm-restored.
+        """
+        def _do():
+            sched = build()
+            if state is not None:
+                sched.load_state(state)
+            self.shards[key] = sched
+
+        self.call(_do)
+
+    def dump_shard(self, key: str):
+        """Snapshot one shard behind everything already queued (FIFO)."""
+        return self.call(lambda: self.shards[key].dump_state())
+
+    def load_shard(self, key: str, state) -> None:
+        """Restore a snapshot into an existing shard (re-arms warm audit)."""
+        self.call(lambda: self.shards[key].load_state(state))
+
+    def drop_shard(self, key: str) -> None:
+        """Remove and close one shard (the source side of a migration)."""
+        def _do():
+            sched = self.shards.pop(key)
+            sched.close()
+
+        self.call(_do)
+
     def stop(self, join: bool = True, timeout: float = 5.0) -> None:
         """Graceful shutdown: drain the queue, close every scheduler.
 

@@ -581,8 +581,15 @@ def build_signals(
         suffix = name[len(QUEUE_DEPTH_PREFIX):]
         if not suffix.isdigit():
             continue
-        latest = timeline.latest(name)
-        depth = latest[1] if latest else 0.0
+        if now is None:
+            latest = timeline.latest(name)
+            depth = latest[1] if latest else 0.0
+        else:
+            # Point-in-time read: live callers pass now == the newest
+            # sample (same answer as latest); an offline controller
+            # replay passes a historical t and must not see the future.
+            at = timeline.value_at(name, now)
+            depth = at if at is not None else 0.0
         total_depth += depth
         workers.append(
             WorkerSignal(

@@ -103,6 +103,23 @@ class Timeline:
             ring = self._series.get(name)
             return ring[-1] if ring else None
 
+    def value_at(self, name: str, t: float) -> Optional[float]:
+        """The last recorded value at or before ``t`` (None when no
+        sample that old exists). On a live timeline ``value_at(now)`` IS
+        ``latest``; on a dumped capture it is the point-in-time read that
+        keeps an offline replay (SLO engine, controller) honest — a
+        replayed decision at t must not see a sample from t+30."""
+        with self._lock:
+            ring = self._series.get(name)
+            if not ring:
+                return None
+            out = None
+            for pt, pv in ring:
+                if pt > t:
+                    break
+                out = pv
+            return out
+
     def bounds(self) -> Optional[Tuple[float, float]]:
         """(oldest, newest) timestamp across every series; None if empty.
 

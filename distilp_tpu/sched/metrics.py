@@ -180,6 +180,23 @@ METRIC_REGISTRY = {
     "http_conflict": "HTTP 409s (shard exists but nothing servable yet)",
     "http_too_many_requests": "HTTP 429s (queue full; Retry-After returned)",
     "http_internal_error": "HTTP 500s (unexpected server-side failure)",
+    # -- dynamic fleet / live migration (gateway) -------------------------
+    "workers_spawned": "Workers added live to a dynamic gateway",
+    "workers_retired": "Workers drained and stopped live",
+    "shards_migrated": "Shards moved between workers (warm, zero cold ticks)",
+    "migration_parked": "Events parked during a migration flip and replayed "
+    "onto the destination (none lost, none doubled)",
+    "migration_failed": "Migration flips that failed (routing unchanged, "
+    "source kept serving)",
+    # -- closed-loop autoscaler (distilp_tpu.control) ---------------------
+    "control_actions": "Controller actions emitted (all kinds)",
+    "control_scale_out": "Scale-out actions (spawn one worker + rebalance)",
+    "control_scale_in": "Scale-in actions (retire one worker after drain)",
+    "control_degrade_on": "Forced-degrade admissions switched ON",
+    "control_degrade_off": "Forced-degrade admissions switched OFF",
+    "control_spec_k": "spec_k adaptations applied fleet-wide",
+    "control_hold": "Decisions suppressed by cooldown or band edges",
+    "control_errors": "Control ticks that raised (loop survived; counted)",
     # -- observability layer ----------------------------------------------
     "flight_dumps": "Flight-recorder post-mortem dumps written",
     "health_state": "Shard health as a gauge (0 healthy, 1 degraded, 2 broken)",

@@ -35,8 +35,11 @@ from .arrivals import ScheduledEvent
 def _view_invalid(view) -> bool:
     """The ChaosReport structural-validity check, minus the L cross-check
     (open-loop traces are drift-only by default; a coalesced or near-match
-    serve still must be a well-formed placement)."""
-    r = view.result
+    serve still must be a well-formed placement). Stub schedulers (the
+    process-worker test factory) serve plain dicts — nothing to check."""
+    r = getattr(view, "result", None)
+    if r is None:
+        return False
     return r.k < 1 or len(r.w) != len(r.n) or any(w < 0 for w in r.w)
 
 
@@ -94,7 +97,7 @@ async def execute_openloop(
                 on_event(item, "shed")
             return
         done_ms = (loop.time() - target) * 1e3
-        if view.events_behind > 0:
+        if getattr(view, "events_behind", 0) > 0:
             # The tick produced no fresh placement (solve failed); the
             # served answer is the previous one — an error under open
             # loop just like under replay.
@@ -122,7 +125,7 @@ async def execute_openloop(
         # record the lateness — never skip, never throttle.
         counts["offered"] += 1
         dispatch_late_ms.append(max(0.0, (loop.time() - target) * 1e3))
-        for w in gateway.workers:
+        for w in gateway.live_workers():
             max_depth_seen = max(max_depth_seen, w.depth())
         tasks.append(asyncio.ensure_future(_fire(item, target)))
     if tasks:
@@ -234,6 +237,101 @@ def shed_violations(gateway: Gateway, flight) -> List[str]:
     return out
 
 
+def control_violations(gateway: Gateway, loop) -> List[str]:
+    """Closed-loop accounting reconciliation (empty = contract held).
+
+    The autoscaler's version of ``shed_violations``: every action the
+    control loop took must be explained by the counters AND — when a
+    flight recorder is attached — by a flight record on the ``control``
+    ring, in order, kind-for-kind. A counted-but-unrecorded decision (or
+    an actuation the fleet state does not reflect) is a violation.
+    """
+    out: List[str] = []
+    counters = gateway.metrics.snapshot()["counters"]
+    actions = list(loop.actions)
+    n = counters.get("control_actions", 0)
+    if n != len(actions):
+        out.append(
+            f"control accounting: control_actions={n} but the loop "
+            f"took {len(actions)} action(s)"
+        )
+    per_kind: Dict[str, int] = {}
+    for a in actions:
+        per_kind[a.kind] = per_kind.get(a.kind, 0) + 1
+    for kind, ctr in (
+        ("scale_out", "control_scale_out"),
+        ("scale_in", "control_scale_in"),
+        ("degrade_on", "control_degrade_on"),
+        ("degrade_off", "control_degrade_off"),
+        ("spec_k", "control_spec_k"),
+    ):
+        if counters.get(ctr, 0) != per_kind.get(kind, 0):
+            out.append(
+                f"control accounting: {ctr}={counters.get(ctr, 0)} but "
+                f"{per_kind.get(kind, 0)} {kind} action(s) were taken"
+            )
+    # Actuation must be reflected in the fleet counters: every scale_out
+    # spawned a worker, every scale_in retired one, and no migration may
+    # have failed (a failed flip leaves routing on the source — correct,
+    # but the autoscale smoke demands the clean path).
+    if counters.get("workers_spawned", 0) != per_kind.get("scale_out", 0):
+        out.append(
+            f"control accounting: workers_spawned="
+            f"{counters.get('workers_spawned', 0)} but "
+            f"{per_kind.get('scale_out', 0)} scale_out action(s)"
+        )
+    if counters.get("workers_retired", 0) != per_kind.get("scale_in", 0):
+        out.append(
+            f"control accounting: workers_retired="
+            f"{counters.get('workers_retired', 0)} but "
+            f"{per_kind.get('scale_in', 0)} scale_in action(s)"
+        )
+    if counters.get("migration_failed", 0):
+        out.append(
+            f"control accounting: {counters.get('migration_failed', 0)} "
+            "migration(s) failed"
+        )
+    if loop.errors:
+        out.append(
+            f"control accounting: {loop.errors} control tick(s) raised"
+        )
+    flight = gateway.flight
+    if flight is None:
+        if actions:
+            out.append(
+                f"control accounting: {len(actions)} action(s) with no "
+                "flight recorder attached (decisions must be recorded)"
+            )
+        return out
+    ring = (
+        list(flight.snapshot("control")) if "control" in flight.keys() else []
+    )
+    recorded = [(r.get("action") or {}).get("kind") for r in ring]
+    expect = [a.kind for a in actions]
+    # Same oldest-first eviction semantics as shed records: with no
+    # overflow the trail must match exactly; with overflow, the surviving
+    # suffix must.
+    if len(ring) < flight.capacity:
+        if recorded != expect:
+            out.append(
+                f"control accounting: flight trail {recorded} does not "
+                f"match actions {expect}"
+            )
+    elif recorded != expect[len(expect) - len(recorded):]:
+        out.append(
+            "control accounting: flight trail (overflowed) does not "
+            "match the action suffix"
+        )
+    for r in ring:
+        if "signals" not in r:
+            out.append(
+                "control accounting: flight record for "
+                f"{(r.get('action') or {}).get('kind')} at t={r.get('t')} "
+                "carries no signals snapshot"
+            )
+    return out
+
+
 async def _warmup(
     gateway: Gateway, specs: Dict[str, dict], per_fleet: int, seed: int
 ) -> None:
@@ -280,6 +378,11 @@ def run_openloop(
     timeline=None,
     timeline_period_s: float = 0.05,
     settle_s: float = 0.0,
+    worker_backend: str = "thread",
+    scheduler_factory=None,
+    autoscale=None,
+    control_period_s: float = 0.25,
+    capacity_probe_events: int = 0,
 ) -> dict:
     """One full open-loop arm: build, warm, fire, report, tear down.
 
@@ -297,6 +400,17 @@ def run_openloop(
     keeps sampling AFTER the schedule drains — the recovery window a
     burn-rate alert needs to clear, which is exactly what the smoke
     asserts. ``timeline`` alone (no config) just records, no alerting.
+
+    Autoscale arm (``autoscale``, a ``control.ControlPolicy``): the
+    gateway is built dynamic (spawn/retire/migrate enabled, backed by
+    ``worker_backend`` — "thread" or "process"), a ``ControlLoop`` runs
+    for the flood's whole life, and — unless the probe is skipped — a
+    post-warmup closed-loop probe of ``capacity_probe_events`` per fleet
+    populates the ``/signals`` headroom denominator, refreshed
+    deterministically per worker-count change (no live re-probe inside
+    the loop). The report grows a ``control`` block with the policy,
+    every action taken, and the flight-record reconciliation verdict
+    (``control_violations``).
     """
     kwargs = {
         "mip_gap": mip_gap,
@@ -307,11 +421,16 @@ def run_openloop(
     kwargs.update(scheduler_kwargs or {})
     gateway = Gateway(
         n_workers=n_workers, scheduler_kwargs=kwargs,
+        scheduler_factory=scheduler_factory,
         flight=flight, tracer=tracer,
+        worker_backend=worker_backend,
+        dynamic=autoscale is not None,
     )
     engine = None
     sampler = None
-    if slo_config is not None and timeline is None:
+    control_loop = None
+    capacity_probe = None
+    if (slo_config is not None or autoscale is not None) and timeline is None:
         from ..obs.timeline import Timeline
 
         timeline = Timeline()
@@ -342,8 +461,11 @@ def run_openloop(
                 slo_config, timeline, metrics=gateway.metrics,
                 tracer=tracer, flight=flight,
             )
-            gateway.attach_slo(engine, timeline)
         if timeline is not None:
+            # engine may be None (timeline-only / autoscale-only arms):
+            # the read surface still needs gateway.timeline wired so
+            # /signals — and the control loop reading it — can build.
+            gateway.attach_slo(engine, timeline)
             from ..obs.timeline import TimelineSampler
 
             sampler = gateway.attach_sampler(
@@ -363,6 +485,19 @@ def run_openloop(
             asyncio.run(
                 _warmup(gateway, specs, warmup_per_fleet, warmup_seed)
             )
+        if autoscale is not None and capacity_probe_events > 0 and (
+            gateway.capacity_eps is None
+        ):
+            # Satellite: the /signals headroom denominator comes from a
+            # closed-loop probe of THIS gateway (same fleets, same
+            # workers), run while admission is still open — the probe is
+            # warm-phase work, not flood traffic to be shed.
+            # note_capacity keeps the per-worker quotient: capacity_eps
+            # refreshes deterministically on every spawn/retire.
+            capacity_probe = measure_closed_loop(
+                gateway, specs, capacity_probe_events, warmup_seed
+            )
+            gateway.note_capacity(capacity_probe["events_per_sec"])
         gateway.configure_admission(
             max_queue_depth=max_queue_depth,
             coalesce=coalesce,
@@ -376,6 +511,14 @@ def run_openloop(
             # executable per committed bucket x lane shape): trace all of
             # it BEFORE the warm boundary, or the flood pays it live.
             combine_warm = gateway.warm_combine()
+        if autoscale is not None:
+            from ..control import Controller, ControlLoop
+
+            control_loop = ControlLoop(
+                gateway, Controller(autoscale), period_s=control_period_s
+            )
+            gateway.attach_controller(control_loop)
+            control_loop.start()
         if _mled is not None:
             # The admission flip IS openloop's warm boundary: everything
             # before it (fleet registration, per-fleet warmup solves,
@@ -432,6 +575,27 @@ def run_openloop(
                 report["combine"][ctr] = totals.get(ctr, 0)
         if flight is not None:
             report["shed_violations"] = shed_violations(gateway, flight)
+        if control_loop is not None:
+            control_loop.stop()
+            report["control"] = {
+                "policy": autoscale.model_dump(),
+                "actions": [a.model_dump() for a in control_loop.actions],
+                "workers_final": len(gateway.live_workers()),
+                "worker_backend": worker_backend,
+                "capacity_probe": capacity_probe,
+                "capacity_eps": gateway.capacity_eps,
+                "counters": {
+                    k: int(v)
+                    for k, v in sorted(snap["counters"].items())
+                    if k.startswith("control_")
+                    or k in (
+                        "workers_spawned", "workers_retired",
+                        "shards_migrated", "migration_parked",
+                        "migration_failed",
+                    )
+                },
+                "violations": control_violations(gateway, control_loop),
+            }
         if _led is not None:
             arm_events = _led.events_since(_led_tok)
             warm_events = _led.events_since(_led_warm_tok)

@@ -781,6 +781,65 @@ def main() -> int:
         f"{trace.lp_iters_executed} LP iterations "
         f"({trace.iters_to_certify} to certify), final gap {final_gap}"
     )
+
+    # ------------------------------------------------------------------
+    # 20. Close the loop: step 15 WATCHED the flood page; now the page
+    #     STEERS the fleet. Same flood shape, 10 fleets, one PROCESS
+    #     worker (schedulers live in a subprocess behind the unix-socket
+    #     RPC — the stub factory keeps the children jax-free and this
+    #     step inside the walkthrough's minute budget; the bench
+    #     federation section runs the real scheduler in children). A
+    #     ControlLoop reads the same /signals payload the HTTP surface
+    #     serves, a post-warmup closed-loop probe fills the headroom
+    #     denominator, and the committed policy does the rest: the page
+    #     alert votes, the controller flips forced-degrade ON and spawns
+    #     worker 1, the ring rebalance migrates shards into the fresh
+    #     subprocess WARM (zero cold ticks), and once the burst drains
+    #     the alert clears and degrade lifts. Every decision is counted
+    #     AND flight-recorded with the signals snapshot that justified
+    #     it — the `violations` reconciliation (trail vs counters vs
+    #     actuations) is the same audit `make smoke-autoscale` gates,
+    #     and `solver autoscale` replays the dumped timeline through the
+    #     same Controller byte-for-byte offline (README "Closed-loop
+    #     autoscaling & process workers").
+    # ------------------------------------------------------------------
+    from distilp_tpu.control import ControlPolicy
+
+    as_cfg = ArrivalConfig(
+        seed=21, duration_s=40.0, base_rate=4.0, diurnal_amplitude=0.5,
+        diurnal_period_s=40.0, n_regions=2, burst_rate_per_region=0.08,
+        burst_factor=6.0, burst_duration_s=8.0, fleet_size=3, fleet_seed=42,
+    )
+    as_specs, as_items = generate_openloop_schedule(as_cfg, 10)
+    ctl_flight = FlightRecorder(capacity=2 * len(as_items))
+    as_arm = run_openloop(
+        "stub", as_specs, as_items, 1, time_scale=0.001,
+        max_queue_depth=2, flight=ctl_flight,
+        slo_config=SLOConfig.from_json("tests/traces/slo_live_spec.json"),
+        worker_backend="process",
+        scheduler_factory="tests.procstub:make_scheduler",
+        autoscale=ControlPolicy.from_json(
+            "tests/traces/control_live_policy.json"
+        ),
+        capacity_probe_events=3, control_period_s=0.05, settle_s=3.0,
+    )
+    ctl = as_arm["control"]
+    for a in ctl["actions"]:
+        extra = (
+            f" -> {a['target_workers']} workers"
+            if a.get("target_workers") is not None else ""
+        )
+        print(f"[20] {a['kind']:<11s}{extra}  ({a['reason']})")
+    cc = ctl["counters"]
+    print(
+        f"[20] closed loop on process workers: {as_arm['shed']} shed "
+        f"paged the SLO, {cc.get('control_scale_out', 0)} scale-out "
+        f"spawned worker(s) (final fleet {ctl['workers_final']}), "
+        f"{cc.get('shards_migrated', 0)} shard(s) migrated live, "
+        f"capacity probe {ctl['capacity_eps']:.0f} ev/s; "
+        f"{len(ctl_flight.snapshot('control'))} flight record(s) "
+        f"reconcile the trail (violations: {ctl['violations'] or 'none'})"
+    )
     return 0
 
 

@@ -1,0 +1,423 @@
+"""Closed-loop autoscaler: policy bands, hysteresis, cooldown, replay.
+
+The ``Controller`` contract pinned three ways:
+
+- **decide** is a deterministic function of (signals, now, n_workers)
+  with the two stabilizers — asymmetric hysteresis (scale-out on any
+  one vote, scale-in only after EVERY calm condition holds for
+  ``calm_hold_s``) and the scale cooldown — exercised on synthetic
+  payloads, no gateway, no clock.
+- **step** is decide + the accounting contract: every action counted
+  (``control_actions`` + per-kind) and flight-recorded WITH the signals
+  snapshot that justified it.
+- **replay** is pure: the committed timeline + committed policy
+  reproduce the committed action fixture byte-for-byte, twice.
+
+The live actuation path (ControlLoop driving a dynamic gateway) runs
+here against stub schedulers; the full process-worker flood lives in
+``make smoke-autoscale``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from distilp_tpu.control import (
+    Action,
+    ControlLoop,
+    Controller,
+    ControlPolicy,
+    actions_to_jsonl,
+)
+from distilp_tpu.gateway import Gateway
+from distilp_tpu.gateway.traces import make_fleet_from_spec
+from distilp_tpu.obs import (
+    FlightRecorder,
+    SignalsPayload,
+    SLOConfig,
+    Timeline,
+)
+from distilp_tpu.obs.slo import SLOBurnSignal, WorkerSignal
+from distilp_tpu.sched.metrics import METRIC_REGISTRY, SchedulerMetrics
+
+TRACES = "tests/traces"
+
+
+def sig(
+    depth: float = 0.0,
+    n_workers: int = 1,
+    page: bool = False,
+    alerts_open: int = 0,
+    headroom: float | None = None,
+    capacity: float | None = None,
+    mem: float | None = None,
+    trend: float | None = None,
+) -> SignalsPayload:
+    """A synthetic /signals payload: depth spread evenly over workers."""
+    slos = []
+    if page:
+        slos.append(
+            SLOBurnSignal(
+                slo="lat", budget=0.05, burn={}, firing=["page"]
+            )
+        )
+        alerts_open = max(alerts_open, 1)
+    return SignalsPayload(
+        workers=[
+            WorkerSignal(
+                worker=i,
+                queue_depth=depth / n_workers,
+                queue_depth_trend_per_s=trend,
+            )
+            for i in range(n_workers)
+        ],
+        queue_depth_total=depth,
+        slos=slos,
+        alerts_open=alerts_open,
+        max_sustainable_eps=capacity,
+        headroom_eps=headroom,
+        mem_headroom_bytes=mem,
+    )
+
+
+def policy(**kw) -> ControlPolicy:
+    base = dict(
+        min_workers=1,
+        max_workers=4,
+        scale_cooldown_s=10.0,
+        headroom_min_frac=None,
+        depth_high_per_worker=8.0,
+        calm_hold_s=5.0,
+    )
+    base.update(kw)
+    return ControlPolicy(**base)
+
+
+# -- policy document ---------------------------------------------------------
+
+
+def test_policy_fixture_parses():
+    p = ControlPolicy.from_json(f"{TRACES}/control_policy.json")
+    assert p.version == 1
+    assert (p.min_workers, p.max_workers) == (2, 4)
+    assert p.depth_high_per_worker == 8.0
+
+
+def test_policy_rejects_unknown_fields_and_versions():
+    with pytest.raises(Exception):
+        ControlPolicy(version=2)
+    with pytest.raises(Exception):
+        ControlPolicy(scale_up_aggressiveness=11)  # not in the vocabulary
+    with pytest.raises(Exception):
+        Action(t=0.0, kind="reboot_everything", reason="nope")
+
+
+def test_action_counters_are_registered():
+    # DLP019's promise, asserted directly: every counter the controller
+    # can increment is a documented METRIC_REGISTRY entry.
+    for name in (
+        "control_actions",
+        "control_scale_out",
+        "control_scale_in",
+        "control_degrade_on",
+        "control_degrade_off",
+        "control_spec_k",
+        "control_hold",
+        "control_errors",
+    ):
+        assert name in METRIC_REGISTRY
+
+
+# -- decide: bands, hysteresis, cooldown -------------------------------------
+
+
+def test_depth_vote_scales_out_and_cooldown_suppresses():
+    ctl = Controller(policy())
+    acts = ctl.decide(sig(depth=16.0), now=0.0, n_workers=1)
+    assert [a.kind for a in acts] == ["scale_out"]
+    assert acts[0].target_workers == 2
+    # Still hot 1s later: the cooldown holds the second spawn back.
+    assert ctl.decide(sig(depth=16.0, n_workers=2), 1.0, 2) == []
+    assert ctl._holds == 1
+    # Cooldown expired: the standing vote trips again.
+    acts = ctl.decide(sig(depth=32.0, n_workers=2), 10.0, 2)
+    assert [a.kind for a in acts] == ["scale_out"]
+    assert acts[0].target_workers == 3
+
+
+def test_max_workers_clamps_scale_out():
+    ctl = Controller(policy(max_workers=2))
+    assert ctl.decide(sig(depth=99.0, n_workers=2), 0.0, 2) == []
+    assert ctl._holds == 1
+
+
+def test_page_alert_votes_and_degrades():
+    ctl = Controller(policy())
+    acts = ctl.decide(sig(page=True), now=0.0, n_workers=1)
+    # Degrade is instant (bridges the spawn); both levers fire together.
+    assert [a.kind for a in acts] == ["degrade_on", "scale_out"]
+    # The page staying open does NOT re-fire degrade_on (edge-triggered).
+    assert ctl.decide(sig(page=True, n_workers=2), 1.0, 2) == []
+    acts = ctl.decide(sig(), now=2.0, n_workers=2)
+    assert [a.kind for a in acts] == ["degrade_off"]
+
+
+def test_headroom_floor_votes():
+    p = policy(headroom_min_frac=0.10, depth_high_per_worker=None)
+    ctl = Controller(p)
+    # 5 eps headroom of 100 eps capacity: below the 10% floor.
+    acts = ctl.decide(sig(headroom=5.0, capacity=100.0), 0.0, 1)
+    assert [a.kind for a in acts] == ["scale_out"]
+    assert "headroom" in acts[0].reason
+    # Plenty of headroom: no vote (and calm scale-in needs n > min).
+    ctl2 = Controller(p)
+    assert ctl2.decide(sig(headroom=50.0, capacity=100.0), 0.0, 1) == []
+
+
+def test_trend_vote():
+    ctl = Controller(
+        policy(depth_high_per_worker=None, trend_up_per_s=2.0)
+    )
+    acts = ctl.decide(sig(depth=1.0, trend=3.5), now=0.0, n_workers=1)
+    assert [a.kind for a in acts] == ["scale_out"]
+    assert "trending" in acts[0].reason
+
+
+def test_scale_in_requires_sustained_calm():
+    ctl = Controller(policy(calm_hold_s=5.0, scale_cooldown_s=0.0))
+    # Calm at t=0 starts the timer; calm at t=4.9 is not held long
+    # enough; a depth blip at t=5 RESETS it; only 5s of re-held calm
+    # finally retires a worker.
+    assert ctl.decide(sig(depth=0.0, n_workers=2), 0.0, 2) == []
+    assert ctl.decide(sig(depth=0.0, n_workers=2), 4.9, 2) == []
+    assert ctl.decide(sig(depth=30.0, n_workers=2), 5.0, 2) != []  # blip
+    assert ctl.decide(sig(depth=0.0, n_workers=3), 6.0, 3) == []
+    assert ctl.decide(sig(depth=0.0, n_workers=3), 10.0, 3) == []
+    acts = ctl.decide(sig(depth=0.0, n_workers=3), 11.0, 3)
+    assert [a.kind for a in acts] == ["scale_in"]
+    assert acts[0].target_workers == 2
+
+
+def test_scale_in_stops_at_min_workers():
+    ctl = Controller(policy(min_workers=1, calm_hold_s=0.0))
+    for t in (0.0, 1.0, 2.0):
+        assert ctl.decide(sig(depth=0.0), now=t, n_workers=1) == []
+
+
+def test_open_alert_blocks_scale_in():
+    ctl = Controller(policy(calm_hold_s=0.0, scale_cooldown_s=0.0))
+    ctl.decide(sig(alerts_open=1, n_workers=2), 0.0, 2)
+    for t in (1.0, 20.0):
+        acts = ctl.decide(sig(alerts_open=1, n_workers=2), t, 2)
+        assert all(a.kind != "scale_in" for a in acts)
+
+
+def test_spec_k_memory_lever_hysteresis():
+    ctl = Controller(
+        policy(mem_low_bytes=1e9, spec_k_low=1, spec_k_normal=4)
+    )
+    acts = ctl.decide(sig(mem=0.5e9), now=0.0, n_workers=1)
+    assert [(a.kind, a.spec_k) for a in acts] == [("spec_k", 1)]
+    # Still squeezed: no re-fire. Recovered: restore once.
+    assert ctl.decide(sig(mem=0.6e9), 1.0, 1) == []
+    acts = ctl.decide(sig(mem=2e9), now=2.0, n_workers=1)
+    assert [(a.kind, a.spec_k) for a in acts] == [("spec_k", 4)]
+    assert ctl.decide(sig(mem=2e9), 3.0, 1) == []
+
+
+# -- step: the accounting contract -------------------------------------------
+
+
+def test_step_counts_and_flight_records_every_action():
+    metrics = SchedulerMetrics()
+    flight = FlightRecorder(capacity=16)
+    ctl = Controller(policy())
+    acts = ctl.step(
+        sig(page=True), now=3.0, n_workers=1, metrics=metrics,
+        flight=flight,
+    )
+    assert [a.kind for a in acts] == ["degrade_on", "scale_out"]
+    c = metrics.counters
+    assert c["control_actions"] == 2
+    assert c["control_degrade_on"] == 1
+    assert c["control_scale_out"] == 1
+    recs = flight.snapshot("control")
+    assert len(recs) == 2
+    for rec, act in zip(recs, acts):
+        assert rec["t"] == 3.0
+        assert rec["action"] == act.model_dump()
+        # The justification rides the record: the signals snapshot.
+        assert rec["signals"]["queue_depth_total"] == 0.0
+        assert rec["signals"]["alerts_open"] == 1
+    # A held decision is counted too (cooldown suppression).
+    ctl.step(
+        sig(page=True, n_workers=2), now=4.0, n_workers=2,
+        metrics=metrics, flight=flight,
+    )
+    assert c["control_hold"] == 1
+    assert c["control_actions"] == 2  # unchanged: nothing acted
+
+
+# -- replay: the offline purity contract -------------------------------------
+
+
+def test_replay_reproduces_committed_fixture_bytes():
+    tl = Timeline.load(f"{TRACES}/slo_timeline_overload.jsonl")
+    pol = ControlPolicy.from_json(f"{TRACES}/control_policy.json")
+    cfg = SLOConfig.from_json(f"{TRACES}/slo_overload_spec.json")
+    actions = Controller.replay(tl, pol, slo_config=cfg, step_s=0.5)
+    committed = open(f"{TRACES}/control_expected_actions.jsonl").read()
+    assert actions_to_jsonl(actions) == committed
+    # Pure: a second replay of the same inputs is byte-identical.
+    again = Controller.replay(tl, pol, slo_config=cfg, step_s=0.5)
+    assert actions_to_jsonl(again) == committed
+
+
+def test_replay_follows_its_own_scale_actions():
+    tl = Timeline.load(f"{TRACES}/slo_timeline_overload.jsonl")
+    pol = ControlPolicy.from_json(f"{TRACES}/control_policy.json")
+    cfg = SLOConfig.from_json(f"{TRACES}/slo_overload_spec.json")
+    actions = Controller.replay(tl, pol, slo_config=cfg, step_s=0.5)
+    scale = [a for a in actions if a.kind in ("scale_out", "scale_in")]
+    assert scale, "fixture must exercise the scale path"
+    # target_workers walks one step at a time from the inferred start,
+    # never outside the policy band.
+    n = None
+    for a in scale:
+        if n is not None:
+            assert abs(a.target_workers - n) == 1
+        assert pol.min_workers <= a.target_workers <= pol.max_workers
+        n = a.target_workers
+
+
+def test_replay_rejects_bad_step_and_empty_timeline():
+    with pytest.raises(ValueError):
+        Controller.replay(Timeline(), ControlPolicy(), step_s=0.0)
+    assert Controller.replay(Timeline(), ControlPolicy()) == []
+
+
+def test_actions_to_jsonl_is_key_sorted():
+    a = Action(t=1.5, kind="scale_out", target_workers=2, reason="r")
+    line = actions_to_jsonl([a]).splitlines()[0]
+    keys = list(json.loads(line))
+    assert keys == sorted(keys)
+
+
+# -- the live loop against a (stub) dynamic gateway --------------------------
+
+
+def _control_gateway() -> Gateway:
+    gw = Gateway(
+        n_workers=1,
+        scheduler_factory="tests.procstub:make_scheduler",
+        dynamic=True,
+        flight=FlightRecorder(capacity=64),
+    )
+    for i in range(4):
+        fid = f"c{i:02d}"
+        gw.register_fleet(
+            fid, make_fleet_from_spec(fid, {"m": 3, "seed": 900 + i}), "stub"
+        )
+    return gw
+
+
+def test_control_loop_actuates_scale_out_and_back():
+    gw = _control_gateway()
+    try:
+        tl = Timeline()
+        gw.attach_slo(None, tl)
+        loop = ControlLoop(
+            gw,
+            Controller(
+                ControlPolicy(
+                    min_workers=1,
+                    max_workers=2,
+                    scale_cooldown_s=0.0,
+                    headroom_min_frac=None,
+                    depth_high_per_worker=8.0,
+                    calm_hold_s=4.0,
+                )
+            ),
+        )
+        # Hot: the recorded depth trips the per-worker band -> spawn.
+        tl.record("queue_depth.w0", 10.0, 16.0)
+        acts = loop.step(now=10.0)
+        assert [a.kind for a in acts] == ["scale_out"]
+        assert gw.live_worker_ids() == [0, 1]
+        # The fleet keeps serving through and after the actuation.
+        for fid in sorted(gw._fleet_key):
+            assert gw.handle_event(fid, "post-spawn")["seq"] == 1
+        # Calm, held past calm_hold_s: retire back down to one.
+        for t in (20.0, 22.0, 25.0):
+            tl.record_many(t, {"queue_depth.w0": 0.0, "queue_depth.w1": 0.0})
+            acts = loop.step(now=t)
+        assert [a.kind for a in acts] == ["scale_in"]
+        assert gw.live_worker_ids() == [0]
+        for fid in sorted(gw._fleet_key):
+            assert gw.handle_event(fid, "post-retire")["seq"] == 2
+
+        # Reconciliation: counters == live trail == flight ring.
+        c = gw.metrics.snapshot()["counters"]
+        assert c["control_actions"] == len(loop.actions) == 2
+        assert c["control_scale_out"] == c["workers_spawned"] == 1
+        assert c["control_scale_in"] == c["workers_retired"] == 1
+        recs = gw.flight.snapshot("control")
+        assert [r["action"]["kind"] for r in recs] == [
+            a.kind for a in loop.actions
+        ]
+        assert all("signals" in r for r in recs)
+        # Every control tick publishes the worker count on the timeline.
+        assert "control.workers" in tl.names()
+        assert tl.latest("control.workers")[1] == 1.0
+        assert loop.errors == 0
+    finally:
+        gw.close()
+
+
+def test_control_loop_survives_actuation_failure():
+    gw = _control_gateway()
+    try:
+        tl = Timeline()
+        gw.attach_slo(None, tl)
+        ctl = Controller(
+            ControlPolicy(
+                min_workers=1,
+                max_workers=2,
+                scale_cooldown_s=0.0,
+                headroom_min_frac=None,
+                depth_high_per_worker=8.0,
+            )
+        )
+        loop = ControlLoop(gw, ctl, period_s=0.01)
+        gw.spawn_worker = None  # actuation will raise TypeError
+        tl.record("queue_depth.w0", 1.0, 50.0)
+        # step() raising is the unit surface ...
+        with pytest.raises(TypeError):
+            loop.step(now=1.0)
+        # ... and the threaded runner counts it and keeps going.
+        loop.start()
+        deadline = time.time() + 10.0
+        while loop.errors < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        loop.stop()
+        assert loop.errors >= 2  # it survived the first failure
+        counters = gw.metrics.snapshot()["counters"]
+        assert counters["control_errors"] == loop.errors
+        # Topology untouched throughout; serving still works.
+        assert gw.live_worker_ids() == [0]
+        assert gw.handle_event(sorted(gw._fleet_key)[0], "ev")["seq"] == 1
+    finally:
+        gw.close()
+
+
+def test_control_loop_noops_without_timeline():
+    gw = _control_gateway()
+    try:
+        loop = ControlLoop(gw, Controller(ControlPolicy()))
+        assert loop.step(now=0.0) == []
+        assert loop.actions == []
+    finally:
+        gw.close()

@@ -2555,3 +2555,83 @@ def test_check_lockwatch_subset_empty_and_witness_verdicts(tmp_path, capsys):
         ],
     })
     assert wit_rc == 1 and "cycle witness" in wit_out
+
+
+# --------------------------------------------------------------------------
+# distilp_tpu/control/ (the closed-loop autoscaler) joins the repo-wide
+# contracts: lazy-jax (DLP013), accounted excepts (DLP017), no blocking
+# calls in async defs (DLP018), registered metric names (DLP019) and
+# module-level ledger-registered jit (DLP020) — fixture-pinned so the
+# prefix coverage cannot silently regress out from under the subsystem.
+
+
+def test_control_module_joins_lazy_jax_contract():
+    out = findings_for("DLP013", "distilp_tpu/control/controller.py", """\
+        import jax
+
+        def decide(signals):
+            return jax.numpy.asarray(signals["queue_depth"])
+        """)
+    assert len(out) == 1 and "lazy" in out[0].message
+    out = findings_for("DLP013", "distilp_tpu/control/controller.py", """\
+        from distilp_tpu.ops.ipm import TRACE_COLS
+        """)
+    assert len(out) == 1
+
+
+def test_control_module_joins_silent_except_contract():
+    out = findings_for("DLP017", "distilp_tpu/control/controller.py", """\
+        def actuate(self, gw, action):
+            try:
+                gw.spawn_worker()
+            except RuntimeError:
+                return None
+        """)
+    assert len(out) == 1 and "metrics sink" in out[0].message
+
+
+def test_control_module_joins_async_blocking_contract():
+    out = findings_for("DLP018", "distilp_tpu/control/exporter.py", """\
+        import time
+
+        async def push(self):
+            time.sleep(0.1)
+        """)
+    assert len(out) == 1
+
+
+def test_control_module_joins_metric_registry_contract():
+    out = findings_for("DLP019", "distilp_tpu/control/controller.py", """\
+        def step(self, metrics):
+            metrics.inc("control_totally_unregistered")
+        """)
+    assert len(out) == 1 and "METRIC_REGISTRY" in out[0].message
+    # ...while the registered autoscaler counters pass.
+    out = findings_for("DLP019", "distilp_tpu/control/controller.py", """\
+        def step(self, metrics):
+            metrics.inc("control_actions")
+            metrics.inc("control_scale_out")
+        """)
+    assert out == []
+
+
+def test_control_module_joins_jit_registry_contract():
+    out = findings_for("DLP020", "distilp_tpu/control/predictor.py", """\
+        import jax
+
+        def forecast(self, xs):
+            step = jax.jit(lambda x: x * 2)
+            return step(xs)
+        """)
+    assert len(out) == 1
+
+
+def test_control_real_modules_are_currently_clean():
+    """The REAL control/ package passes its layer's contracts."""
+    from pathlib import Path
+
+    for mod in ("__init__", "policy", "controller"):
+        rel = f"distilp_tpu/control/{mod}.py"
+        src = Path(rel).read_text()
+        for code in ("DLP013", "DLP017", "DLP018", "DLP019", "DLP020"):
+            assert findings_for(code, rel, src) == [], (rel, code)

@@ -1,0 +1,284 @@
+"""Live shard migration: warm hand-off reconciliation (ISSUE 19 tentpole).
+
+The zero-downtime contract, pinned with real solvers on both LP engines
+and with stub schedulers under concurrent ingest:
+
+- every migrated shard's first post-move tick rides warm
+  (``warm_resumes == shards moved``, ``cold_resumes == 0``);
+- zero ``tick_cold`` in the whole moved phase (the bit-exact snapshot
+  blob carries incumbents/duals/pool — nothing re-solves from scratch);
+- per-fleet event cursors stay continuous through the move (no event is
+  lost or double-applied while ticks are parked and replayed);
+- a migration that fails mid-flip leaves routing on the intact source.
+
+Solver-backed tests reuse the L=32 model + M=4 synthetic fleets of
+tests/test_gateway.py so the jit programs are shared within the pytest
+process.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from distilp_tpu.gateway import Gateway
+from distilp_tpu.gateway.traces import make_fleet_from_spec
+from distilp_tpu.sched import generate_trace
+
+GAP = 1e-3
+KS = [4, 8]
+
+
+@pytest.fixture(scope="module")
+def model():
+    from distilp_tpu.profiler.api import profile_model
+
+    return profile_model(
+        "tests/configs/llama31_8b_4bit.json",
+        batch_sizes=[1],
+        sequence_length=128,
+    ).to_model_profile()
+
+
+def sched_kwargs(**extra):
+    kw = dict(mip_gap=GAP, kv_bits="4bit", backend="jax", k_candidates=KS)
+    kw.update(extra)
+    return kw
+
+
+def _stub_gateway(n_fleets: int, n_workers: int = 1, **gw_kwargs) -> Gateway:
+    gw = Gateway(
+        n_workers=n_workers,
+        scheduler_factory="tests.procstub:make_scheduler",
+        dynamic=True,
+        **gw_kwargs,
+    )
+    for i in range(n_fleets):
+        fid = f"m{i:02d}"
+        gw.register_fleet(
+            fid, make_fleet_from_spec(fid, {"m": 3, "seed": 500 + i}), "stub"
+        )
+    return gw
+
+
+def _stub_events(gw: Gateway, fleets, n: int):
+    for j in range(n):
+        for fid in fleets:
+            view = gw.handle_event(fid, f"ev{j}")
+            assert view["kind"] == f"ev{j}"
+
+
+# -- reconciliation with real solvers, both LP engines ---------------------
+
+
+@pytest.mark.parametrize("engine", ["ipm", "pdhg"])
+def test_live_migration_warm_reconciliation(model, engine):
+    """Spawn a worker mid-trace and retire it again: every moved shard
+    resumes warm, nothing cold-solves, and the per-fleet placements keep
+    evolving from exactly where they left off."""
+    extra = {"lp_backend": engine}
+    if engine == "pdhg":
+        extra["pdhg_iters"] = 400
+    specs = {f"g{i}": {"m": 4, "seed": 90 + i} for i in range(2)}
+    traces = {
+        fid: generate_trace(
+            "drift", 6, seed=95 + i,
+            base_fleet=make_fleet_from_spec(fid, spec),
+        )
+        for i, (fid, spec) in enumerate(specs.items())
+    }
+    gw = Gateway(
+        n_workers=1, scheduler_kwargs=sched_kwargs(**extra), dynamic=True
+    )
+    try:
+        for fid, spec in specs.items():
+            gw.register_fleet(fid, make_fleet_from_spec(fid, spec), model)
+        # Warmup: cold solve + first warm tick per fleet, BEFORE the
+        # baseline snapshot — migration must add zero cold work on top.
+        for j in range(2):
+            for fid in specs:
+                gw.handle_event(fid, traces[fid][j])
+        base = gw.metrics_snapshot()["shard_totals"]
+        assert base["warm_resumes"] == 0
+
+        widx, moved_out = gw.spawn_worker()
+        assert gw.live_worker_ids() == [0, 1]
+        # Consistent hashing moved SOME (not necessarily all) shards.
+        assert 0 <= len(moved_out) <= len(specs)
+
+        for j in range(2, 4):
+            for fid in specs:
+                view = gw.handle_event(fid, traces[fid][j])
+                assert view.events_behind == 0
+
+        _, moved_back = gw.retire_worker(widx)
+        assert gw.live_worker_ids() == [0]
+        assert len(moved_back) == len(moved_out)
+
+        finals = {}
+        for j in range(4, 6):
+            for fid in specs:
+                finals[fid] = gw.handle_event(fid, traces[fid][j])
+
+        totals = gw.metrics_snapshot()["shard_totals"]
+        counters = gw.metrics.snapshot()["counters"]
+        migrated = counters.get("shards_migrated", 0)
+        assert migrated == len(moved_out) + len(moved_back)
+        # THE reconciliation: warm resumes == shards moved, zero cold.
+        assert totals["warm_resumes"] - base["warm_resumes"] == migrated
+        assert totals["cold_resumes"] == 0
+        assert totals["tick_cold"] == base["tick_cold"]
+        assert counters.get("migration_failed", 0) == 0
+        # Cursor continuity: every fleet handled all 6 events, exactly.
+        for fid in specs:
+            assert gw._handled[fid] == 6
+            assert finals[fid].result.k >= 1
+    finally:
+        gw.close()
+
+
+def test_uninterrupted_and_migrated_runs_agree(model):
+    """Same trace, one gateway static and one migrating mid-trace: final
+    placements identical — a live move is invisible to the math."""
+    spec = {"m": 4, "seed": 123}
+    trace = generate_trace(
+        "drift", 5, seed=321, base_fleet=make_fleet_from_spec("x0", spec)
+    )
+
+    def run(dynamic: bool):
+        gw = Gateway(
+            n_workers=1, scheduler_kwargs=sched_kwargs(), dynamic=dynamic
+        )
+        try:
+            gw.register_fleet("x0", make_fleet_from_spec("x0", spec), model)
+            out = None
+            for j, ev in enumerate(trace):
+                if dynamic and j == 3:
+                    gw.spawn_worker()
+                out = gw.handle_event("x0", ev)
+            return out.result
+        finally:
+            gw.close()
+
+    a, b = run(False), run(True)
+    assert (a.k, a.w, a.n, a.obj_value) == (b.k, b.w, b.n, b.obj_value)
+
+
+# -- stub-backed: concurrency, parking, failure recovery -------------------
+
+
+def test_migration_parks_and_replays_concurrent_ingest():
+    """Events ingested WHILE a shard is mid-flip park at the gate and
+    replay on the destination in order: nothing lost, nothing doubled,
+    per-fleet seq strictly continuous."""
+    gw = _stub_gateway(n_fleets=4)
+    try:
+        fleets = sorted(gw._fleet_key)
+        _stub_events(gw, fleets, 3)
+
+        stop = threading.Event()
+        errors = []
+        seqs = {fid: 3 for fid in fleets}
+
+        def ingest():
+            j = 3
+            while not stop.is_set():
+                for fid in fleets:
+                    try:
+                        view = gw.handle_event(fid, f"ev{j}")
+                    except Exception as e:  # pragma: no cover
+                        errors.append(e)
+                        return
+                    # seq must be exactly prev+1: a lost parked event
+                    # (or a double replay) breaks the chain instantly.
+                    if view["seq"] != seqs[fid] + 1:
+                        errors.append(
+                            AssertionError(
+                                f"{fid}: seq {view['seq']} after "
+                                f"{seqs[fid]}"
+                            )
+                        )
+                        return
+                    seqs[fid] = view["seq"]
+                j += 1
+
+        t = threading.Thread(target=ingest)
+        t.start()
+        try:
+            for _ in range(3):
+                gw.spawn_worker()
+                gw.retire_worker()
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert not errors
+        counters = gw.metrics.snapshot()["counters"]
+        assert counters.get("shards_migrated", 0) > 0
+        assert counters.get("migration_failed", 0) == 0
+        # Post-churn: serving still works and the fleet is back to one.
+        assert gw.live_worker_ids() == [0]
+        for fid in fleets:
+            view = gw.handle_event(fid, "tail")
+            assert view["seq"] == seqs[fid] + 1
+    finally:
+        gw.close()
+
+
+def test_migration_failure_leaves_source_intact():
+    """A flip whose destination load blows up must recover: routing stays
+    on the (still-serving) source, the failure is counted, and parked
+    events replay against the source."""
+    gw = _stub_gateway(n_fleets=2)
+    try:
+        fleets = sorted(gw._fleet_key)
+        _stub_events(gw, fleets, 2)
+        gw.spawn_worker()
+
+        key = gw._fleet_key[fleets[0]]
+        src_widx = gw._shards[key][2]
+        dst_widx = next(w for w in gw.live_worker_ids() if w != src_widx)
+        dst = gw.workers[dst_widx]
+
+        real_load = dst.load_shard
+
+        def broken_load(k, state):
+            raise RuntimeError("injected: destination refuses the state")
+
+        dst.load_shard = broken_load
+        try:
+            with pytest.raises(RuntimeError, match="injected"):
+                gw.migrate_shard(fleets[0], dst_widx)
+        finally:
+            dst.load_shard = real_load
+
+        counters = gw.metrics.snapshot()["counters"]
+        assert counters.get("migration_failed", 0) == 1
+        # Routing unchanged; the fleet still serves with continuous seq.
+        assert gw._shards[key][2] == src_widx
+        view = gw.handle_event(fleets[0], "after-failure")
+        assert view["seq"] == 3
+    finally:
+        gw.close()
+
+
+def test_static_gateway_refuses_dynamic_verbs():
+    gw = Gateway(
+        n_workers=1, scheduler_factory="tests.procstub:make_scheduler"
+    )
+    try:
+        with pytest.raises(RuntimeError, match="dynamic"):
+            gw.spawn_worker()
+        with pytest.raises(RuntimeError, match="dynamic"):
+            gw.retire_worker()
+    finally:
+        gw.close()
+
+
+def test_retire_last_worker_refused():
+    gw = _stub_gateway(n_fleets=1)
+    try:
+        with pytest.raises(RuntimeError, match="last worker"):
+            gw.retire_worker()
+    finally:
+        gw.close()

@@ -63,6 +63,10 @@ LAZY_JAX_PREFIXES = (
     # backend at all, and a top-level jax import here would leak into the
     # sched/gateway layers that import obs at module level.
     "distilp_tpu/obs/",
+    # The autoscaler decides from a SignalsPayload and actuates through
+    # gateway methods — pure policy/stdlib; offline replay (a tier-1
+    # pin) must never pay backend init to judge a timeline.
+    "distilp_tpu/control/",
     # The traffic engine generates schedules and fires them at the
     # gateway; generating (or byte-checking) a committed open-loop trace
     # must never pay backend init — jax only loads through the
@@ -826,6 +830,9 @@ class SilentExceptInScheduler(Rule):
         # The combiner serves many shards from one dispatch: a swallowed
         # flush/delivery failure would strand every lane in the batch.
         "distilp_tpu/combine/",
+        # The autoscaler RESHAPES the fleet: a swallowed spawn/migrate
+        # failure would leave topology and accounting silently split.
+        "distilp_tpu/control/",
     )
     # Attribute calls that count as recording through the metrics sink.
     # `_quarantine`/`_quarantine_note` are the scheduler's fault recorders
@@ -893,6 +900,9 @@ class BlockingCallInAsyncGateway(Rule):
         "distilp_tpu/gateway/",
         "distilp_tpu/obs/",
         "distilp_tpu/traffic/",
+        # The control loop runs beside the gateway's asyncio tier; any
+        # future async surface here inherits the same no-blocking rule.
+        "distilp_tpu/control/",
     )
     # module -> function names that block the loop outright. Matched
     # through ALIASES too: `import time as t; t.sleep(...)` and
@@ -1011,6 +1021,7 @@ class UnregisteredJitEntryPoint(Rule):
         "distilp_tpu/ops/",
         "distilp_tpu/twin/",
         "distilp_tpu/combine/",
+        "distilp_tpu/control/",
     )
 
     @staticmethod
@@ -1155,6 +1166,7 @@ class UnregisteredMetricName(Rule):
         "distilp_tpu/obs/",
         "distilp_tpu/traffic/",
         "distilp_tpu/combine/",
+        "distilp_tpu/control/",
     )
 
     _registry_cache: Optional[Dict[str, str]] = None
