@@ -26,7 +26,7 @@ format:
 	ruff format --diff .
 
 .PHONY: test
-test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory smoke-combine smoke-lockwatch smoke-shard smoke-autoscale
+test: lint-strict smoke-twin smoke-chaos smoke-gateway smoke-spec smoke-diag smoke-overload smoke-slo smoke-compile smoke-memory smoke-combine smoke-lockwatch smoke-shard smoke-autoscale smoke-crash
 	python -m pytest tests/ -q
 
 # Lock-sanitizer smoke: the runtime half of DLP032's deadlock claim. The
@@ -333,6 +333,36 @@ smoke-autoscale: lint-strict
 		--capacity-probe 3 --control-period-s 0.05 \
 		--check --expect-scale 2 --expect-sheds --expect-alert page \
 		--settle-s 3 --quiet
+
+# Crash-tolerance smoke: the chaos trace served by a SUPERVISED
+# process-backed worker whose child eats two kill -9s mid-soak (plus a
+# one-shot rpc_delay for the degraded-but-alive corner) — each kill
+# exercises the whole recovery chain inline: crash detection on the dead
+# socket, respawn with backoff, micro-snapshot restore, WAL-tail replay,
+# then the interrupted dispatch re-serves. `--chaos-check` fails the run
+# unless the crash contract holds: events_lost == 0 (WAL lost nothing,
+# replay double-applied nothing), zero cold resumes (every shard came
+# back warm from its snapshot), every crash answered by a respawn or a
+# quarantine, and the soak returns to healthy. Run on BOTH LP engines —
+# dump/load bit-exactness is per engine, so warm recovery must be proven
+# per engine. Torn-frame/EOF taxonomy and the crash-loop breaker are
+# pytest's half (tests/test_procworker.py, tests/test_recovery.py).
+.PHONY: smoke-crash
+smoke-crash: lint-strict
+	@for eng in ipm pdhg; do \
+		D=$$(mktemp -d) ; \
+		JAX_PLATFORMS=cpu python -m distilp_tpu.cli.solver_cli serve \
+			--trace tests/traces/scheduler_smoke_20.jsonl \
+			--profile tests/profiles/llama_3_70b/online \
+			--synthetic-fleet 4 --fleet-seed 11 --k-candidates 8,10 \
+			--lp-backend $$eng \
+			--worker-backend process --supervise \
+			--recovery-dir $$D --snapshot-every 4 \
+			--fault-plan tests/traces/crash_plan.json \
+			--chaos-check --quiet ; \
+		rc=$$? ; rm -rf $$D ; \
+		[ $$rc -eq 0 ] || exit $$rc ; \
+	done
 
 # Combine smoke: the committed diurnal+burst capture replayed with
 # cross-shard batching ON (coalesce folds a shard's burst into one tick;

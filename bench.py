@@ -293,6 +293,11 @@ _REGRESSION_GATED = (
     # plumbing, budgets or restart tuning regressed even if wall-clock
     # noise hides it.
     "conv_ipm_iters_to_certify", "conv_pdhg_iters_to_certify",
+    # Crash-to-serving-again under the kill loop: respawn + snapshot
+    # restore + WAL replay. A >20% growth means the recovery chain got
+    # slower (bigger WAL tails, slower restores, lazier detection) even
+    # if the exactly-once audit still holds.
+    "recovery_mttr_p99_ms",
 )
 # Higher-better metrics that also gate: a >20% DROP fails the compare.
 # The gateway's sustained multi-fleet rate is the serving tier's headline.
@@ -326,6 +331,7 @@ _COMPARE_LOWER_BETTER = (
     "slo_overhead_pct",
     "compile_overhead_pct", "compile_warm_phase_count",
     "memory_overhead_pct", "memory_leak_bytes",
+    "recovery_mttr_p50_ms", "recovery_mttr_p99_ms", "recovery_goodput_dip",
 )
 # Instrumentation cost ceiling: tracing + Prometheus exposition may never
 # cost more than this fraction of the loadgen arm's events/sec. Checked
@@ -514,6 +520,28 @@ def _compare_against(payload: dict, against: str) -> int:
             f"federation_warm_phase_compiles {fed_warm:g} != 0 (a worker "
             "subprocess compiled during the steady-state warm phase — "
             "see the federation section's proc_workers per-child counts)"
+        )
+    # Crash recovery's exactly-once audit, absolute: every accepted event
+    # is applied exactly once across kill -9s. Positive means the WAL
+    # lost accepted events; NEGATIVE means replay double-applied (the
+    # snapshot/WAL-truncate ordering or the seq-cursor reconciliation
+    # broke) — both fail regardless of the reference.
+    rec_lost = payload.get("recovery_events_lost")
+    if isinstance(rec_lost, (int, float)) and rec_lost != 0:
+        failures.append(
+            f"recovery_events_lost {rec_lost:g} != 0 (accepted events "
+            f"{'lost across a crash' if rec_lost > 0 else 'double-applied by WAL replay'}"
+            " — see the recovery section's per-audit counters)"
+        )
+    # Its warm-restore twin, also absolute: a recovered shard that
+    # resumes cold threw away its micro-snapshot (or restored a stale
+    # one) and is silently paying re-solve latency after every crash.
+    rec_cold = payload.get("recovery_cold_resumes")
+    if isinstance(rec_cold, (int, float)) and rec_cold != 0:
+        failures.append(
+            f"recovery_cold_resumes {rec_cold:g} != 0 (a respawned shard "
+            "resumed without warm state — snapshot restore or WAL replay "
+            "fell back to a cold solve)"
         )
     mem_pct = payload.get("memory_overhead_pct")
     if isinstance(mem_pct, (int, float)) and mem_pct > _MEM_OVERHEAD_MAX_PCT:
@@ -889,6 +917,18 @@ def main(against: str | None = None, history: str | None = None) -> int:
     except Exception as e:  # pragma: no cover - defensive bench path
         payload["federation_error"] = f"{type(e).__name__}: {e}"
 
+    # Crash recovery (ISSUE 20): kill -9 loop against the SUPERVISED
+    # process tier — MTTR p50/p99 from crash detection to serving again
+    # (respawn + snapshot restore + WAL-tail replay), the exactly-once
+    # audit (recovery_events_lost == 0 ABSOLUTE in --against, negative
+    # would mean double-apply), zero post-recovery cold resumes
+    # (absolute), and the goodput-dip depth a kill costs the serving
+    # path. A failure costs only these keys.
+    try:
+        payload.update(_recovery_bench(model))
+    except Exception as e:  # pragma: no cover - defensive bench path
+        payload["recovery_error"] = f"{type(e).__name__}: {e}"
+
     # Overload realism (distilp_tpu.traffic): OPEN-loop arrivals against
     # the 100-fleet gateway — a rate ladder finds the max sustainable
     # throughput (highest offered rate whose p99 meets the SLO), then a
@@ -1213,6 +1253,102 @@ def _federation_bench(model) -> dict:
     thread_top = arms.get(f"thread_{hi}w", {}).get("events_per_sec")
     if thread_top and top:
         out["federation_vs_thread"] = round(top / thread_top, 2)
+    return out
+
+
+def _recovery_bench(model) -> dict:
+    """recovery section: MTTR under a kill-loop flood of the supervised
+    process tier.
+
+    One supervised process-backed gateway serves a seeded drift trace
+    while ``DPERF_RECOVERY_KILLS`` ``kill -9``s land on the worker child
+    at evenly spaced event indices. Every kill exercises the full
+    recovery chain — crash detection, respawn with backoff, snapshot
+    restore, WAL-tail replay — inline on the serving path, so the
+    kill-adjacent event's latency IS the mean-time-to-recovery the
+    supervisor's ``recovery_mttr_ms`` histogram records (dominated on a
+    cold cache by the respawned child's jit re-compile; the histogram is
+    the honest number either way).
+
+    Headlines: ``recovery_mttr_p50_ms``/``recovery_mttr_p99_ms`` (p99
+    regression-gated in ``--against``) and the exactly-once audit,
+    absolute-gated — ``recovery_events_lost`` must be 0 (positive means
+    the WAL lost accepted events, negative means replay double-applied)
+    and ``recovery_cold_resumes`` must be 0 (every recovered shard
+    resumes warm from its micro-snapshot, or the restore chain broke).
+    ``recovery_goodput_dip`` rides along: worst kill-adjacent event
+    latency over the healthy median — the depth of the serving dip a
+    crash costs, the knob snapshot cadence tuning would move first.
+    """
+    from distilp_tpu.gateway import Gateway, make_fleet_from_spec
+    from distilp_tpu.gateway.loadgen import (
+        make_fleet_specs,
+        make_loadgen_trace,
+    )
+
+    n_fleets = int(_env_num("DPERF_RECOVERY_FLEETS", 2))
+    events = int(_env_num("DPERF_RECOVERY_EVENTS", 8))
+    kills = int(_env_num("DPERF_RECOVERY_KILLS", 2))
+    fleet_size = int(_env_num("DPERF_RECOVERY_M", 3))
+    warmup = 2  # cold solve + first warm tick, same boundary as loadgen
+    specs = make_fleet_specs(n_fleets, fleet_size=fleet_size, seed=0)
+    items = make_loadgen_trace(specs, events + warmup, seed=0)
+    gw = Gateway(
+        n_workers=1,
+        scheduler_kwargs={
+            "mip_gap": MIP_GAP,
+            "kv_bits": "4bit",
+            "backend": "jax",
+            "k_candidates": [8, 10],
+        },
+        worker_backend="process",
+        supervise=True,
+        snapshot_every=4,
+    )
+    lat_ms: list = []
+    kill_lat_ms: list = []
+    try:
+        for fleet_id, spec in specs.items():
+            gw.register_fleet(
+                fleet_id, make_fleet_from_spec(fleet_id, spec), model
+            )
+        head = n_fleets * warmup
+        for fleet_id, ev in items[:head]:
+            gw.handle_event(fleet_id, ev)
+        # Kills aim at fleet 0's CURRENT owner (the hook re-resolves per
+        # fault: a respawn keeps the slot, a quarantine would re-home it).
+        hook = gw.chaos_process_hook(next(iter(specs)))
+        timed = items[head:]
+        stride = max(1, len(timed) // (kills + 1)) if kills else len(timed)
+        kill_at = {stride * (i + 1) for i in range(kills)}
+        for i, (fleet_id, ev) in enumerate(timed):
+            if i in kill_at:
+                hook("child_kill", None)
+            t0 = time.perf_counter()
+            gw.handle_event(fleet_id, ev)
+            ms = (time.perf_counter() - t0) * 1e3
+            (kill_lat_ms if i in kill_at else lat_ms).append(ms)
+        rec = gw.recovery_status()
+    finally:
+        gw.close()
+    out: dict = {
+        "recovery": {
+            "fleets": n_fleets,
+            "events_per_fleet": events,
+            "kills": kills,
+            "snapshot_every": 4,
+            **rec,
+        },
+        "recovery_events_lost": rec.get("events_lost", 0),
+        "recovery_cold_resumes": rec.get("cold_resumes", 0),
+    }
+    if rec.get("mttr_p50_ms") is not None:
+        out["recovery_mttr_p50_ms"] = rec["mttr_p50_ms"]
+        out["recovery_mttr_p99_ms"] = rec["mttr_p99_ms"]
+    if lat_ms and kill_lat_ms:
+        med = statistics.median(lat_ms)
+        if med > 0:
+            out["recovery_goodput_dip"] = round(max(kill_lat_ms) / med, 2)
     return out
 
 

@@ -340,6 +340,42 @@ def build_serve_parser() -> argparse.ArgumentParser:
         "its own HealthState)",
     )
     p.add_argument(
+        "--worker-backend", choices=("thread", "process"), default="thread",
+        help="host shard workers in threads (default) or dedicated "
+        "subprocesses (own GIL, own XLA runtime; README 'Closed-loop "
+        "autoscaling & process workers')",
+    )
+    p.add_argument(
+        "--scheduler-factory", default=None, metavar="MOD:FN",
+        help="'module:callable' scheduler factory resolved in whichever "
+        "process hosts the shard — the only factory form that crosses a "
+        "process boundary (tests.procstub:make_scheduler is the no-jax "
+        "stub the smokes use)",
+    )
+    # Crash tolerance (README "Crash recovery & supervision"). Default
+    # off — unsupervised process serving is byte-identical to the
+    # pre-supervision tier (no WAL, no snapshots, no supervisor state).
+    p.add_argument(
+        "--supervise", action="store_true",
+        help="supervise process workers: a crashed child is respawned "
+        "with bounded backoff and its shards restored WARM from "
+        "per-shard micro-snapshots + WAL-tail replay (exactly-once; "
+        "crash-looping workers are quarantined and the ring rebalanced); "
+        "needs --worker-backend process",
+    )
+    p.add_argument(
+        "--recovery-dir", default=None, metavar="DIR",
+        help="with --supervise: root directory for the per-fleet WALs "
+        "and micro-snapshots (default: a private tempdir removed at "
+        "close)",
+    )
+    p.add_argument(
+        "--snapshot-every", type=int, default=8, metavar="N",
+        help="with --supervise: micro-snapshot each shard every N "
+        "handled events (the WAL truncates at each boundary, bounding "
+        "replay length)",
+    )
+    p.add_argument(
         "--listen",
         default=None,
         metavar="HOST:PORT",
@@ -983,6 +1019,10 @@ def serve_main(argv=None) -> int:
         or args.coalesce
         or args.degrade_depth is not None
         or args.mem_degrade_headroom_mb is not None
+        # Process workers (and their supervision) ARE the gateway tier.
+        or args.worker_backend != "thread"
+        or args.scheduler_factory is not None
+        or args.supervise
     )
     if args.mem_degrade_headroom_mb is not None and not (
         args.memory_ledger or args.memory_out
@@ -1356,21 +1396,38 @@ def _serve_gateway(args) -> int:
     if args.breaker_threshold is not None:
         scheduler_kwargs["breaker_threshold"] = args.breaker_threshold
 
+    if args.supervise and args.worker_backend != "process":
+        print(
+            "error: --supervise needs --worker-backend process (thread "
+            "workers share the gateway's life; there is no child to "
+            "respawn)",
+            file=sys.stderr,
+        )
+        return 2
     tracer, writer, flight = _build_obs(args)
-    gw = Gateway(
-        n_workers=args.workers,
-        scheduler_kwargs=scheduler_kwargs,
-        tracer=tracer,
-        flight=flight,
-        max_queue_depth=args.max_queue_depth,
-        coalesce=args.coalesce,
-        degrade_depth=args.degrade_depth,
-        mem_degrade_headroom_bytes=(
-            args.mem_degrade_headroom_mb * 1e6
-            if args.mem_degrade_headroom_mb is not None
-            else None
-        ),
-    )
+    try:
+        gw = Gateway(
+            n_workers=args.workers,
+            scheduler_kwargs=scheduler_kwargs,
+            scheduler_factory=args.scheduler_factory,
+            tracer=tracer,
+            flight=flight,
+            max_queue_depth=args.max_queue_depth,
+            coalesce=args.coalesce,
+            degrade_depth=args.degrade_depth,
+            mem_degrade_headroom_bytes=(
+                args.mem_degrade_headroom_mb * 1e6
+                if args.mem_degrade_headroom_mb is not None
+                else None
+            ),
+            worker_backend=args.worker_backend,
+            supervise=args.supervise,
+            recovery_dir=args.recovery_dir,
+            snapshot_every=args.snapshot_every,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     timeline, slo_engine, sampler = _build_slo(
         args, gw.metrics, gw.timeline_sample, tracer, flight
     )
@@ -1487,6 +1544,18 @@ def _serve_gateway(args) -> int:
                 [ev for _, ev in run_items],
                 plan,
                 on_event=_chaos_on_event,
+                # Process-channel faults (child_kill / rpc_torn /
+                # rpc_delay) aim at whichever worker currently owns the
+                # fleet's shard; the recovery probe stamps the report
+                # with the supervision audit (events_lost, MTTR, ...).
+                process_hook=(
+                    gw.chaos_process_hook("default")
+                    if args.supervise
+                    else None
+                ),
+                recovery_probe=(
+                    gw.recovery_status if args.supervise else None
+                ),
             )
             report = _chaos_to_replay_report(chaos, facade)
             if chaos.views:
@@ -1601,13 +1670,24 @@ def _serve_gateway(args) -> int:
             )
         if mled is not None:
             summary["memory"] = _memory_summary(args, mled)
+        chaos_L = None
         if chaos is not None:
+            # Proxy-safe L read: on the process backend the shard
+            # scheduler is a SchedulerProxy (no ``.fleet``); the facade
+            # rebuilds the fleet view over one RPC, and a factory-built
+            # stub with no fleet degrades to None (records carry their
+            # own per-tick L anyway).
+            fl = getattr(ShardFacade(gw, "default"), "fleet", None)
+            chaos_L = getattr(getattr(fl, "model", None), "L", None)
             summary["chaos"] = chaos.summary()
-            if flight is not None and chaos.violations(
-                gw.scheduler("default").fleet.model.L
-            ):
+            if flight is not None and chaos.violations(chaos_L):
                 if flight.trigger("default", "chaos_violation") is not None:
-                    gw.scheduler("default").metrics.inc("flight_dumps")
+                    gw.metrics.inc("flight_dumps")
+        if args.supervise:
+            # The supervision audit rides the report even without chaos:
+            # a clean supervised flood must show zero crashes and
+            # events_lost == 0 (the WAL/snapshot machinery ran for real).
+            summary["recovery"] = gw.recovery_status()
         if sampler is not None:
             summary["slo"] = _slo_summary(args, timeline, slo_engine, sampler)
         if writer is not None or flight is not None:
@@ -1623,19 +1703,28 @@ def _serve_gateway(args) -> int:
                     file=sys.stderr,
                 )
                 return 2
-            violations = chaos.violations(
-                gw.scheduler("default").fleet.model.L
-            )
+            violations = chaos.violations(chaos_L)
             if violations:
                 for v in violations:
                     print(f"chaos violation: {v}", file=sys.stderr)
                 return 1
-            print(
+            ok_line = (
                 f"chaos soak OK ({args.workers} workers): "
                 f"{chaos.injected.get('injected_total', 0)} fault(s) "
                 f"injected, {chaos.summary()['quarantined']} quarantined, "
                 f"healthy after {chaos.ticks_to_healthy} clean tick(s)"
             )
+            if chaos.recovery is not None:
+                rec = chaos.recovery
+                ok_line += (
+                    f"; crash contract OK: {rec.get('worker_crashes', 0)} "
+                    f"crash(es), {rec.get('child_respawns', 0)} "
+                    f"respawn(s), {rec.get('workers_quarantined', 0)} "
+                    f"quarantined, events_lost="
+                    f"{rec.get('events_lost', 0)}, "
+                    f"replayed={rec.get('events_replayed', 0)}"
+                )
+            print(ok_line)
         if args.fail_uncertified and (
             replay_summary.get("structural_uncertified")
             or replay_summary["failed_ticks"]
@@ -1720,7 +1809,9 @@ def _chaos_to_replay_report(chaos, sched):
         p50_ms=_quantile(srt, 0.50),
         p99_ms=_quantile(srt, 0.99),
         structural_uncertified=uncert,
-        failed_ticks=sched.metrics.counters["tick_failed"],
+        # .get: on the process backend the facade hands a plain counter
+        # dict (no defaultdict semantics) snapshotted over RPC.
+        failed_ticks=sched.metrics.counters.get("tick_failed", 0),
     )
 
 

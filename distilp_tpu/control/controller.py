@@ -55,6 +55,11 @@ class Controller:
         self._degraded = False
         self._spec_k_low = False
         self._holds = 0  # decisions suppressed by cooldown/band edges
+        # High-water mark of signals.recovery["workers_quarantined"]:
+        # the quarantine vote fires on the INCREASE (a breaker newly
+        # opened), not on the standing count — cumulative counters would
+        # otherwise re-vote every tick until max_workers.
+        self._quarantined_seen = 0
 
     # -- the decision function --------------------------------------------
 
@@ -116,6 +121,14 @@ class Controller:
             for w in signals.workers
         ):
             votes.append("queue depth trending up")
+        if p.scale_out_on_quarantine and signals.recovery is not None:
+            q = int(signals.recovery.get("workers_quarantined", 0) or 0)
+            if q > self._quarantined_seen:
+                votes.append(
+                    f"crash-loop breaker quarantined "
+                    f"{q - self._quarantined_seen} worker(s)"
+                )
+            self._quarantined_seen = max(self._quarantined_seen, q)
 
         cooled = (
             self._last_scale_t is None

@@ -65,6 +65,12 @@ class ControlPolicy(BaseModel):
     depth_high_per_worker: Optional[float] = 8.0
     # Any worker's depth trend (slope, units/s) at or above this trips.
     trend_up_per_s: Optional[float] = None
+    # A NEWLY quarantined worker (crash-loop breaker opened; the signals
+    # payload's ``recovery`` block, supervised process tier only) votes
+    # scale-out: the ring just lost a slice for good, and respawn cannot
+    # win it back. Inert when signals carry no recovery block, so
+    # committed replay fixtures from unsupervised captures are unchanged.
+    scale_out_on_quarantine: bool = True
 
     # -- scale-in: ALL calm conditions, sustained --------------------------
     calm_hold_s: float = Field(15.0, ge=0.0)

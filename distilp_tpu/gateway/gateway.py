@@ -42,6 +42,8 @@ from ..sched.metrics import (
 )
 from ..sched.scheduler import PlacementView, Scheduler
 from ..utils.lockwatch import make_lock
+from .procworker import WorkerCrashed
+from .recovery import RecoveryStore, Supervisor
 from .router import ConsistentHashRouter, shard_key
 from .snapshot import GatewaySnapshot, ShardSnapshot
 from .worker import ShardWorker, WorkerQueueFull
@@ -156,6 +158,13 @@ class Gateway:
         combine_policy=None,
         worker_backend: str = "thread",
         dynamic: bool = False,
+        supervise: bool = False,
+        recovery_dir=None,
+        snapshot_every: int = 8,
+        crash_loop_threshold: int = 3,
+        crash_loop_window_s: float = 30.0,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
     ):
         # Library entry point that dispatches backend work (via the
         # schedulers it builds): arm the axon-wedge guard exactly like
@@ -210,6 +219,60 @@ class Gateway:
         # migration gate — byte-identical to the pre-autoscaler serving
         # path, pinned by test.
         self._dynamic = bool(dynamic)
+        # -- crash tolerance (supervised process tier) ---------------------
+        # supervise=True arms the per-worker supervisor: child death is
+        # detected (WorkerCrashed), classified, respawned under bounded
+        # exponential backoff with a crash-loop breaker, and every
+        # accepted event rides a per-fleet WAL + periodic micro-snapshots
+        # so a respawned child restores warm and replays only the tail.
+        # Default OFF: ingest takes no WAL append, no snapshot cadence,
+        # no routing re-check — byte-identical to unsupervised serving
+        # (pinned by test). Thread workers share the gateway's own crash
+        # domain, so supervision is the process backend's feature.
+        self._supervise = bool(supervise)
+        if self._supervise and worker_backend != "process":
+            raise ValueError(
+                "supervise=True needs worker_backend='process' (thread "
+                "workers live in the gateway's own crash domain — there "
+                "is no child to respawn)"
+            )
+        self.snapshot_every = max(1, int(snapshot_every))
+        self._recovery_store: Optional[RecoveryStore] = None
+        self._recovery_tmpdir: Optional[str] = None
+        # worker_id -> crash-loop policy; worker_id -> per-worker recovery
+        # serialization (recovery always runs on the crashed worker's own
+        # thread in steady state; the lock covers rare direct off-thread
+        # proxy reads). Per-worker, NOT global: a global lock would let
+        # two simultaneously-crashed workers deadlock through a
+        # quarantine's cross-worker rebuild round trips.
+        self._supervisors: Dict[int, Supervisor] = {}
+        self._recover_locks: Dict[int, object] = {}
+        self._quarantined_workers: List[int] = []
+        # shard key -> picklable child build spec, retained so a respawn
+        # (or quarantine re-home) can rebuild the shard from scratch.
+        self._specs: Dict[str, dict] = {}
+        # fleet -> cursor of the micro-snapshot whose counters were last
+        # folded. A snapshot's counters fold exactly ONCE — on the first
+        # crash after it was taken: a respawned child's counters cover
+        # only its own lifetime (post-restore), so a second crash off
+        # the SAME snapshot has nothing new below the cursor to fold,
+        # and re-folding would double count.  # guarded-by: self._migration_lock
+        self._snap_folded: Dict[str, int] = {}
+        self._sup_kwargs = dict(
+            threshold=crash_loop_threshold,
+            window_s=crash_loop_window_s,
+            backoff_base_s=backoff_base_s,
+            backoff_max_s=backoff_max_s,
+        )
+        if self._supervise:
+            if recovery_dir is None:
+                import tempfile
+
+                self._recovery_tmpdir = tempfile.mkdtemp(
+                    prefix="distilp-recovery-"
+                )
+                recovery_dir = self._recovery_tmpdir
+            self._recovery_store = RecoveryStore(recovery_dir)
         self.router = ConsistentHashRouter(n_workers, replicas=replicas)
         # Worker SLOTS: a retired worker leaves None at its index so
         # worker ids stay stable ring labels; iterate live_workers() —
@@ -349,11 +412,21 @@ class Gateway:
             # a ledgered run gets per-process compile attribution (the
             # bench federation section's zero-warm-compiles gate reads
             # it via ledger_counters()); an unledgered run pays nothing.
-            return ProcShardWorker(
+            w = ProcShardWorker(
                 worker_id,
                 metrics=self.metrics,
                 compile_ledger=_cl.current() is not None,
             )
+            if self._supervise:
+                self._supervisors[worker_id] = Supervisor(**self._sup_kwargs)
+                self._recover_locks[worker_id] = make_lock("gateway.recover")
+                # Read paths retry once after this hook recovers the
+                # worker in place (mutating calls never route through it).
+                w.recovery_hook = (
+                    lambda worker: self._recover_worker(worker)[0]
+                    == "respawned"
+                )
+            return w
         return ShardWorker(worker_id, metrics=self.metrics)
 
     def live_workers(self) -> List[ShardWorker]:
@@ -443,12 +516,25 @@ class Gateway:
             )
         widx = self.router.owner(key)
         worker = self.workers[widx]
+        spec = self._shard_spec(devices, model, fleet_id)
         worker.create_shard(
             key,
             build=lambda: self._build_scheduler(devices, model, fleet_id),
             state=state,
-            spec=self._shard_spec(devices, model, fleet_id),
+            spec=spec,
         )
+        if spec is not None:
+            # Retained for crash recovery: a respawned (or re-homed)
+            # child rebuilds the shard from this spec before restoring
+            # its micro-snapshot and replaying the WAL tail.
+            self._specs[key] = spec
+        if self._supervise and state is not None:
+            # A shard registered FROM a snapshot blob is warm before its
+            # first micro-snapshot lands; seed the recovery store with
+            # that blob so a crash in the gap still restores warm.
+            self._recovery_store.save_micro_snapshot(
+                fleet_id, events_handled, state, {}
+            )
         with self._migration_lock:
             self._shards[key] = (fleet_id, model_id, widx)
         self._fleet_key[fleet_id] = key
@@ -683,6 +769,14 @@ class Gateway:
         """
 
         def _do() -> PlacementView:
+            if self._supervise:
+                # Crash-tolerant path: WAL append before dispatch, crash
+                # detection + recovery around it. Kept out of line so the
+                # unsupervised closure below stays byte-identical.
+                return self._supervised_tick(
+                    fleet_id, key, worker, event, parent, t_enq,
+                    pressure, depth,
+                )
             attrs = {"worker": worker.worker_id}
             if depth is not None:
                 attrs["depth"] = depth
@@ -712,6 +806,86 @@ class Gateway:
                     )
 
         return _do
+
+    def _supervised_tick(
+        self, fleet_id: str, key: str, worker, event, parent, t_enq,
+        pressure: bool, depth: Optional[int],
+    ) -> PlacementView:
+        """One supervised tick, ON a worker thread: journal the event,
+        dispatch it, and on child death recover (respawn or quarantine)
+        before answering the waiter.
+
+        A quarantine may have re-homed this shard after the closure was
+        queued — the drain of a dead worker's queue runs on its (still
+        live) parent thread. Re-resolve the owner first and FORWARD to
+        its queue when it moved: the inner closure does its own WAL
+        append and cursor bump, so the forwarding frame must return
+        before the caller's bump region (it is inside ``_tick_closure``'s
+        ``_do`` body, before any bump of this frame's own).
+        """
+        with self._migration_lock:
+            cur = self.workers[self._shards[key][2]]
+        if cur is not worker:
+            box, done = self._submit_tick(
+                fleet_id, key, cur, event, parent, t_enq
+            )
+            done.wait()
+            if "exc" in box:
+                raise box["exc"]
+            return box["result"]
+        attrs = {"worker": worker.worker_id}
+        if depth is not None:
+            attrs["depth"] = depth
+        self.tracer.record_span(
+            "gateway.queue_wait",
+            t_enq if t_enq is not None else 0.0,
+            None,
+            parent=parent,
+            attrs=attrs,
+        )
+        with self.tracer.attach(parent):
+            cursor = self._handled.get(fleet_id, 0) + 1
+            # Journal BEFORE dispatch: a child that dies holding this
+            # event leaves it replayable from the WAL tail.
+            self._recovery_store.wal(fleet_id).append(cursor, event)
+            self.metrics.inc("wal_appends")
+            try:
+                try:
+                    if pressure:
+                        view = worker.shards[key].handle(
+                            event, pressure=True
+                        )
+                    else:
+                        view = worker.shards[key].handle(event)
+                except WorkerCrashed:  # dlint: disable=DLP017 accounted inside _recover_worker (worker_crashes inc + recovery_mttr_ms observe per attempt)
+                    # The RPC died mid-flight: whether the child applied
+                    # the event is UNKNOWABLE, so its partial state is
+                    # discarded entirely — recovery restores the last
+                    # micro-snapshot and replays the WAL tail, which
+                    # includes this event (appended above). Exactly-once
+                    # holds by construction, not by guessing.
+                    verdict, views = self._recover_worker(worker)
+                    view = views.get(fleet_id)
+                    if view is None:
+                        owner = self.workers[self._shards[key][2]]
+                        view = owner.shards[key].latest()
+                self._maybe_micro_snapshot(fleet_id, key, cursor)
+                return view
+            finally:
+                self._handled[fleet_id] = (
+                    self._handled.get(fleet_id, 0) + 1
+                )
+
+    def _maybe_micro_snapshot(self, fleet_id: str, key: str, cursor: int) -> None:
+        """Persist a micro-snapshot when ``cursor`` lands on a boundary
+        (the first event always snapshots — a kill before the first
+        boundary must still respawn warm). Runs on the owning worker's
+        thread; a crash DURING the dump is survivable (the previous
+        snapshot + WAL tail still cover everything), so failure here
+        only counts, never raises."""
+        if cursor != 1 and cursor % self.snapshot_every != 0:
+            return
+        self._maybe_micro_snapshot_at(fleet_id, key, cursor)
 
     def _submit_tick(
         self, fleet_id: str, key: str, worker: ShardWorker, event, parent, t_enq,
@@ -1002,9 +1176,14 @@ class Gateway:
                     self._resolve_waiters(waiters, shared)
                     return
                 try:
-                    shared["result"] = worker.shards[key].handle_coalesced(
-                        events, pressure=pressure
-                    )
+                    if self._supervise:
+                        shared["result"] = self._supervised_batch(
+                            fleet_id, key, worker, events, pressure
+                        )
+                    else:
+                        shared["result"] = worker.shards[key].handle_coalesced(
+                            events, pressure=pressure
+                        )
                 except BaseException as e:
                     # Counted here (not re-raised to the worker loop): the
                     # waiters below are the real consumers and each gets
@@ -1018,6 +1197,362 @@ class Gateway:
                     self._resolve_waiters(waiters, shared)
 
         return _do
+
+    def _supervised_batch(
+        self, fleet_id: str, key: str, worker, events, pressure: bool
+    ) -> PlacementView:
+        """The coalesced-drain analogue of ``_supervised_tick``: journal
+        every event of the batch before the one dispatch, recover on
+        child death, micro-snapshot when the batch crosses a boundary.
+        The CALLER's finally still bumps the cursor by ``len(events)``
+        — this method only journals and dispatches."""
+        with self._migration_lock:
+            cur = self.workers[self._shards[key][2]]
+        if cur is not worker:
+            # Shard re-homed by a quarantine after this drain was queued:
+            # run the whole supervised batch on the new owner's thread
+            # (serialized behind its queue) and hand back its view. The
+            # caller's cursor bump covers these events exactly once —
+            # this forwarded frame bumps nothing.
+            return cur.call(
+                lambda: self._supervised_batch(
+                    fleet_id, key, cur, events, pressure
+                )
+            )
+        base = self._handled.get(fleet_id, 0)
+        wal = self._recovery_store.wal(fleet_id)
+        for i, ev in enumerate(events):
+            wal.append(base + 1 + i, ev)
+        self.metrics.inc("wal_appends", len(events))
+        try:
+            view = worker.shards[key].handle_coalesced(
+                events, pressure=pressure
+            )
+        except WorkerCrashed:  # dlint: disable=DLP017 accounted inside _recover_worker (worker_crashes inc + recovery_mttr_ms observe per attempt)
+            verdict, views = self._recover_worker(worker)
+            view = views.get(fleet_id)
+            if view is None:
+                owner = self.workers[self._shards[key][2]]
+                view = owner.shards[key].latest()
+        cursor = base + len(events)
+        if base == 0 or cursor // self.snapshot_every > base // self.snapshot_every:
+            self._maybe_micro_snapshot_at(fleet_id, key, cursor)
+        return view
+
+    def _maybe_micro_snapshot_at(self, fleet_id: str, key: str, cursor: int) -> None:
+        """Unconditional micro-snapshot at ``cursor`` (the batch path
+        computed the boundary crossing itself — a batch can straddle one
+        without any member landing exactly on it)."""
+        owner = self.workers[self._shards[key][2]]
+        sched = owner.shards[key]
+        try:
+            state = sched.dump_state()
+            counters = dict(sched.metrics.counters)
+        except WorkerCrashed:
+            self.metrics.inc("micro_snapshot_failed")
+            return
+        self._recovery_store.save_micro_snapshot(
+            fleet_id, cursor, state, counters
+        )
+        self.metrics.inc("micro_snapshots")
+
+    # -- crash recovery ----------------------------------------------------
+
+    def _recover_worker(self, worker) -> Tuple[str, Dict[str, PlacementView]]:
+        """Bring a crashed process worker's shards back: respawn with
+        bounded backoff (retrying through double-crashes — snapshot
+        restore + WAL replay is idempotent, each attempt rebuilds from
+        scratch) or, when the crash-loop breaker opens, quarantine the
+        worker and re-home its slice onto the surviving ring.
+
+        Returns ``(verdict, views)`` where views maps each recovered
+        fleet_id to the placement view its replayed tail produced —
+        the supervised tick answers its waiter from this map.
+
+        Locking: per-WORKER recover locks, never one global lock — a
+        quarantine re-homes shards via round trips through OTHER workers'
+        queues, and two workers quarantining simultaneously under one
+        global lock would deadlock on each other's rebuild round trips.
+        Recovery otherwise runs inline on the dead worker's own (still
+        live) parent thread, so per-worker work is naturally serialized.
+        """
+        wid = worker.worker_id
+        lock = self._recover_locks.get(wid)
+        if lock is None:
+            raise RuntimeError(
+                f"worker {wid} crashed with supervision off"
+            )
+        with lock:
+            if wid in self._quarantined_workers:
+                return "quarantined", {}
+            if self._closed:
+                # Clean shutdown, not a crash: the gateway closed the
+                # child under us. Nothing to respawn.
+                return "stopped", {}
+            if worker.child_alive():
+                try:
+                    worker.rpc({"op": "ping"})
+                    # A racing caller on this thread already recovered it.
+                    return "respawned", {}
+                except WorkerCrashed:  # dlint: disable=DLP017 probe only: a dead ping falls through to the recovery loop below, whose record_crash/worker_crashes account every attempt
+                    pass
+            sup = self._supervisors[wid]
+            t0 = time.perf_counter()
+            views: Dict[str, PlacementView] = {}
+            while True:
+                verdict = sup.record_crash()
+                self.metrics.inc("worker_crashes")
+                if verdict == "quarantine" and len(self.live_worker_ids()) > 1:
+                    views = self._quarantine_worker(worker)
+                    mttr = (time.perf_counter() - t0) * 1000.0
+                    self.metrics.observe("recovery_mttr_ms", mttr)
+                    self._record_recovery(worker, "quarantine", mttr, views)
+                    return "quarantined", views
+                # A single-worker gateway has nowhere to re-home: keep
+                # respawning past the breaker (documented; the breaker
+                # still surfaces via crashes_in_window in /signals).
+                time.sleep(sup.backoff_s())
+                try:
+                    worker.respawn_child()
+                    self.metrics.inc("child_respawns")
+                    views = self._rebuild_worker_shards(worker)
+                    break
+                except WorkerCrashed:  # dlint: disable=DLP017 the loop's next record_crash() increments worker_crashes — every failed attempt is counted, none swallowed
+                    # Crash DURING recovery (respawn died, or replay
+                    # killed the fresh child): loop — the next attempt
+                    # restores the same snapshot and replays the same
+                    # tail. The abandoned attempt's counters die
+                    # unfolded, which is correct: attempt N+1 replays
+                    # the whole tail and regenerates them.
+                    continue
+            mttr = (time.perf_counter() - t0) * 1000.0
+            self.metrics.observe("recovery_mttr_ms", mttr)
+            self._record_recovery(worker, "respawn", mttr, views)
+            return "respawned", views
+
+    def _fold_snapshot_counters(self, fid: str, snap: Optional[dict]) -> None:
+        """Fold a dead child's micro-snapshot counters into the fleet's
+        running totals — at most ONCE per snapshot (see ``_snap_folded``):
+        the replay regenerates only the tail's counters, so the fold
+        covers exactly the prefix the restoring child will not recount,
+        and a repeat crash off the same snapshot folds nothing new."""
+        if not snap or not snap.get("counters"):
+            return
+        cursor = int(snap.get("cursor", 0))
+        with self._migration_lock:
+            if self._snap_folded.get(fid) == cursor:
+                return
+            self._snap_folded[fid] = cursor
+            acc = self._folded_counters.setdefault(fid, {})
+            for name, v in snap["counters"].items():
+                if v:
+                    acc[name] = acc.get(name, 0) + int(v)
+
+    def _rebuild_worker_shards(self, worker) -> Dict[str, PlacementView]:
+        """Rebuild every shard a freshly-respawned child owns: build from
+        the retained spec, restore the micro-snapshot (warm — load_state
+        rides the bit-exact chain), replay the WAL tail record by
+        record. Raises ``WorkerCrashed`` if the child dies mid-rebuild
+        (the caller's retry loop handles it)."""
+        from .procworker import SchedulerProxy
+
+        with self._migration_lock:
+            owned = [
+                (key, fid)
+                for key, (fid, _mid, widx) in self._shards.items()
+                if widx == worker.worker_id
+            ]
+        views: Dict[str, PlacementView] = {}
+        for key, fid in owned:
+            spec = self._specs.get(key)
+            snap, records = self._recovery_store.recovery_plan(fid)
+            worker.rpc({
+                "op": "build",
+                "key": key,
+                "spec": spec,
+                "state": snap["state"] if snap is not None else None,
+            })
+            # Installed directly (not via create_shard's queued closure):
+            # recovery already runs ON this worker's thread.
+            worker.shards[key] = SchedulerProxy(worker, key)
+            self._fold_snapshot_counters(fid, snap)
+            for _cursor, ev in records:
+                views[fid] = worker.shards[key].handle(ev)
+                self.metrics.inc("events_replayed")
+            self.metrics.inc("shards_recovered")
+        return views
+
+    def _quarantine_worker(self, worker) -> Dict[str, PlacementView]:
+        """Crash-loop breaker open: retire the worker from the ring and
+        re-home its shards onto the survivors (consistent hashing moves
+        ONLY the dead worker's keys), restoring each from its
+        micro-snapshot + WAL tail on the new owner's thread.
+
+        Stale closures already queued on the dead worker's drain forward
+        themselves: supervised paths re-resolve the owner at their top
+        and round-trip through the new owner's queue."""
+        wid = worker.worker_id
+        # Ring/worker-list rewrites share _migrate_serial with the
+        # autoscaler's spawn/retire (no _migrate_serial holder ever
+        # takes a recover lock, so the nesting is acyclic). The lock
+        # covers ONLY the attribute flips — the per-shard rebuilds are
+        # blocking round trips through other workers' queues and must
+        # not park a concurrent scale action behind them; shard entry
+        # ownership stays consistent under _migration_lock per entry.
+        with self._migrate_serial:
+            self._quarantined_workers.append(wid)
+            self.metrics.inc("workers_quarantined")
+            remaining = [i for i in self.live_worker_ids() if i != wid]
+            self.router = ConsistentHashRouter(
+                replicas=self.router.replicas, worker_ids=remaining
+            )
+        with self._migration_lock:
+            owned = [
+                (key, fid, mid)
+                for key, (fid, mid, widx) in self._shards.items()
+                if widx == wid
+            ]
+        views: Dict[str, PlacementView] = {}
+        for key, fid, mid in owned:
+            spec = self._specs.get(key)
+            snap, records = self._recovery_store.recovery_plan(fid)
+            tidx = self.router.owner(key)
+            target = self.workers[tidx]
+            target.create_shard(
+                key,
+                build=None,
+                state=snap["state"] if snap is not None else None,
+                spec=spec,
+            )
+            self._fold_snapshot_counters(fid, snap)
+
+            def _replay(target=target, key=key, recs=records, fid=fid):
+                out = None
+                for _cursor, ev in recs:
+                    out = target.shards[key].handle(ev)
+                    self.metrics.inc("events_replayed")
+                return out
+
+            v = target.call(_replay)
+            if v is not None:
+                views[fid] = v
+            self.metrics.inc("shards_recovered")
+            with self._migration_lock:
+                self._shards[key] = (fid, mid, tidx)
+        # Retire the slot from the worker's OWN thread (a stop() would
+        # join ourselves); queued closures still drain past the sentinel
+        # and forward themselves to the new owners.
+        worker.retire_crashed()
+        with self._migrate_serial:
+            self.workers[wid] = None
+            self.n_workers = len(remaining)
+        return views
+
+    def _record_recovery(self, worker, kind: str, mttr_ms: float, views) -> None:
+        """Flight-record the recovery trail with the signals snapshot
+        that accompanied it (the chaos contract: every kill's recovery
+        is reconstructible from the flight recorder alone)."""
+        if self.flight is None:
+            return
+        sig = None
+        if self.timeline is not None:
+            try:
+                sig = self.signals()
+            except Exception:  # dlint: disable=DLP017 the recovery record must land even when signals cannot be built mid-crash (e.g. a second worker down); sig=None records that fact
+                sig = None
+        sup = self._supervisors.get(worker.worker_id)
+        self.flight.record(
+            "recovery",
+            {
+                "t": time.time(),
+                "worker": worker.worker_id,
+                "action": kind,
+                "generation": worker.generation,
+                "pid": worker.child_pid,
+                "mttr_ms": round(mttr_ms, 3),
+                "fleets": sorted(views),
+                "crashes_in_window": (
+                    sup.crashes_in_window if sup is not None else None
+                ),
+                "signals": sig,
+            },
+        )
+
+    def recovery_status(self) -> dict:
+        """The supervision tier's audit surface (merged into ``/signals``
+        as the ``recovery`` block and probed by chaos_replay).
+
+        ``events_lost`` is the reconciliation: per fleet, the handled
+        cursor minus (live + folded) ``events_total`` — every accepted
+        event must be accounted for by exactly one application. Zero is
+        the contract; positive means lost events, negative double-apply.
+        """
+        c = self.metrics.counters
+        status = {
+            "supervised": self._supervise,
+            "worker_crashes": c.get("worker_crashes", 0),
+            "child_respawns": c.get("child_respawns", 0),
+            "shards_recovered": c.get("shards_recovered", 0),
+            "events_replayed": c.get("events_replayed", 0),
+            "wal_appends": c.get("wal_appends", 0),
+            "micro_snapshots": c.get("micro_snapshots", 0),
+            "workers_quarantined": c.get("workers_quarantined", 0),
+            "quarantined_workers": list(self._quarantined_workers),
+        }
+        per_fleet = self._per_worker(
+            lambda s, _fid: dict(s.metrics.counters)
+        )
+        lost = 0
+        warm = cold = ident = 0
+        for fid, cursor in self._handled.items():
+            live = per_fleet.get(fid, {})
+            folded = self._folded_counters.get(fid, {})
+            applied = (
+                live.get("events_total", 0)
+                + folded.get("events_total", 0)
+            )
+            lost += cursor - applied
+            warm += live.get("warm_resumes", 0) + folded.get("warm_resumes", 0)
+            cold += live.get("cold_resumes", 0) + folded.get("cold_resumes", 0)
+            # A restore whose first tick changed identity (structural
+            # event replayed first) proves nothing about warmth and
+            # counts as neither warm nor cold — surfaced so the crash
+            # contract can still reconcile one resume per recovery.
+            ident += live.get("resume_identity_changed", 0) + folded.get(
+                "resume_identity_changed", 0
+            )
+        status["events_lost"] = lost
+        status["warm_resumes"] = warm
+        status["cold_resumes"] = cold
+        status["identity_resumes"] = ident
+        lat = self.metrics.snapshot().get("latency", {})
+        mttr = lat.get("recovery_mttr_ms")
+        if mttr:
+            status["mttr_p50_ms"] = mttr.get("p50_ms")
+            status["mttr_p99_ms"] = mttr.get("p99_ms")
+        return status
+
+    def chaos_process_hook(self, fleet_id: str):
+        """The ``chaos_replay`` bridge for process-level faults: returns
+        ``hook(kind, spec)`` that aims each fault at the CURRENT owner
+        of ``fleet_id``'s shard (a kill may have re-homed it since the
+        last fault)."""
+        def hook(kind: str, spec) -> None:
+            key = self._fleet_key[fleet_id]
+            with self._migration_lock:
+                worker = self.workers[self._shards[key][2]]
+            if kind == "child_kill":
+                worker.kill_child()
+            elif kind == "rpc_torn":
+                worker.inject_torn_frame()
+            elif kind == "rpc_delay":
+                worker.inject_rpc_delay(
+                    getattr(spec, "delay_s", 0.05) or 0.05
+                )
+            else:
+                raise ValueError(f"unknown process fault kind {kind!r}")
+
+        return hook
 
     def _resolve_waiters(self, waiters, shared: dict) -> None:
         """Resolve a batch's waiters with one shared outcome (result or
@@ -1260,23 +1795,42 @@ class Gateway:
             raise ValueError(f"worker {dst_widx} is not live")
 
         # Phase 1 — prefetch: base snapshot + destination build, source
-        # still serving every tick.
-        base = src.dump_shard(key)
+        # still serving every tick. The source's cumulative counters ride
+        # along: if the flip later aborts because the source CHILD died,
+        # this prefetch is the last readable copy of them (counters are
+        # live-copy-only — they do not ride the dump blob).
+        def _prefetch(w=src, k=key):
+            s = w.shards[k]
+            return s.dump_state(), dict(s.metrics.counters)
+
+        base, pre_counters = src.call(_prefetch)
+        spec = self._spec_from_blob(base, fid)
         dst.create_shard(
             key,
             build=lambda: self._build_from_blob(base, fid),
             state=base,
-            spec=self._spec_from_blob(base, fid),
+            spec=spec,
         )
 
         # Phase 2 — park and flip.
         with self._migration_lock:
             self._migrating[key] = {"parked": []}
+        abort = {"src_lost": False}
 
         def _flip():
             ok = False
             try:
-                state = src.shards[key].dump_state()
+                try:
+                    state = src.shards[key].dump_state()
+                except WorkerCrashed:
+                    # The SOURCE child died under the flip dump: its
+                    # counters are gone with it — the abort path below
+                    # folds the prefetched copy so the fleet's totals
+                    # survive the crash. (A dst-side failure must NOT
+                    # set this: the source still serves, and folding a
+                    # still-counting copy would double count.)
+                    abort["src_lost"] = True
+                    raise
                 dst.load_shard(key, state)
                 ok = True
             finally:
@@ -1308,12 +1862,27 @@ class Gateway:
         except BaseException:
             # Failed flip: best-effort drop of the prefetched copy.
             self.metrics.inc("migration_failed")
+            if abort["src_lost"]:
+                # Source child crashed mid-migration: fold the Phase-1
+                # prefetched counters so the fleet's cumulative totals
+                # are not silently dropped with the dead child. (Events
+                # ticked between prefetch and crash are covered by the
+                # supervision tier's own snapshot fold when it is on.)
+                with self._migration_lock:
+                    acc = self._folded_counters.setdefault(fid, {})
+                    for name, v in pre_counters.items():
+                        if v:
+                            acc[name] = acc.get(name, 0) + v
             try:
                 dst.drop_shard(key)
             except Exception:  # dlint: disable=DLP017 the flip failure was counted (migration_failed) and re-raises below; this drop is best-effort cleanup of the never-published prefetch copy
                 pass
             raise
         self.metrics.inc("shards_migrated")
+        if spec is not None:
+            # The shard moved: future crash recovery rebuilds it on the
+            # destination from this (identical) spec.
+            self._specs[key] = spec
         if self.flight is not None:
             self.flight.record(
                 "migration",
@@ -1437,6 +2006,8 @@ class Gateway:
             "migrations": int(
                 self.metrics.counters.get("shards_migrated", 0)
             ),
+            "supervised": self._supervise,
+            "quarantined_workers": list(self._quarantined_workers),
             "actions": actions,
         }
 
@@ -1569,7 +2140,15 @@ class Gateway:
             def _collect(w=worker, ms=members) -> dict:
                 return {fid: extract(w.shards[k], fid) for k, fid in ms}
 
-            out.update(worker.call(_collect))
+            if threading.current_thread() is worker._thread:
+                # Re-entrant probe FROM a worker thread (the recovery
+                # trail snapshots /signals mid-closure): a queued round
+                # trip to ourselves would deadlock — run inline; the
+                # read is mid-closure rather than at a tick boundary,
+                # which is exactly what a crash-time snapshot wants.
+                out.update(_collect())
+            else:
+                out.update(worker.call(_collect))
         return out
 
     def healthz(self) -> dict:
@@ -1618,6 +2197,22 @@ class Gateway:
         snap["per_shard"] = per_shard
         snap["workers"] = self.n_workers
         snap["shards"] = len(self._shards)
+        return snap
+
+    def shard_metrics_snapshot(self, fleet_id: str) -> dict:
+        """One shard's metrics snapshot with the fleet's FOLDED counters
+        merged in: migrations and crash recoveries retire scheduler
+        copies whose counters fold gateway-side, and a per-shard audit
+        (chaos reconciliation, the walkthrough's counter reads) needs
+        the cumulative view, not just the live copy's. A fleet that
+        never migrated or crashed merges nothing — byte-identical."""
+        snap = self.read_shard(fleet_id, lambda s: s.metrics_snapshot())
+        with self._migration_lock:
+            folded = dict(self._folded_counters.get(fleet_id, {}))
+        if folded:
+            counters = snap.get("counters", {})
+            for name, v in folded.items():
+                counters[name] = counters.get(name, 0) + v
         return snap
 
     def prometheus_text(self) -> str:
@@ -1723,6 +2318,18 @@ class Gateway:
             # static samples stay byte-identical: live worker count is
             # the signal a replayed decision trail is audited against.
             out["control.workers"] = float(len(depths))
+        if self._supervise:
+            # Recovery series, only on supervised gateways (same
+            # byte-identical argument): zero-valued from the first
+            # sample so a kill's delta has a pre-incident baseline.
+            for name in (
+                "c.worker_crashes",
+                "c.child_respawns",
+                "c.events_replayed",
+                "c.shards_recovered",
+                "c.workers_quarantined",
+            ):
+                out.setdefault(name, 0.0)
         from ..obs import compile_ledger as _cl
 
         led = _cl.current()
@@ -1769,6 +2376,9 @@ class Gateway:
                 self._combiner.snapshot()
                 if self._combiner is not None
                 else None
+            ),
+            recovery=(
+                self.recovery_status() if self._supervise else None
             ),
         ).model_dump()
 
@@ -1909,6 +2519,12 @@ class Gateway:
             self._combiner.stop()
         for w in self.live_workers():
             w.stop()
+        if self._recovery_store is not None:
+            self._recovery_store.close()
+        if self._recovery_tmpdir is not None:
+            import shutil
+
+            shutil.rmtree(self._recovery_tmpdir, ignore_errors=True)
 
     def __enter__(self) -> "Gateway":
         return self
@@ -1950,7 +2566,10 @@ class ShardFacade:
         return self._gw.latest(self._fleet)
 
     def metrics_snapshot(self) -> dict:
-        return self._read(lambda s: s.metrics_snapshot())
+        # Routed through the gateway so counters folded from retired
+        # scheduler copies (migrations, crash recoveries) stay in the
+        # fleet's totals; without folds this is the plain shard read.
+        return self._gw.shard_metrics_snapshot(self._fleet)
 
     def health_snapshot(self) -> dict:
         return self._read(lambda s: s.health_snapshot())
@@ -1964,7 +2583,34 @@ class ShardFacade:
 
     @property
     def fleet(self) -> FleetReadView:
-        def _capture(s: Scheduler) -> FleetReadView:
+        def _capture(s) -> FleetReadView:
+            if hasattr(s, "fleet_view"):
+                # Process-backed shard: the scheduler lives in a child
+                # and ``s`` is a SchedulerProxy — ``s._published`` would
+                # read the proxy, not the scheduler. One RPC captures
+                # the whole view child-side instead.
+                wire = s.fleet_view()
+                if wire is None:
+                    raise AttributeError(
+                        "shard scheduler exposes no fleet"
+                    )
+                model = wire["model"]
+                if isinstance(model, dict):
+                    model = ModelProfile.model_validate(model)
+                devices = {
+                    did: (
+                        DeviceProfile.model_validate(d)
+                        if isinstance(d, dict)
+                        else d
+                    )
+                    for did, d in wire["devices"].items()
+                }
+                return FleetReadView(
+                    seq=wire["seq"],
+                    model=model,
+                    devices=devices,
+                    published_seq=wire["published_seq"],
+                )
             pub = s._published
             return FleetReadView(
                 seq=s.fleet.seq,
@@ -1998,8 +2644,15 @@ class ShardFacade:
             object.__setattr__(self, name, value)
 
 
-def view_to_dict(view: PlacementView) -> dict:
-    """A served placement as the JSON the HTTP tier returns."""
+def view_to_dict(view) -> dict:
+    """A served placement as the JSON the HTTP tier returns.
+
+    Stub scheduler factories serve plain-dict views (already JSON);
+    those pass through untouched so the HTTP tier works over a
+    stub-backed gateway (crash-taxonomy tests, process smokes).
+    """
+    if isinstance(view, dict):
+        return view
     r = view.result
     return {
         "k": r.k,

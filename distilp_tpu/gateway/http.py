@@ -53,6 +53,7 @@ from typing import Optional, Tuple
 
 from ..obs.trace import now_ms
 from .gateway import Gateway, QueueFull, view_to_dict
+from .procworker import WorkerCrashed
 
 _MAX_BODY = 8 * 1024 * 1024  # a DeviceJoin carries a full profile; 8 MB is generous
 _MAX_HEADER_LINES = 64
@@ -153,6 +154,19 @@ class GatewayHTTPServer:
         except (KeyError, FileNotFoundError) as e:
             self.gateway.metrics.inc("http_not_found")
             status, payload = 404, {"error": str(e)}
+        except WorkerCrashed as e:
+            # A child died under this request and the supervised retry
+            # budget (read-only RPCs retry once against the respawn;
+            # mutating calls never retry) is spent. 503, not 500: the
+            # gateway itself is fine, the shard is mid-recovery — the
+            # client should back off and retry.
+            self.gateway.metrics.inc("http_worker_crashed")
+            status, payload = 503, {
+                "error": str(e),
+                "worker": e.worker_id,
+                "op": e.op,
+            }
+            headers = {"Retry-After": "1"}
         except RuntimeError as e:
             # e.g. "no placement published yet" — the shard exists but has
             # nothing servable; a retriable condition, not a client error.

@@ -45,6 +45,7 @@ import struct
 import subprocess
 import sys
 import tempfile
+import time
 from typing import Any, Callable, Dict, Optional
 
 from ..sched.metrics import SchedulerMetrics
@@ -56,6 +57,42 @@ _LEN = struct.Struct(">Q")
 # Scheduler methods whose return value is a PlacementView (or None):
 # converted to a wire dict child-side, rebuilt parent-side.
 _VIEW_METHODS = frozenset({"handle", "handle_coalesced", "latest"})
+
+
+class WorkerCrashed(Exception):
+    """The child process died under (or before) an RPC.
+
+    Deliberately a plain ``Exception`` — NOT ``RuntimeError`` (the HTTP
+    ladder maps that to 409) and NOT ``EOFError`` (that means the HTTP
+    *client* hung up, a 400). ``gateway/http.py`` catches this type
+    explicitly and answers 503 + Retry-After: the shard is coming back.
+
+    Carries the pending-call inventory so the supervisor (and the error
+    text a caller sees) knows exactly what was in flight: the RPC op
+    that died on the wire is AMBIGUOUS (it may or may not have applied
+    child-side — recovery resolves it from the WAL), while the queued
+    closures behind it never dispatched and simply run post-recovery.
+    """
+
+    def __init__(
+        self,
+        worker_id: int,
+        returncode: Optional[int],
+        op: Optional[str],
+        queued: int,
+        detail: str = "",
+    ):
+        self.worker_id = worker_id
+        self.returncode = returncode
+        self.op = op
+        self.queued = queued
+        msg = (
+            f"process worker {worker_id} child crashed (rc={returncode}) "
+            f"during op {op!r}; {queued} queued call(s) pending"
+        )
+        if detail:
+            msg += f" [{detail}]"
+        super().__init__(msg)
 
 
 # -- framing (shared by both ends) ----------------------------------------
@@ -196,7 +233,18 @@ class SchedulerProxy:
             return _view_from_wire(out)
         return out
 
-    # the tick surface
+    def _retry_read(self, fn):
+        """Read-only RPCs retry ONCE against a respawned child; mutating
+        calls never come through here — a mutation that died on the wire
+        is ambiguous, and resolving it is the WAL's job, not a retry's."""
+        try:
+            return fn()
+        except WorkerCrashed:
+            if not self._owner.ensure_recovered():
+                raise
+            return fn()
+
+    # the tick surface (mutating — NEVER auto-retried)
     def handle(self, event, pressure: bool = False):
         if pressure:
             return self._call("handle", event, pressure=True)
@@ -206,38 +254,52 @@ class SchedulerProxy:
         return self._call("handle_coalesced", events, pressure=pressure)
 
     def latest(self):
-        return self._call("latest")
+        return self._retry_read(lambda: self._call("latest"))
 
     # the snapshot chain (bit-exact blobs pass through untouched)
     def dump_state(self) -> dict:
-        return self._call("dump_state")
+        return self._retry_read(lambda: self._call("dump_state"))
 
     def load_state(self, state: dict) -> None:
         self._call("load_state", state)
 
     # the read surface
     def health_snapshot(self) -> dict:
-        return self._call("health_snapshot")
+        return self._retry_read(lambda: self._call("health_snapshot"))
 
     def metrics_snapshot(self) -> dict:
-        return self._call("metrics_snapshot")
+        return self._retry_read(lambda: self._call("metrics_snapshot"))
+
+    def fleet_view(self) -> Optional[dict]:
+        """The child fleet's read surface as a plain dict (seq, published
+        seq, model, devices) — None when the scheduler has no fleet
+        (stub factories). The facade rebuilds a FleetReadView from it."""
+        return self._retry_read(
+            lambda: self._owner.rpc({"op": "fleet_view", "key": self._key})
+        )
 
     @property
     def health(self) -> str:
-        return self._owner.rpc(
-            {"op": "getattr", "key": self._key, "name": "health"}
+        return self._retry_read(
+            lambda: self._owner.rpc(
+                {"op": "getattr", "key": self._key, "name": "health"}
+            )
         )
 
     @property
     def metrics(self) -> _MetricsView:
-        out = self._owner.rpc({"op": "metrics", "key": self._key})
+        out = self._retry_read(
+            lambda: self._owner.rpc({"op": "metrics", "key": self._key})
+        )
         return _MetricsView(out["counters"], out["snapshot"])
 
     # the control surface (autoscaler spec_k actuation)
     @property
     def spec_k(self) -> int:
-        return self._owner.rpc(
-            {"op": "getattr", "key": self._key, "name": "spec_k"}
+        return self._retry_read(
+            lambda: self._owner.rpc(
+                {"op": "getattr", "key": self._key, "name": "spec_k"}
+            )
         )
 
     @spec_k.setter
@@ -276,30 +338,20 @@ class ProcShardWorker(ShardWorker):
         compile_ledger: bool = False,
     ):
         self._sock_dir = tempfile.mkdtemp(prefix=f"distilp-pw{worker_id}-")
-        path = os.path.join(self._sock_dir, "rpc.sock")
-        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._listener.bind(path)
-        self._listener.listen(1)
-        cmd = [
-            python or sys.executable,
-            "-m",
-            "distilp_tpu.gateway.procworker",
-            "--socket",
-            path,
-        ]
-        if compile_ledger:
-            cmd.append("--compile-ledger")
-        self._proc = subprocess.Popen(cmd)
-        self._listener.settimeout(spawn_timeout_s)
-        try:
-            self._conn, _ = self._listener.accept()
-        except socket.timeout:
-            self._proc.kill()
-            raise RuntimeError(
-                f"process worker {worker_id} child did not connect within "
-                f"{spawn_timeout_s}s"
-            )
-        self._conn.settimeout(None)
+        self._python = python
+        self._spawn_timeout_s = spawn_timeout_s
+        self._compile_ledger = compile_ledger
+        # Bumped on every respawn; each generation gets its own socket
+        # path so a straggling old child can never connect to the new
+        # listener.
+        self._generation = 0
+        self._delay_next_rpc = 0.0
+        # Installed by a supervising Gateway: called with this worker
+        # when an RPC dies under a read path; returns True when the
+        # worker was respawned in place (safe to retry a read), False
+        # when unsupervised or quarantined.
+        self.recovery_hook: Optional[Callable[["ProcShardWorker"], bool]] = None
+        self._spawn(worker_id)
         # Serializes request/reply pairs on the one channel: the worker
         # thread is the steady-state caller but control-plane reads
         # (health probes under load, ledger snapshots) share it.
@@ -307,16 +359,63 @@ class ProcShardWorker(ShardWorker):
         super().__init__(worker_id, metrics)
         self.rpc({"op": "ping"})  # fail fast if the child can't serve
 
+    def _spawn(self, worker_id: int) -> None:
+        """Bind a fresh generation socket, spawn the child, accept."""
+        name = f"rpc{self._generation}.sock" if self._generation else "rpc.sock"
+        path = os.path.join(self._sock_dir, name)
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(path)
+        self._listener.listen(1)
+        cmd = [
+            self._python or sys.executable,
+            "-m",
+            "distilp_tpu.gateway.procworker",
+            "--socket",
+            path,
+        ]
+        if self._compile_ledger:
+            cmd.append("--compile-ledger")
+        self._proc = subprocess.Popen(cmd)
+        self._listener.settimeout(self._spawn_timeout_s)
+        try:
+            self._conn, _ = self._listener.accept()
+        except socket.timeout:
+            self._proc.kill()
+            raise RuntimeError(
+                f"process worker {worker_id} child did not connect within "
+                f"{self._spawn_timeout_s}s"
+            )
+        self._conn.settimeout(None)
+
     # -- channel -----------------------------------------------------------
 
     def rpc(self, req: dict) -> Any:
-        with self._rpc_lock:
-            send_frame(self._conn, req)
-            reply = recv_frame(self._conn)
+        delay = self._delay_next_rpc
+        if delay:
+            self._delay_next_rpc = 0.0
+            time.sleep(delay)
+        try:
+            with self._rpc_lock:
+                send_frame(self._conn, req)
+                reply = recv_frame(self._conn)
+        except (EOFError, OSError) as e:
+            # Send hit a broken pipe, or recv saw bytes-then-EOF: the
+            # child died mid-call. Typed so callers (and the HTTP
+            # ladder) can tell a crashed worker from a client hangup.
+            raise WorkerCrashed(
+                self.worker_id,
+                self._reap_returncode(),
+                req.get("op"),
+                self.depth(),
+                detail=str(e),
+            ) from e
         if reply is None:
-            raise EOFError(
-                f"process worker {self.worker_id} child exited "
-                f"(rc={self._proc.poll()})"
+            raise WorkerCrashed(
+                self.worker_id,
+                self._reap_returncode(),
+                req.get("op"),
+                self.depth(),
+                detail="clean EOF at frame boundary",
             )
         if reply.get("ok"):
             return reply.get("result")
@@ -324,6 +423,101 @@ class ProcShardWorker(ShardWorker):
         if isinstance(exc, BaseException):
             raise exc
         raise RuntimeError(f"process worker {self.worker_id}: {exc}")
+
+    def _reap_returncode(self) -> Optional[int]:
+        """The child's exit status for a WorkerCrashed. The socket EOF
+        races the SIGCHLD: the parent's blocked recv often notices the
+        death before the corpse is reapable, and a bare ``poll()`` would
+        report ``None`` — erasing the taxonomy (SIGKILL's -9 vs a torn
+        frame's deliberate nonzero exit). A dead peer implies an exit is
+        imminent, so a short wait is bounded in practice."""
+        rc = self._proc.poll()
+        if rc is not None:
+            return rc
+        try:
+            return self._proc.wait(timeout=2.0)
+        except Exception:  # dlint: disable=DLP017 the exit status is diagnostic garnish; a child that outlives the wait is reaped by stop()/respawn and the crash itself is already being raised
+            return None
+
+    # -- supervision surface ----------------------------------------------
+
+    def child_alive(self) -> bool:
+        return self._proc.poll() is None
+
+    @property
+    def child_pid(self) -> int:
+        return self._proc.pid
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    def ensure_recovered(self) -> bool:
+        """Route a crashed read through the gateway's supervisor (if one
+        is installed). True → respawned in place, retry the read."""
+        hook = self.recovery_hook
+        if hook is None:
+            return False
+        return bool(hook(self))
+
+    def respawn_child(self) -> int:
+        """Tear the dead channel down, spawn a fresh child, re-ping.
+
+        The caller (the gateway's supervisor) owns shard state: after
+        this returns the child is EMPTY — every shard must be rebuilt
+        from its spec + micro-snapshot and the WAL tail replayed before
+        the worker serves again. Returns the new child pid.
+        """
+        for s in (self._conn, self._listener):
+            try:
+                s.close()
+            except OSError:  # dlint: disable=DLP017 closing a channel the dead child already tore down; the respawn below is the observable outcome
+                pass
+        try:
+            self._proc.kill()
+            self._proc.wait(timeout=5.0)
+        except Exception:  # dlint: disable=DLP017 reaping an already-dead child can raise; the fresh spawn below is the enforcement
+            pass
+        self._generation += 1
+        self._spawn(self.worker_id)
+        self.rpc({"op": "ping"})
+        return self._proc.pid
+
+    # -- process-level chaos primitives (sched/faults.py drives these) ----
+
+    def kill_child(self) -> Optional[int]:
+        """SIGKILL the child (chaos ``child_kill``). The next RPC — or
+        the one currently blocked on the socket — raises WorkerCrashed."""
+        try:
+            self._proc.kill()
+            self._proc.wait(timeout=5.0)
+        except Exception:  # dlint: disable=DLP017 chaos primitive: the child may already be dead; the WorkerCrashed on the next RPC is the observable signal
+            pass
+        return self._proc.poll()
+
+    def inject_torn_frame(self) -> None:
+        """Half-close the channel mid-frame (chaos ``rpc_torn``): write a
+        partial length header, then shut the socket down. The child's
+        ``_recv_exact`` sees bytes-then-EOF → EOFError → nonzero exit (a
+        torn peer must never parse as a frame); the parent's next RPC
+        raises WorkerCrashed on the closed channel."""
+        with self._rpc_lock:
+            try:
+                self._conn.sendall(_LEN.pack(1 << 20)[: _LEN.size // 2])
+                self._conn.shutdown(socket.SHUT_RDWR)
+            except OSError:  # dlint: disable=DLP017 chaos primitive: channel already dead is the same observable outcome (next RPC raises WorkerCrashed)
+                pass
+            self._conn.close()
+        try:
+            self._proc.wait(timeout=5.0)
+        except Exception:  # dlint: disable=DLP017 a child that survives a torn channel gets SIGKILLed; either way the next RPC raises WorkerCrashed
+            self._proc.kill()
+
+    def inject_rpc_delay(self, delay_s: float) -> None:
+        """One-shot latency injection (chaos ``rpc_delay``): the next RPC
+        sleeps ``delay_s`` before dispatch, stretching the tick without
+        killing anything — the degraded-but-alive corner of the plan."""
+        self._delay_next_rpc = float(delay_s)
 
     # -- shard lifecycle ---------------------------------------------------
 
@@ -363,6 +557,33 @@ class ProcShardWorker(ShardWorker):
             self._proc.wait(timeout=timeout)
         except Exception:  # dlint: disable=DLP017 the recovery IS the recording: a child that ignores stop gets SIGKILLed, never orphaned
             self._proc.kill()
+        for s in (self._conn, self._listener):
+            try:
+                s.close()
+            except Exception:  # dlint: disable=DLP017 socket already torn down by the dead child; nothing to account
+                pass
+        import shutil
+
+        shutil.rmtree(self._sock_dir, ignore_errors=True)
+
+    def retire_crashed(self) -> None:
+        """Teardown FROM this worker's own thread, child already dead:
+        the quarantine path runs inside one of our queued closures, so
+        ``stop()``'s forced join would deadlock on ourselves. Marks the
+        queue stopped (the sentinel still drains queued closures first —
+        supervised ones forward themselves to the shard's new owner),
+        reaps the corpse, and releases the sockets. No stop RPC: there
+        is no child to answer it."""
+        # Drop the dead proxies BEFORE the stop sentinel's _close_all
+        # drains: each close would RPC a corpse and raise into a box
+        # nobody reads. The shards were already re-homed.
+        self.shards.clear()
+        super().stop(join=False)
+        try:
+            self._proc.kill()
+            self._proc.wait(timeout=5)
+        except Exception:  # dlint: disable=DLP017 the child is already a corpse (or reaped); this kill is belt-and-braces against a half-dead child, not a recordable failure
+            pass
         for s in (self._conn, self._listener):
             try:
                 s.close()
@@ -441,6 +662,25 @@ def _child_dispatch(shards: Dict[str, Any], req: dict) -> Any:
     if op == "metrics":
         m = shards[req["key"]].metrics
         return {"counters": dict(m.counters), "snapshot": m.snapshot()}
+    if op == "fleet_view":
+        sched = shards[req["key"]]
+        fleet = getattr(sched, "fleet", None)
+        if fleet is None:
+            return None
+        model = getattr(fleet, "model", None)
+        devices = getattr(fleet, "devices", None) or {}
+        # The published seq lives on the scheduler's placement record,
+        # not the fleet — mirror ShardFacade's thread-path `_capture`.
+        pub = getattr(sched, "_published", None)
+        return {
+            "seq": getattr(fleet, "seq", 0),
+            "published_seq": None if pub is None else getattr(pub, "seq", None),
+            "model": model.model_dump() if hasattr(model, "model_dump") else None,
+            "devices": {
+                did: d.model_dump() if hasattr(d, "model_dump") else d
+                for did, d in dict(devices).items()
+            },
+        }
     if op == "drop":
         sched = shards.pop(req["key"], None)
         if sched is not None:

@@ -66,18 +66,48 @@ def snapshot_path(directory) -> Path:
     return Path(directory) / SNAPSHOT_FILENAME
 
 
+def _durable_replace(tmp: Path, path: Path) -> None:
+    """``os.replace`` with the two fsyncs rename-atomicity forgets.
+
+    ``tmp`` must already hold the complete payload. The file is fsynced
+    BEFORE the rename (so the bytes are on the platter when the name
+    flips) and the parent directory is fsynced after (so the rename
+    itself survives a host crash — without it the directory entry can
+    still point at the old inode after power loss, or at nothing).
+    Directory fds are unsupported on some filesystems; that fsync is
+    best-effort by design, the file fsync is not.
+    """
+    fd = os.open(tmp, os.O_RDWR)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(path.parent, os.O_RDONLY)
+    except OSError:  # dlint: disable=DLP017 directory fds unsupported on some filesystems; the directory fsync is best-effort by contract (docstring), the file fsync above is not
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:  # dlint: disable=DLP017 same best-effort contract: a filesystem that rejects directory fsync still got the file fsync + atomic rename
+        pass
+    finally:
+        os.close(dfd)
+
+
 def save_snapshot(snap: GatewaySnapshot, directory) -> Path:
-    """Write the snapshot atomically (tmp + rename) under ``directory``.
+    """Write the snapshot atomically (tmp + durable rename) under ``directory``.
 
     A crash mid-write must leave either the previous snapshot or none —
-    never a torn file a restore would half-parse.
+    never a torn file a restore would half-parse — and a snapshot that
+    returned from here must survive a host crash (`_durable_replace`).
     """
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     path = snapshot_path(directory)
     tmp = path.with_suffix(".json.tmp")
     tmp.write_text(json.dumps(snap.model_dump()))
-    os.replace(tmp, path)
+    _durable_replace(tmp, path)
     return path
 
 

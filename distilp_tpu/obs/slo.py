@@ -558,6 +558,11 @@ class SignalsPayload(BaseModel):
     # Cross-shard solve combiner state. Additive (None when the gateway
     # runs per-shard), same versioning argument as mem_headroom_bytes.
     combine: Optional[CombineSignal] = None
+    # Crash-recovery posture (Gateway.recovery_status()): crash/respawn/
+    # quarantine counters, events replayed vs lost, MTTR quantiles.
+    # Additive (None unless the gateway supervises a process tier); the
+    # controller's quarantine vote keys on workers_quarantined here.
+    recovery: Optional[dict] = None
 
 
 def build_signals(
@@ -567,6 +572,7 @@ def build_signals(
     now: Optional[float] = None,
     rate_window_s: float = 30.0,
     combine: Optional[dict] = None,
+    recovery: Optional[dict] = None,
 ) -> SignalsPayload:
     """Assemble the ``/signals`` payload from a timeline (+ optional SLO
     engine and capacity estimate). Pure read — safe on any thread."""
@@ -665,6 +671,7 @@ def build_signals(
         headroom_eps=headroom,
         mem_headroom_bytes=mem_headroom,
         combine=CombineSignal(**combine) if combine is not None else None,
+        recovery=dict(recovery) if recovery is not None else None,
     )
 
 

@@ -47,12 +47,19 @@ FAULT_KINDS = (
     "nan_poison",
     "malformed_event",
     "dropout_burst",
+    "child_kill",
+    "rpc_torn",
+    "rpc_delay",
 )
 
 # Fault channels that fire inside the solve attempt (via fault_hook) vs
-# ones injected as churn events ahead of the trace event.
+# ones injected as churn events ahead of the trace event vs ones aimed at
+# the PROCESS boundary (SIGKILL, torn RPC frames, RPC delay) through the
+# gateway's ``chaos_process_hook`` — only meaningful against a
+# process-backed worker tier, rejected otherwise.
 SOLVER_CHANNEL = frozenset({"solver_exception", "latency_spike"})
 EVENT_CHANNEL = frozenset({"nan_poison", "malformed_event", "dropout_burst"})
+PROCESS_CHANNEL = frozenset({"child_kill", "rpc_torn", "rpc_delay"})
 
 
 class InjectedSolverFault(RuntimeError):
@@ -73,6 +80,9 @@ class FaultSpec(BaseModel):
         "nan_poison",
         "malformed_event",
         "dropout_burst",
+        "child_kill",
+        "rpc_torn",
+        "rpc_delay",
     ]
     at_ticks: Optional[List[int]] = None
     p: float = 0.0
@@ -86,6 +96,8 @@ class FaultSpec(BaseModel):
     # dropout_burst: devices dropped at once, and ticks until they rejoin.
     burst_size: int = 1
     rejoin_after: int = 2
+    # rpc_delay: seconds the owning worker stalls its next RPC dispatch.
+    delay_s: float = 0.05
 
 
 class FaultPlan(BaseModel):
@@ -208,6 +220,22 @@ class FaultInjector:
                     self._count("injected", spec.kind)
         return out
 
+    # -- process channel (gateway.chaos_process_hook) ---------------------
+
+    def process_faults(self, tick: int, specs) -> List[Tuple[int, FaultSpec]]:
+        """The process-channel specs active this tick, counted as
+        injected; ``chaos_replay`` fires each through the gateway's
+        process hook (SIGKILL, torn frame, RPC delay)."""
+        out = [(i, s) for i, s in specs if s.kind in PROCESS_CHANNEL]
+        for _, spec in out:
+            self._count("injected", spec.kind)
+        return out
+
+    def count_fired(self, kind: str) -> None:
+        """Record that a process-channel fault actually fired (the hook
+        returned — the kill/torn-frame/delay landed on the child)."""
+        self._count("fired", kind)
+
     def pop_rejoins(self, tick: int) -> list:
         """Device profiles due to rejoin at (or before) this tick."""
         due = []
@@ -276,7 +304,10 @@ class FaultInjector:
         self.counters[f"{phase}_{kind}"] += 1
         if phase == "injected":
             self.counters["injected_total"] += 1
-        if self.metrics is not None:
+        # hasattr, not None-check: a process-backed shard's facade hands
+        # a read-only _MetricsView (child-side counters over RPC) with no
+        # inc(); the injector's own self.counters still mirror everything.
+        if self.metrics is not None and hasattr(self.metrics, "inc"):
             self.metrics.inc(f"fault_{phase}_{kind}")
             if phase == "injected":
                 self.metrics.inc("faults_injected_total")
@@ -295,6 +326,11 @@ class ChaosRecord(NamedTuple):
     view: object  # the PlacementView served after the event
     ms: float
     L: int = 0  # the model's layer count in force when the view was served
+    # Fleet-seq advance across the handle: 1 = applied exactly once, 0 =
+    # quarantined, >1 = DOUBLE-APPLIED (a crash-recovery replay applied
+    # the event on top of the dead child's application — the exactly-once
+    # contract's per-record reconciliation key).
+    seq_delta: int = 1
 
 
 class ChaosReport(NamedTuple):
@@ -306,9 +342,14 @@ class ChaosReport(NamedTuple):
     ticks_to_healthy: Optional[int]  # clean ticks until healthy (0 = already)
     final_health: str
     metrics: dict  # scheduler metrics snapshot at the end
+    # The supervision tier's audit at soak end (Gateway.recovery_status,
+    # via chaos_replay's recovery_probe) — None on a soak that injected
+    # no process faults / ran without a supervised gateway. Feeds the
+    # crash-contract section of violations().
+    recovery: Optional[dict] = None
 
     def summary(self) -> dict:
-        return {
+        out = {
             "events": len(self.views),
             "handled": len(self.records),
             "injected": {
@@ -319,6 +360,25 @@ class ChaosReport(NamedTuple):
             "ticks_to_healthy": self.ticks_to_healthy,
             "final_health": self.final_health,
         }
+        if self.recovery is not None:
+            out["recovery"] = {
+                k: self.recovery.get(k)
+                for k in (
+                    "worker_crashes",
+                    "child_respawns",
+                    "workers_quarantined",
+                    "shards_recovered",
+                    "events_replayed",
+                    "events_lost",
+                    "warm_resumes",
+                    "cold_resumes",
+                    "identity_resumes",
+                    "mttr_p50_ms",
+                    "mttr_p99_ms",
+                )
+                if k in self.recovery
+            }
+        return out
 
     def violations(self, L: Optional[int] = None) -> List[str]:
         """Soak-contract violations (empty = the chaos soak passed).
@@ -449,6 +509,73 @@ class ChaosReport(NamedTuple):
                     "sequential chaos soak (nothing was concurrently "
                     "queued, so nothing could be shed or coalesced)"
                 )
+        # Crash contract (process-level chaos against a supervised
+        # gateway): every accepted event applied exactly once or shed,
+        # respawns restore WARM, every crash actually recovered, and the
+        # reconciliation the recovery tier reports agrees with the
+        # record-by-record seq deltas above.
+        if self.recovery is not None:
+            rec = self.recovery
+            lost = rec.get("events_lost", 0)
+            if lost:
+                out.append(
+                    f"crash recovery: events_lost={lost} (every accepted "
+                    "event must be applied exactly once or shed; positive "
+                    "= lost, negative = double-applied)"
+                )
+            dbl = sum(
+                1 for r in self.records
+                if getattr(r, "seq_delta", 1) > 1
+            )
+            if dbl:
+                out.append(
+                    f"crash recovery: {dbl} record(s) advanced the fleet "
+                    "seq more than once (a recovery replay re-applied an "
+                    "event the dead child had already applied)"
+                )
+            cold = rec.get("cold_resumes", 0)
+            if cold:
+                out.append(
+                    f"crash recovery: cold_resumes={cold} (a respawned "
+                    "shard must restore WARM from its micro-snapshot — "
+                    "zero post-recovery cold ticks)"
+                )
+            crashes = rec.get("worker_crashes", 0)
+            recovered = rec.get("child_respawns", 0) + rec.get(
+                "workers_quarantined", 0
+            )
+            if crashes and not recovered:
+                out.append(
+                    f"crash recovery: {crashes} worker crash(es) but "
+                    "nothing respawned or quarantined"
+                )
+            shards = rec.get("shards_recovered", 0)
+            warm = rec.get("warm_resumes", 0)
+            # A first post-restore tick that changed identity (structural
+            # event replayed first) proves nothing about warmth and
+            # legitimately counts as neither warm nor cold. The resume
+            # tally is checked one-sided: resume classifications from an
+            # epoch BETWEEN two crashes are lost whenever no micro-
+            # snapshot captured them before the next kill (the fold
+            # carries the last snapshot's counters; events still
+            # reconcile because WAL replay re-applies the tail), so
+            # equality would flake on kill timing. More resumes than
+            # recoveries, or none at all, cannot be explained that way.
+            ident = rec.get("identity_resumes", 0)
+            if warm + ident > shards:
+                out.append(
+                    f"crash recovery: warm_resumes={warm} + "
+                    f"identity_resumes={ident} exceeds "
+                    f"{shards} shard recover(ies) "
+                    "(a restored shard resumed more than once)"
+                )
+            if shards and warm + ident == 0:
+                out.append(
+                    f"crash recovery: {shards} shard recover(ies) but no "
+                    "resume was ever observed (restored shards never "
+                    "proved warm — warm_resumes and "
+                    "resume_identity_changed both zero)"
+                )
         if self.ticks_to_healthy is None:
             out.append(
                 f"service did not return to healthy (final state: "
@@ -471,15 +598,25 @@ def chaos_replay(
     plan: FaultPlan,
     recovery_tick_budget: int = 25,
     on_event=None,
+    process_hook=None,
+    recovery_probe=None,
 ) -> ChaosReport:
     """Drive a scheduler through a trace under a fault plan, then recover.
 
-    Trace events are handled in order; each tick first flushes due
-    dropout-burst rejoins, then injects the tick's event-channel faults
-    (which the quarantine gate must reject), arms the solver-channel faults
-    on the scheduler's ``fault_hook``, and finally handles the real trace
-    event. After the trace, clean no-op load ticks run until the scheduler
+    Trace events are handled in order; each tick first fires the tick's
+    PROCESS-channel faults through ``process_hook`` (SIGKILL the owning
+    child, tear an RPC frame, delay the next RPC — only meaningful when
+    the scheduler fronts a supervised process-backed gateway, see
+    ``Gateway.chaos_process_hook``), then flushes due dropout-burst
+    rejoins, injects the tick's event-channel faults (which the
+    quarantine gate must reject), arms the solver-channel faults on the
+    scheduler's ``fault_hook``, and finally handles the real trace event.
+    After the trace, clean no-op load ticks run until the scheduler
     reports healthy, bounded by ``recovery_tick_budget``.
+
+    ``recovery_probe`` (e.g. ``Gateway.recovery_status``) is called once
+    at soak end; its dict rides the report as ``.recovery`` and arms the
+    crash-contract section of ``violations()``.
 
     ``on_event(event, view, ms)`` fires for every handled event (the serve
     CLI's log hook). The scheduler's ``fault_hook`` is overwritten for the
@@ -490,20 +627,36 @@ def chaos_replay(
     records: List[ChaosRecord] = []
     trace_views = []
 
+    def _fleet_seq() -> int:
+        # getattr with a default also absorbs an AttributeError raised
+        # INSIDE a facade's ``fleet`` property (factory-built schedulers
+        # may expose no fleet); seq 0 then disables seq reconciliation
+        # for that record rather than killing the soak.
+        fleet = getattr(scheduler, "fleet", None)
+        return getattr(fleet, "seq", 0) if fleet is not None else 0
+
+    def _fleet_L() -> int:
+        # Defensive: a factory-built scheduler (stub harnesses) may carry
+        # no model; the per-record L then falls back to violations(L=...).
+        fleet = getattr(scheduler, "fleet", None)
+        return getattr(getattr(fleet, "model", None), "L", 0) or 0
+
     def _handle(ev, tick: int, source: str):
-        seq_before = scheduler.fleet.seq
+        seq_before = _fleet_seq()
         t0 = time.perf_counter()
         view = scheduler.handle(ev)
         ms = (time.perf_counter() - t0) * 1e3
+        delta = _fleet_seq() - seq_before
         records.append(
             ChaosRecord(
                 tick=tick,
                 source=source,
                 kind=getattr(ev, "kind", type(ev).__name__),
-                quarantined=scheduler.fleet.seq == seq_before,
+                quarantined=delta == 0,
                 view=view,
                 ms=ms,
-                L=scheduler.fleet.model.L,
+                L=_fleet_L(),
+                seq_delta=delta,
             )
         )
         if on_event is not None:
@@ -518,6 +671,20 @@ def chaos_replay(
             # tick's arming.
             specs = injector.faults_at(tick)
             injector.arm(tick, specs)
+            procs = injector.process_faults(tick, specs)
+            if procs and process_hook is None:
+                raise ValueError(
+                    f"fault plan schedules process fault "
+                    f"{procs[0][1].kind!r} at tick {tick} but no "
+                    "process_hook was provided (process faults need a "
+                    "supervised process-backed gateway)"
+                )
+            for _idx, spec in procs:
+                # Fire BEFORE the tick's handles: the kill/torn frame
+                # lands mid-stream and the very next dispatch walks into
+                # the dead child — the recovery path under test.
+                process_hook(spec.kind, spec)
+                injector.count_fired(spec.kind)
             for dev in injector.pop_rejoins(tick):
                 _handle(DeviceJoin(device=dev), tick, "injected:rejoin")
             for label, bad in injector.event_faults(tick, specs, scheduler.fleet):
@@ -558,4 +725,5 @@ def chaos_replay(
         ticks_to_healthy=ticks_to_healthy,
         final_health=scheduler.health,
         metrics=scheduler.metrics_snapshot(),
+        recovery=dict(recovery_probe()) if recovery_probe is not None else None,
     )

@@ -188,6 +188,18 @@ METRIC_REGISTRY = {
     "onto the destination (none lost, none doubled)",
     "migration_failed": "Migration flips that failed (routing unchanged, "
     "source kept serving)",
+    # -- crash-tolerant process tier (gateway supervision) ----------------
+    "worker_crashes": "Process-worker child deaths the supervisor classified",
+    "child_respawns": "Crashed children respawned (fresh socket, same worker)",
+    "shards_recovered": "Shards rebuilt onto a respawned child (warm)",
+    "events_replayed": "WAL-tail events replayed during crash recovery",
+    "wal_appends": "Accepted events journaled to the per-shard WAL",
+    "micro_snapshots": "Per-shard micro-snapshots taken (WAL truncated)",
+    "micro_snapshot_failed": "Micro-snapshot attempts that hit a dead child",
+    "workers_quarantined": "Workers taken out of the ring by the crash-loop "
+    "breaker (slice rebalanced away; surfaced in /signals)",
+    "http_worker_crashed": "HTTP 503s (child died mid-request; shard "
+    "recovering, Retry-After returned)",
     # -- closed-loop autoscaler (distilp_tpu.control) ---------------------
     "control_actions": "Controller actions emitted (all kinds)",
     "control_scale_out": "Scale-out actions (spawn one worker + rebalance)",
@@ -221,6 +233,8 @@ METRIC_REGISTRY = {
     "twin_p95": "Twin p95 latency of the served placement, ms",
     "gateway_event_to_placement": "Gateway ingest to placement (queue wait included), ms",
     "spec_hit_ms": "Speculative-hit serve latency (bank probe to publish), ms",
+    "recovery_mttr_ms": "Crash detection to shard(s) serving again "
+    "(respawn+replay or quarantine+rebalance), ms",
     "spec_presolve_ms": "Speculative presolve batch latency (off the serving path), ms",
     "compile_ms": "XLA compile time a tick paid (ledger-attributed), ms",
     "mem_live_mb": "Live jax-array megabytes at tick end (memory-ledger "
@@ -241,7 +255,7 @@ METRIC_FAMILIES = (
     ("lp_backend_", "Ticks by the LP relaxation engine that actually ran"),
     ("served_", "Degraded-mode serves, by published mode"),
     ("fault_injected_", "Chaos faults scheduled, by kind"),
-    ("fault_fired_", "Solver-channel chaos faults that fired, by kind"),
+    ("fault_fired_", "Solver/process-channel chaos faults that fired, by kind"),
     ("worker_", "Gateway per-worker counters (worker_<i>_events)"),
 )
 

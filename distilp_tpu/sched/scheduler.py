@@ -2092,15 +2092,17 @@ def drift_warm_share(metrics: SchedulerMetrics) -> float:
     mode, since the entry mode is what the event routing chose.
     """
     c = metrics.counters
-    drift = c["drift_events"]
+    # .get everywhere, for two reasons: a bracket read on the live
+    # defaultdict would MINT a speculation counter into the default
+    # (spec-off) path's summary output — breaking the byte-identical
+    # contract — and a process-backed shard's counters arrive as a PLAIN
+    # dict snapshotted over RPC, where a missing key is a KeyError.
+    drift = c.get("drift_events", 0)
     if not drift:
         return 1.0
-    # .get, not []: the counters dict is a defaultdict, and a bracket read
-    # here would MINT a speculation counter into the default (spec-off)
-    # path's summary output — breaking the byte-identical contract.
     fast = (
-        c["drift_tick_warm"]
-        + c["drift_tick_margin"]
+        c.get("drift_tick_warm", 0)
+        + c.get("drift_tick_margin", 0)
         + c.get("drift_tick_spec", 0)
         + c.get("drift_tick_spec_near", 0)
     )

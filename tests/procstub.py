@@ -12,6 +12,9 @@ through a live move.
 
 from __future__ import annotations
 
+import os
+import time
+
 from distilp_tpu.sched.metrics import SchedulerMetrics
 
 
@@ -24,10 +27,19 @@ class StubScheduler:
         self.spec_k = 4
         self.events = 0
         self._restore_pending = False
+        # Chaos knobs (ISSUE 20 crash-taxonomy tests), set over the RPC
+        # setattr surface and inert by default. Neither rides the dump
+        # blob: a respawned child comes back with both disarmed, exactly
+        # like a real scheduler loses its injected faults on restart.
+        self.exit_on_dump = 0  # die (os._exit) on the Nth dump_state call
+        self.solve_sleep_s = 0.0  # stretch handle() so a kill lands mid-solve
+        self.dumps = 0
 
     # -- ticks -------------------------------------------------------------
 
     def handle(self, event, pressure: bool = False):
+        if self.solve_sleep_s:
+            time.sleep(self.solve_sleep_s)
         if self._restore_pending:
             self._restore_pending = False
             self.metrics.inc("warm_resumes")
@@ -51,6 +63,12 @@ class StubScheduler:
     # -- snapshot chain ----------------------------------------------------
 
     def dump_state(self) -> dict:
+        self.dumps += 1
+        if self.exit_on_dump and self.dumps >= self.exit_on_dump:
+            # Child suicide mid-RPC: the parent's recv sees EOF and
+            # raises WorkerCrashed — the migration-abort / torn-dump
+            # corner the fold-on-abort tests pin.
+            os._exit(43)
         return {
             "version": 1,
             "devices": list(self.devices),

@@ -55,6 +55,7 @@ def sig(
     capacity: float | None = None,
     mem: float | None = None,
     trend: float | None = None,
+    recovery: dict | None = None,
 ) -> SignalsPayload:
     """A synthetic /signals payload: depth spread evenly over workers."""
     slos = []
@@ -80,6 +81,7 @@ def sig(
         max_sustainable_eps=capacity,
         headroom_eps=headroom,
         mem_headroom_bytes=mem,
+        recovery=recovery,
     )
 
 
@@ -421,3 +423,43 @@ def test_control_loop_noops_without_timeline():
         assert loop.actions == []
     finally:
         gw.close()
+
+
+# -- quarantine vote (ISSUE 20 crash-loop breaker -> scale-out) ---------------
+
+
+def test_quarantine_vote_fires_on_increase_only():
+    """The crash-loop breaker's quarantine is permanent capacity loss:
+    the controller votes scale-out on the INCREASE of
+    ``recovery.workers_quarantined`` — once per newly opened breaker,
+    never again for the same high-water mark."""
+    c = Controller(policy())
+    # Supervised but nothing quarantined: no vote.
+    assert c.decide(sig(recovery={"workers_quarantined": 0}), 0.0, 2) == []
+    # A breaker opens: one scale-out vote.
+    acts = c.decide(sig(recovery={"workers_quarantined": 1}), 1.0, 2)
+    assert [a.kind for a in acts] == ["scale_out"]
+    assert acts[0].target_workers == 3
+    assert "quarantined" in acts[0].reason
+    # Same count re-observed past the cooldown: high-water mark holds,
+    # no re-vote (the lost worker was already compensated for).
+    assert c.decide(sig(recovery={"workers_quarantined": 1}), 20.0, 3) == []
+    # A SECOND breaker opens: fires again (delta 1, past cooldown).
+    acts = c.decide(sig(recovery={"workers_quarantined": 2}), 40.0, 3)
+    assert [a.kind for a in acts] == ["scale_out"]
+
+
+def test_quarantine_vote_respects_gates():
+    """The vote is inert without a recovery block (unsupervised
+    gateway), when the policy knob is off, and — like every vote — it
+    cannot breach max_workers."""
+    # No recovery block: nothing to vote on.
+    c = Controller(policy())
+    assert c.decide(sig(), 0.0, 2) == []
+    # Knob off: quarantines observed but never voted on.
+    c = Controller(policy(scale_out_on_quarantine=False))
+    assert c.decide(sig(recovery={"workers_quarantined": 1}), 0.0, 2) == []
+    # At the ceiling: the vote holds instead of acting.
+    c = Controller(policy(max_workers=2))
+    assert c.decide(sig(recovery={"workers_quarantined": 1}), 0.0, 2) == []
+    assert c._holds == 1

@@ -2635,3 +2635,93 @@ def test_control_real_modules_are_currently_clean():
         src = Path(rel).read_text()
         for code in ("DLP013", "DLP017", "DLP018", "DLP019", "DLP020"):
             assert findings_for(code, rel, src) == [], (rel, code)
+
+
+# --------------------------------------------------------------------------
+# crash-tolerance tier (ISSUE 20): the recovery module (WAL + snapshot
+# store + supervisor) and the process-worker chaos surface ride the
+# gateway/ prefix of every service-layer contract. Pinned per rule so a
+# rename out of the prefix set fails HERE — not by silently un-linting
+# the exactly-once machinery.
+
+
+def test_recovery_module_joins_silent_except_contract():
+    out = findings_for("DLP017", "distilp_tpu/gateway/recovery.py", """\
+        def replay_tail(self):
+            try:
+                self._apply_records()
+            except OSError:
+                pass
+        """)
+    assert len(out) == 1 and "metrics sink" in out[0].message
+    # The justified-disable escape the WAL's torn-tail scan and the
+    # best-effort directory fsync use — reason required on the line.
+    out = findings_for("DLP017", "distilp_tpu/gateway/recovery.py", """\
+        def replay_tail(self):
+            try:
+                self._apply_records()
+            except OSError:  # dlint: disable=DLP017 a torn tail record IS the crash being recovered; replay stops at the last durable frame
+                pass
+        """)
+    assert out == []
+
+
+def test_recovery_module_joins_lazy_jax_contract():
+    out = findings_for("DLP013", "distilp_tpu/gateway/recovery.py", """\
+        import jax
+
+        def restore(self):
+            return jax
+        """)
+    assert len(out) == 1
+
+
+def test_recovery_module_joins_async_blocking_contract():
+    out = findings_for("DLP018", "distilp_tpu/gateway/recovery.py", """\
+        import time
+
+        async def flush(self):
+            time.sleep(0.1)
+        """)
+    assert len(out) == 1
+
+
+def test_recovery_module_joins_metric_registry_contract():
+    out = findings_for("DLP019", "distilp_tpu/gateway/recovery.py", """\
+        def append(self, metrics):
+            metrics.inc("wal_appendz")
+        """)
+    assert len(out) == 1 and "METRIC_REGISTRY" in out[0].message
+    # ...while the registered supervision counters pass.
+    out = findings_for("DLP019", "distilp_tpu/gateway/recovery.py", """\
+        def append(self, metrics):
+            metrics.inc("wal_appends")
+            metrics.inc("micro_snapshots")
+            metrics.inc("worker_crashes")
+            metrics.inc("child_respawns")
+            metrics.inc("events_replayed")
+            metrics.inc("workers_quarantined")
+        """)
+    assert out == []
+
+
+def test_recovery_module_joins_jit_registry_contract():
+    out = findings_for("DLP020", "distilp_tpu/gateway/recovery.py", """\
+        import jax
+
+        def warm_restore(self, xs):
+            step = jax.jit(lambda x: x + 1)
+            return step(xs)
+        """)
+    assert len(out) == 1
+
+
+def test_recovery_real_modules_are_currently_clean():
+    """The REAL crash-tolerance modules pass their layer's contracts."""
+    from pathlib import Path
+
+    for mod in ("recovery", "snapshot", "procworker"):
+        rel = f"distilp_tpu/gateway/{mod}.py"
+        src = Path(rel).read_text()
+        for code in ("DLP013", "DLP017", "DLP018", "DLP019", "DLP020"):
+            assert findings_for(code, rel, src) == [], (rel, code)

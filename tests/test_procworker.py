@@ -296,3 +296,84 @@ def test_proc_snapshot_roundtrips_to_thread_backend():
         assert totals["warm_resumes"] == 2
     finally:
         gw2.close()
+
+
+# -- crash taxonomy (ISSUE 20) ---------------------------------------------
+#
+# Three distinct deaths, three distinct surfaces: a torn RPC frame (the
+# child refuses to parse a half-frame and exits nonzero), SIGKILL landing
+# mid-solve (rc -9, the in-flight op named in the error), and a clean
+# shutdown (exit 0, no crash counter — stop() is not a failure mode).
+
+
+def test_torn_frame_mid_payload_is_worker_crashed_not_eof():
+    from distilp_tpu.gateway.procworker import WorkerCrashed
+
+    gw = _gateway(n_fleets=1)
+    try:
+        fid = sorted(gw._fleet_key)[0]
+        gw.handle_event(fid, "ev0")
+        gw.workers[0].inject_torn_frame()
+        with pytest.raises(WorkerCrashed) as ei:
+            gw.handle_event(fid, "ev1")
+        err = ei.value
+        # Typed for the HTTP ladder: NOT EOFError (client hangup, 400)
+        # and NOT RuntimeError (conflict, 409).
+        assert not isinstance(err, (EOFError, RuntimeError))
+        assert err.worker_id == 0
+        # A torn peer is a deliberate nonzero exit (the child's framing
+        # layer refuses half a length header), NOT a SIGKILL.
+        assert err.returncode is not None
+        assert err.returncode != 0 and err.returncode != -9
+    finally:
+        gw.close()
+
+
+def test_kill9_mid_solve_surfaces_sigkill_returncode():
+    import time
+
+    from distilp_tpu.gateway.procworker import WorkerCrashed
+
+    gw = _gateway(n_fleets=1)
+    try:
+        fid = sorted(gw._fleet_key)[0]
+        key = gw._fleet_key[fid]
+        gw.handle_event(fid, "ev0")
+        worker = gw.workers[0]
+        worker.rpc(
+            {
+                "op": "setattr",
+                "key": key,
+                "name": "solve_sleep_s",
+                "value": 1.0,
+            }
+        )
+        crashed: list = []
+
+        def tick():
+            try:
+                gw.handle_event(fid, "mid-solve")
+            except BaseException as e:  # noqa: BLE001 - the assertion target
+                crashed.append(e)
+
+        t = threading.Thread(target=tick)
+        t.start()
+        time.sleep(0.3)  # let the RPC dispatch and the child enter the solve
+        worker.kill_child()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert len(crashed) == 1 and isinstance(crashed[0], WorkerCrashed)
+        assert crashed[0].returncode == -9  # SIGKILL, not a clean exit
+        assert crashed[0].op is not None  # the in-flight op is named
+    finally:
+        gw.close()
+
+
+def test_clean_shutdown_is_not_a_crash():
+    gw = _gateway(n_fleets=1)
+    fid = sorted(gw._fleet_key)[0]
+    gw.handle_event(fid, "ev0")
+    proc = gw.workers[0]._proc
+    gw.close()
+    assert proc.returncode == 0
+    assert "worker_crashes" not in gw.metrics.snapshot()["counters"]

@@ -1,0 +1,479 @@
+"""Crash-tolerant process tier (ISSUE 20 tentpole).
+
+The gateway-side supervisor over ``ProcShardWorker``: crash detection on
+the dead socket, respawn with bounded backoff, per-fleet WAL + micro-
+snapshot recovery (exactly-once: WAL append BEFORE dispatch, snapshot
+durable-rename THEN truncate, respawn restores warm and replays only the
+tail), and the crash-loop breaker that quarantines a flapping worker and
+re-homes its ring slice. All on the jax-free stub factory so the whole
+file stays inside the tier-1 wall-clock budget — the real-scheduler
+kill loop is ``make smoke-crash`` and the bench ``recovery`` section.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from distilp_tpu.gateway import Gateway
+from distilp_tpu.gateway.procworker import WorkerCrashed
+from distilp_tpu.gateway.traces import make_fleet_from_spec
+
+FACTORY = "tests.procstub:make_scheduler"
+
+
+def _supervised(
+    tmp_path, n_fleets: int, n_workers: int = 1, snapshot_every: int = 2, **kw
+) -> Gateway:
+    gw = Gateway(
+        n_workers=n_workers,
+        scheduler_factory=FACTORY,
+        worker_backend="process",
+        supervise=True,
+        recovery_dir=str(tmp_path),
+        snapshot_every=snapshot_every,
+        backoff_base_s=0.01,
+        backoff_max_s=0.05,
+        **kw,
+    )
+    for i in range(n_fleets):
+        fid = f"r{i:02d}"
+        gw.register_fleet(
+            fid, make_fleet_from_spec(fid, {"m": 3, "seed": 900 + i}), "stub"
+        )
+    return gw
+
+
+# -- exactly-once recovery -------------------------------------------------
+
+
+def test_kill9_mid_stream_recovers_exactly_once(tmp_path):
+    """A SIGKILL between ticks: the next dispatch walks into the dead
+    child, recovery restores the snapshot + replays the WAL tail inline,
+    and the interrupted event is applied exactly once (seq continuity,
+    events_lost == 0) with every shard back WARM."""
+    gw = _supervised(tmp_path, n_fleets=2)
+    try:
+        fleets = sorted(gw._fleet_key)
+        for j in range(3):
+            for fid in fleets:
+                assert gw.handle_event(fid, f"ev{j}")["seq"] == j + 1
+        gw.workers[0].kill_child()
+        # The kill-adjacent event rides the recovery: no gap, no repeat.
+        for fid in fleets:
+            assert gw.handle_event(fid, "post-kill")["seq"] == 4
+        rec = gw.recovery_status()
+        assert rec["worker_crashes"] == 1
+        assert rec["child_respawns"] == 1
+        assert rec["shards_recovered"] == 2
+        assert rec["events_lost"] == 0
+        assert rec["cold_resumes"] == 0
+        assert rec["warm_resumes"] == 2
+        assert rec["workers_quarantined"] == 0
+        assert rec["mttr_p99_ms"] > 0
+    finally:
+        gw.close()
+
+
+def test_wal_replay_idempotent_across_double_crash(tmp_path):
+    """Two kills with NO snapshot boundary between them: the second
+    recovery replays a tail overlapping the first's. Replay reconciles
+    record-by-record against the per-fleet cursor, so nothing is applied
+    twice (seq stays strictly continuous, events_lost == 0 — a negative
+    value here would mean double-apply)."""
+    gw = _supervised(tmp_path, n_fleets=1)
+    try:
+        fid = sorted(gw._fleet_key)[0]
+        for j in range(3):
+            gw.handle_event(fid, f"ev{j}")
+        gw.workers[0].kill_child()
+        assert gw.handle_event(fid, "k1")["seq"] == 4
+        # Immediate second kill: cursor 4 sits past the cursor-4
+        # snapshot boundary taken during recovery; the replayed tails
+        # overlap across the two recoveries.
+        gw.workers[0].kill_child()
+        assert gw.handle_event(fid, "k2")["seq"] == 5
+        assert gw.handle_event(fid, "steady")["seq"] == 6
+        rec = gw.recovery_status()
+        assert rec["worker_crashes"] == 2
+        assert rec["child_respawns"] == 2
+        assert rec["events_lost"] == 0
+        assert rec["cold_resumes"] == 0
+    finally:
+        gw.close()
+
+
+def test_recovery_replays_only_the_wal_tail(tmp_path):
+    """Micro-snapshots bound replay work: with snapshot_every=2 and the
+    crash landing right after a boundary, the respawned child replays
+    only the records past the snapshot cursor — not the fleet's whole
+    history."""
+    gw = _supervised(tmp_path, n_fleets=1)
+    try:
+        fid = sorted(gw._fleet_key)[0]
+        for j in range(6):
+            gw.handle_event(fid, f"ev{j}")
+        gw.workers[0].kill_child()
+        assert gw.handle_event(fid, "post")["seq"] == 7
+        rec = gw.recovery_status()
+        assert rec["events_lost"] == 0
+        # Cursor 6 snapshot was durable before the kill: the tail is
+        # AT MOST the post-boundary records, never the 6-event history.
+        assert 0 < rec["events_replayed"] <= 2
+        assert rec["micro_snapshots"] >= 3
+    finally:
+        gw.close()
+
+
+def test_crash_during_recovery_replay_restarts_replay_idempotently(tmp_path):
+    """The fresh child dies MID-REPLAY (after re-applying the first WAL
+    record, before the second): the recovery loop classifies it as a new
+    crash, respawns again, restores the SAME snapshot and replays the
+    SAME tail from the top — the abandoned attempt's partial application
+    died with its child, so nothing lands twice."""
+    gw = _supervised(tmp_path, n_fleets=1, snapshot_every=4)
+    try:
+        fid = sorted(gw._fleet_key)[0]
+        # Snapshot at cursor 1, WAL tail [2, 3]: two records to replay.
+        for j in range(3):
+            gw.handle_event(fid, f"ev{j}")
+        worker = gw.workers[0]
+        orig_rpc = worker.rpc
+        state = {"killed": False}
+
+        def chaos_rpc(req):
+            out = orig_rpc(req)
+            # First successful handle after the kill IS replay record #2
+            # (the triggering dispatch died on the wire): kill again so
+            # replaying record #3 walks into a second dead child.
+            if req.get("method") == "handle" and not state["killed"]:
+                state["killed"] = True
+                worker.kill_child()
+            return out
+
+        worker.rpc = chaos_rpc
+        worker.kill_child()
+        assert gw.handle_event(fid, "post")["seq"] == 4
+        assert state["killed"]  # the mid-replay kill actually fired
+        rec = gw.recovery_status()
+        assert rec["worker_crashes"] == 2
+        assert rec["child_respawns"] == 2
+        assert rec["events_lost"] == 0  # negative would mean double-apply
+        assert rec["cold_resumes"] == 0
+    finally:
+        gw.close()
+
+
+# -- crash-loop breaker ----------------------------------------------------
+
+
+def test_crash_loop_breaker_quarantines_and_rebalances(tmp_path):
+    """N crashes inside the window open the breaker: the flapping worker
+    is quarantined (not respawned again), its ring slice re-homes onto
+    the survivor, and serving continues with the seq chain intact."""
+    gw = _supervised(
+        tmp_path,
+        n_fleets=4,
+        n_workers=2,
+        crash_loop_threshold=2,
+        crash_loop_window_s=60.0,
+    )
+    try:
+        fleets = sorted(gw._fleet_key)
+        for j in range(2):
+            for fid in fleets:
+                gw.handle_event(fid, f"ev{j}")
+        # Aim at whichever worker owns fleets[0]'s shard.
+        key = gw._fleet_key[fleets[0]]
+        wid = gw._shards[key][2]
+        gw.workers[wid].kill_child()
+        for fid in fleets:
+            gw.handle_event(fid, "k1")  # crash 1 -> respawn
+        gw.workers[wid].kill_child()
+        for fid in fleets:
+            assert gw.handle_event(fid, "k2")["seq"] == 4  # crash 2 -> breaker
+        rec = gw.recovery_status()
+        assert rec["workers_quarantined"] == 1
+        assert rec["quarantined_workers"] == [wid]
+        assert rec["events_lost"] == 0
+        assert rec["cold_resumes"] == 0
+        # The ring rebalanced away from the quarantined slot...
+        assert gw.live_worker_ids() == [i for i in (0, 1) if i != wid]
+        assert gw._shards[key][2] != wid
+        # ...and the re-homed shards keep serving.
+        for fid in fleets:
+            assert gw.handle_event(fid, "steady")["seq"] == 5
+    finally:
+        gw.close()
+
+
+def test_single_worker_gateway_never_quarantines(tmp_path):
+    """With nowhere to re-home, the breaker keeps respawning past the
+    threshold (documented): a 1-worker gateway must not serve nothing."""
+    gw = _supervised(
+        tmp_path,
+        n_fleets=1,
+        n_workers=1,
+        crash_loop_threshold=1,
+        crash_loop_window_s=60.0,
+    )
+    try:
+        fid = sorted(gw._fleet_key)[0]
+        gw.handle_event(fid, "ev0")
+        for k in range(2):
+            gw.workers[0].kill_child()
+            assert gw.handle_event(fid, f"k{k}")["seq"] == k + 2
+        rec = gw.recovery_status()
+        assert rec["workers_quarantined"] == 0
+        assert rec["child_respawns"] == 2
+        assert rec["events_lost"] == 0
+    finally:
+        gw.close()
+
+
+# -- RPC retry discipline --------------------------------------------------
+
+
+def test_read_rpcs_retry_once_mutating_calls_never(tmp_path):
+    """A read that dies on the wire retries ONCE against the respawned
+    child (idempotent by definition); a mutating call never auto-retries
+    — whether it applied child-side is ambiguous, and resolving that is
+    the WAL's job, not a blind retry's."""
+    gw = _supervised(tmp_path, n_fleets=1)
+    try:
+        fid = sorted(gw._fleet_key)[0]
+        key = gw._fleet_key[fid]
+        gw.handle_event(fid, "ev0")
+        sched = gw.workers[0].shards[key]
+        gw.workers[0].kill_child()
+        # Read: recovered transparently, no exception, warm cursor intact.
+        assert sched.latest()["seq"] == 1
+        assert gw.recovery_status()["worker_crashes"] == 1
+        # Mutation on a dead child: raises, never auto-retried.
+        gw.workers[0].kill_child()
+        with pytest.raises(WorkerCrashed) as ei:
+            sched.handle("direct-mutation")
+        assert ei.value.worker_id == 0
+        # The supervised gateway path is how mutations recover (replay).
+        assert gw.handle_event(fid, "ev1")["seq"] == 2
+        assert gw.recovery_status()["events_lost"] == 0
+    finally:
+        gw.close()
+
+
+# -- supervision off: byte-identical serving -------------------------------
+
+
+def test_supervision_off_serving_is_byte_identical(tmp_path):
+    """With supervise=False the recovery tier must be invisible: same
+    views and same shard totals as the thread backend on the same trace,
+    and no WAL/snapshot/supervision counter ever minted."""
+
+    def run(backend: str, supervise: bool):
+        kw = {}
+        if supervise:
+            kw = {"supervise": True, "recovery_dir": str(tmp_path)}
+        gw = Gateway(
+            n_workers=2,
+            scheduler_factory=FACTORY,
+            worker_backend=backend,
+            **kw,
+        )
+        try:
+            for i in range(3):
+                fid = f"s{i:02d}"
+                gw.register_fleet(
+                    fid,
+                    make_fleet_from_spec(fid, {"m": 3, "seed": 910 + i}),
+                    "stub",
+                )
+            views = [
+                gw.handle_event(f"s{i:02d}", f"ev{j}")
+                for j in range(4)
+                for i in range(3)
+            ]
+            counters = dict(gw.metrics.snapshot()["counters"])
+            return views, gw.metrics_snapshot()["shard_totals"], counters
+        finally:
+            gw.close()
+
+    views_t, totals_t, counters_t = run("thread", supervise=False)
+    views_p, totals_p, counters_p = run("process", supervise=False)
+    assert views_t == views_p
+    assert totals_t == totals_p
+    for counters in (counters_t, counters_p):
+        for name in (
+            "wal_appends",
+            "micro_snapshots",
+            "worker_crashes",
+            "child_respawns",
+            "shards_recovered",
+            "events_replayed",
+        ):
+            assert name not in counters
+    # Supervision ON serves the same views — the WAL rides alongside the
+    # dispatch path, it never changes what a healthy tick returns.
+    views_s, totals_s, counters_s = run("process", supervise=True)
+    assert views_s == views_p
+    assert totals_s == totals_p
+    assert counters_s.get("wal_appends", 0) == 12
+
+
+def test_unsupervised_recovery_status_and_crash_surface(tmp_path):
+    """Supervision off: a child crash raises WorkerCrashed to the caller
+    (typed — NOT RuntimeError's 409, NOT EOFError's 400) instead of
+    being silently respawned."""
+    gw = Gateway(
+        n_workers=1, scheduler_factory=FACTORY, worker_backend="process"
+    )
+    try:
+        gw.register_fleet(
+            "u0", make_fleet_from_spec("u0", {"m": 3, "seed": 920}), "stub"
+        )
+        gw.handle_event("u0", "ev0")
+        assert gw.recovery_status()["supervised"] is False
+        gw.workers[0].kill_child()
+        with pytest.raises(WorkerCrashed) as ei:
+            gw.handle_event("u0", "ev1")
+        assert not isinstance(ei.value, (RuntimeError, EOFError))
+    finally:
+        gw.close()
+
+
+# -- satellite 1: migration abort folds the prefetched counters ------------
+
+
+def test_migration_abort_folds_prefetched_counters(tmp_path):
+    """Source child dies between the migration's prefetch and flip: the
+    flip aborts, and the Phase-1 prefetched counter copy — the last
+    readable one — folds into the fleet's running totals instead of
+    dying with the child."""
+    gw = Gateway(
+        n_workers=2,
+        scheduler_factory=FACTORY,
+        worker_backend="process",
+        dynamic=True,
+    )
+    try:
+        for i in range(2):
+            fid = f"m{i:02d}"
+            gw.register_fleet(
+                fid, make_fleet_from_spec(fid, {"m": 3, "seed": 930 + i}), "stub"
+            )
+        fleets = sorted(gw._fleet_key)
+        for j in range(3):
+            for fid in fleets:
+                gw.handle_event(fid, f"ev{j}")
+        fid = fleets[0]
+        key = gw._fleet_key[fid]
+        src_widx = gw._shards[key][2]
+        src = gw.workers[src_widx]
+        dst_widx = next(w for w in gw.live_worker_ids() if w != src_widx)
+        # Arm the child to die on its SECOND dump from here: the
+        # migration's prefetch dump succeeds, the flip dump crashes.
+        dumps = src.rpc({"op": "getattr", "key": key, "name": "dumps"})
+        src.rpc(
+            {
+                "op": "setattr",
+                "key": key,
+                "name": "exit_on_dump",
+                "value": dumps + 2,
+            }
+        )
+        with pytest.raises(WorkerCrashed):
+            gw.migrate_shard(fid, dst_widx)
+        assert gw.metrics.snapshot()["counters"]["migration_failed"] == 1
+        # The abort path folded the prefetched copy: the fleet's events
+        # survive the dead child in the running totals.
+        assert gw._folded_counters[fid]["events_total"] == 3
+    finally:
+        gw.close()
+
+
+# -- chaos plumbing --------------------------------------------------------
+
+
+def test_crash_plan_fixture_parses_as_process_faults():
+    from distilp_tpu.sched.faults import PROCESS_CHANNEL, FaultPlan
+
+    plan = FaultPlan.from_json("tests/traces/crash_plan.json")
+    assert plan.seed == 7
+    kinds = [f.kind for f in plan.faults]
+    assert "child_kill" in kinds and "rpc_delay" in kinds
+    assert all(k in PROCESS_CHANNEL for k in kinds)
+
+
+def test_chaos_replay_rejects_process_faults_without_hook():
+    """A plan that schedules process-channel faults is only meaningful
+    against a supervised process-backed gateway: chaos_replay must fail
+    loudly, not silently skip the kills and report a clean soak."""
+    from distilp_tpu.sched.faults import FaultPlan, chaos_replay
+    from tests.procstub import StubScheduler
+
+    plan = FaultPlan(
+        seed=1, faults=[{"kind": "child_kill", "at_ticks": [0, 1]}]
+    )
+    with pytest.raises(ValueError, match="process_hook"):
+        chaos_replay(StubScheduler([], "m"), ["ev0", "ev1"], plan)
+
+
+# -- HTTP surface ----------------------------------------------------------
+
+
+def test_http_maps_worker_crashed_to_503(tmp_path):
+    """WorkerCrashed through POST /events is 503 + Retry-After (shard
+    mid-recovery, back off and retry) — distinct from 409's 'nothing
+    servable yet' and 400's client hangup — and mints its own counter."""
+    import asyncio
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from distilp_tpu.gateway.http import GatewayHTTPServer
+
+    gw = Gateway(
+        n_workers=1, scheduler_factory=FACTORY, worker_backend="process"
+    )
+
+    def post(port, path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=_json.dumps(payload).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return r.status, dict(r.headers), _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), _json.loads(e.read())
+
+    try:
+        gw.register_fleet(
+            "h0", make_fleet_from_spec("h0", {"m": 3, "seed": 940}), "stub"
+        )
+
+        async def main():
+            srv = GatewayHTTPServer(gw)
+            await srv.start()
+            loop = asyncio.get_running_loop()
+            port = srv.port
+            ev = {"kind": "load", "t_comm_jitter": {}}
+            st, _hdrs, out = await loop.run_in_executor(
+                None, post, port, "/events", {"fleet": "h0", "event": ev}
+            )
+            assert st == 200 and out["view"]["seq"] == 1
+            gw.workers[0].kill_child()
+            st, hdrs, out = await loop.run_in_executor(
+                None, post, port, "/events", {"fleet": "h0", "event": ev}
+            )
+            assert st == 503
+            assert hdrs.get("Retry-After") == "1"
+            assert out["worker"] == 0
+            counters = gw.metrics.snapshot()["counters"]
+            assert counters["http_worker_crashed"] == 1
+            assert "http_internal_error" not in counters
+            await srv.close()
+
+        asyncio.run(main())
+    finally:
+        gw.close()
